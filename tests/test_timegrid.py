@@ -1,0 +1,84 @@
+"""Time grid semantics vs a direct per-second datetime reference loop.
+
+The reference derives fractions and rollovers from local `datetime` fields
+(clearskyindexmodel.py:113-126); here we verify our vectorised modular
+arithmetic reproduces a straightforward datetime loop exactly, including
+across the European DST transitions.
+"""
+
+import datetime as dt
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.models.timegrid import TimeGridSpec
+
+
+def _golden_fields(start: dt.datetime, n: int, tz: str):
+    """Per-second local fields computed the reference's way (datetime objects)."""
+    z = ZoneInfo(tz)
+    t0 = start.replace(tzinfo=z) if start.tzinfo is None else start
+    epoch0 = int(t0.timestamp())
+    out = []
+    for i in range(n):
+        t = dt.datetime.fromtimestamp(epoch0 + i, z)
+        minf = t.second / 60
+        hourf = (t.minute + minf) / 60
+        dayf = (t.hour + hourf) / 24
+        out.append((t.day, t.hour, t.minute, minf, hourf, dayf))
+    return out
+
+
+@pytest.mark.parametrize(
+    "start,n",
+    [
+        ("2019-09-05 12:00:00", 7200),
+        ("2019-09-05 23:58:30", 300),          # day rollover, offset phase
+        ("2019-03-31 01:59:00", 7200),         # DST forward (02:00 -> 03:00 CEST)
+        ("2019-10-27 01:59:00", 2 * 3600 + 300),  # DST backward (03:00 -> 02:00)
+    ],
+)
+def test_fields_match_datetime_loop(start, n):
+    spec = TimeGridSpec.from_local_start(start, n, "Europe/Berlin")
+    blk = spec.block(0, n)
+    golden = _golden_fields(dt.datetime.fromisoformat(start), n, "Europe/Berlin")
+
+    for i in range(n):
+        day, hour, minute, minf, hourf, dayf = golden[i]
+        assert blk.min_fraction[i] == pytest.approx(minf)
+        assert blk.hour_fraction[i] == pytest.approx(hourf)
+        assert blk.day_fraction[i] == pytest.approx(dayf)
+        if i > 0:
+            pd, ph, pm = golden[i - 1][:3]
+            assert blk.new_day[i] == (day != pd), i
+            assert blk.new_hour[i] == (hour != ph), i
+            assert blk.new_min[i] == (minute != pm), i
+        else:
+            assert not (blk.new_day[i] or blk.new_hour[i] or blk.new_min[i])
+
+    # indices are cumulative rollover counts
+    assert np.array_equal(blk.day_idx, np.cumsum(blk.new_day))
+    assert np.array_equal(blk.hour_idx, np.cumsum(blk.new_hour))
+    assert np.array_equal(blk.min_idx, np.cumsum(blk.new_min))
+
+
+def test_blockwise_equals_whole():
+    n = 10_000
+    spec = TimeGridSpec.from_local_start("2019-12-31 22:00:00", n, "Europe/Berlin")
+    whole = spec.block(0, n)
+    parts = [spec.block(o, 4096) for o in range(0, n, 4096)]
+    for name in ("min_idx", "hour_idx", "day_idx", "month0", "doy", "local_sec"):
+        got = np.concatenate([getattr(p, name) for p in parts])
+        assert np.array_equal(got, getattr(whole, name)), name
+
+
+def test_interval_counts_cover_indices():
+    n = 3 * 86400 + 123
+    spec = TimeGridSpec.from_local_start("2019-03-30 17:23:45", n, "Europe/Berlin")
+    blk = spec.block(0, n)
+    assert blk.min_idx.max() + 1 == spec.n_minute_intervals
+    assert blk.hour_idx.max() + 1 == spec.n_hour_intervals
+    assert blk.day_idx.max() + 1 == spec.n_day_intervals
+    assert blk.month0[0] == 2  # March, 0-based
+    assert blk.doy[0] == 89

@@ -1,0 +1,33 @@
+"""tmhpvsim-tpu: TPU-native photovoltaic simulation & streaming framework.
+
+A ground-up re-design of the capabilities of ``coroa/tmhpvsim`` (reference at
+/root/reference) for JAX/XLA on TPU.  The reference simulates, per second,
+
+  * a random electricity demand ("meter") stream, and
+  * a stochastic PV generation stream (Markov-chain cloud cover -> clear-sky
+    index -> irradiance -> AC power, following Bright et al. 2015 + a pvlib
+    physics chain),
+
+joins the two 1 Hz streams by timestamp and writes ``time, meter, pv,
+residual load`` CSV rows (reference: tmhpvsim/pvsim.py:86-101).
+
+This framework keeps that capability surface (same CLI entrypoints and flags,
+an asyncio/AMQP streaming backend) and adds a TPU-first execution backend
+(``--backend=jax``) in which the whole per-second Monte Carlo loop is a
+``jit(shard_map(vmap(lax.scan(step))))`` over a device mesh: thousands to
+millions of independent site-chains, each advancing hourly/daily/minute/second
+stochastic state, evaluated blockwise over the time grid with the PV physics
+chain fully vectorized.
+
+Layout (mirrors SURVEY.md section 7's build order):
+
+  models/    stochastic weather + clear-sky-index + PV physics (pure JAX)
+  engine/    single-chip blockwise simulation engine and numpy golden path
+  parallel/  mesh/sharding layer: shard_map across chips, multi-host helpers
+  runtime/   asyncio streaming runtime (clock, funnel, retry, AMQP broker)
+  offline/   working shape-parameter fitting tool (replaces the reference's
+             broken pymc3 pipeline, cloud_cover_hourly.py:118-267)
+  data/      vendored distribution shape parameters + PV coefficients
+"""
+
+__version__ = "0.1.0"

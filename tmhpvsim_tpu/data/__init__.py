@@ -1,0 +1,9 @@
+"""Vendored numeric data for tmhpvsim-tpu (no runtime file/IO dependencies)."""
+
+from tmhpvsim_tpu.data.parameters import (  # noqa: F401
+    MARKOV_STEP_BINS,
+    MARKOV_STEP_PARAMS,
+    SAPM_MODULE,
+    SANDIA_INVERTER,
+    LINKE_TURBIDITY_MONTHLY_MUNICH,
+)

@@ -1,0 +1,1 @@
+"""Stochastic weather models and PV physics, as pure JAX + host-side grids."""
