@@ -1,0 +1,124 @@
+"""Hourly cloud-cover Markov chain as a branchless `lax.scan`.
+
+Reference semantics (cloud_cover_hourly.py:1-21, 290-316): the hourly cloud
+cover x in [0, 1] evolves as
+
+    x[i+1] = clip(x[i] + step(x[i]), 0, 1)
+
+where the step is drawn from one of six fitted distributions selected by
+which bin x[i] falls into (searchsorted over the right bin edges).  Five bins
+use an asymmetric-Laplace step, one a Student-t (data/parameters.py).
+
+TPU-first formulation: per transition we gather the bin's parameters with a
+`searchsorted` + take (no data-dependent Python branching), draw *both* an
+asymmetric-Laplace variate (closed-form inverse CDF of one uniform) and a
+Student-t variate from independent key splits, and `where`-select by the
+bin's distribution mark.  One transition is ~20 scalar flops, so a year of
+hourly states for a million chains is ~1e10 flops — `vmap` over chains and
+`lax.scan` over hours maps this straight onto the VPU.
+
+Reference-bug note: the reference's hourly *sampler* accidentally rebuilds
+the chain generator on every draw (clearskyindexmodel.py:61-63), so in
+practice it emits i.i.d. single steps from state 1.0 rather than a persistent
+chain.  `chain()` implements the documented persistent behaviour;
+`iid_from_one()` reproduces the accidental behaviour for compatibility
+(selected via ModelOptions.persistent_cloud_chain).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmhpvsim_tpu.data import MARKOV_STEP_BINS, MARKOV_STEP_PARAMS
+from tmhpvsim_tpu.models import distributions as dist
+
+
+def step_params(dtype=jnp.float32):
+    """Stacked per-bin step-distribution parameters for device-side gathers."""
+    p = np.asarray(MARKOV_STEP_PARAMS, dtype=np.float64)
+    return {
+        "bins": jnp.asarray(MARKOV_STEP_BINS, dtype=dtype),
+        "loc": jnp.asarray(p[:, 0], dtype=dtype),
+        "scale": jnp.asarray(p[:, 1], dtype=dtype),
+        "kappa": jnp.asarray(p[:, 2], dtype=dtype),
+        "df": jnp.asarray(p[:, 3], dtype=dtype),
+        "is_t": jnp.asarray(p[:, 4], dtype=dtype),
+    }
+
+
+def transition(key, state, params, dtype=jnp.float32):
+    """One Markov transition; `state` may be any shape, keys broadcast over it."""
+    idx = jnp.searchsorted(params["bins"], state, side="left")
+    idx = jnp.clip(idx, 0, params["loc"].shape[0] - 1)
+    loc = params["loc"][idx]
+    scale = params["scale"][idx]
+    kappa = params["kappa"][idx]
+    df = params["df"][idx]
+    is_t = params["is_t"][idx]
+
+    k_al, k_t = jax.random.split(key)
+    shape = jnp.shape(state)
+    d_al = dist.asymmetric_laplace(k_al, loc, scale, kappa, shape, dtype)
+    d_t = dist.student_t(k_t, loc, scale, df, shape, dtype)
+    step = jnp.where(is_t > 0.5, d_t, d_al)
+    return jnp.clip(state + step, 0.0, 1.0)
+
+
+def chain(key, n_samples, initial_state=1.0, dtype=jnp.float32):
+    """Persistent chain: `n_samples` successive states after `initial_state`.
+
+    Returns shape (n_samples,).  vmap over keys for independent chains.
+    """
+    params = step_params(dtype)
+    init = jnp.asarray(np.clip(initial_state, 0.0, 1.0), dtype=dtype)
+
+    def body(state, k):
+        nxt = transition(k, state, params, dtype)
+        return nxt, nxt
+
+    _, samples = jax.lax.scan(body, init, jax.random.split(key, n_samples))
+    return samples
+
+
+def iid_from_one(key, n_samples, dtype=jnp.float32):
+    """Reference-compat mode: i.i.d. draws, each one step from state 1.0
+    (the accidental behaviour of clearskyindexmodel.py:61-63)."""
+    params = step_params(dtype)
+    state = jnp.ones((n_samples,), dtype=dtype)
+    keys = jax.random.split(key, n_samples)
+    return jax.vmap(lambda k, s: transition(k, s, params, dtype))(keys, state)
+
+
+# ---------------------------------------------------------------------------
+# numpy golden implementation (float64, same formulas, independent code path)
+# ---------------------------------------------------------------------------
+
+
+def chain_numpy(rng: np.random.Generator, n_samples, initial_state=1.0):
+    """Pure-numpy persistent chain for distributional parity tests.
+
+    Independent implementation of the same mathematical model (inverse-CDF
+    sampling from numpy uniforms / standard_t), *not* the same RNG stream as
+    `chain` — comparisons are distributional (SURVEY.md §7 hard part (c)).
+    """
+    p = np.asarray(MARKOV_STEP_PARAMS, dtype=np.float64)
+    bins = np.asarray(MARKOV_STEP_BINS, dtype=np.float64)
+    state = float(np.clip(initial_state, 0.0, 1.0))
+    out = np.empty(n_samples)
+    for i in range(n_samples):
+        loc, scale, kappa, df, is_t = p[np.searchsorted(bins, state, side="left")]
+        if is_t > 0.5:
+            step = loc + scale * rng.standard_t(df)
+        else:
+            u = rng.uniform()
+            k2 = kappa * kappa
+            if u < k2 / (1 + k2):
+                x = kappa * np.log((1 + k2) / k2 * u)
+            else:
+                x = -np.log((1 + k2) * (1 - u)) / kappa
+            step = loc + scale * x
+        state = float(np.clip(state + step, 0.0, 1.0))
+        out[i] = state
+    return out
