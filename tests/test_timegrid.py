@@ -73,6 +73,29 @@ def test_blockwise_equals_whole():
         assert np.array_equal(got, getattr(whole, name)), name
 
 
+def test_minute_value_features_match_block_across_dst():
+    """Hour features at minute-draw instants agree with block() features at
+    those same seconds — including across the October backward transition,
+    where the n_back correction must keep the cc gather index consistent."""
+    n = 5 * 3600
+    spec = TimeGridSpec.from_local_start("2019-10-27 00:30:00", n, "Europe/Berlin")
+    blk = spec.block(0, n)
+    lo, hi = 0, int(blk.min_idx[-1]) + 2
+    h_idx, h_frac = spec.minute_value_features(lo, hi)
+    for i in range(lo, hi):
+        if i >= 2:
+            rel = 60 * (i - 1) - spec.min_phase
+            if rel >= n:
+                continue  # value after grid end (the final 'after' draw)
+            assert h_idx[i] == blk.hour_idx[rel], i
+            assert h_frac[i] == blk.hour_fraction[rel], i
+        else:
+            assert h_idx[i] == blk.hour_idx[0]
+            assert h_frac[i] == blk.hour_fraction[0]
+    # the repeated 02:xx hour must not advance hour_idx twice
+    assert blk.hour_idx[-1] == 4  # 5 wall-clock hours span only 4 rollovers
+
+
 def test_interval_counts_cover_indices():
     n = 3 * 86400 + 123
     spec = TimeGridSpec.from_local_start("2019-03-30 17:23:45", n, "Europe/Berlin")

@@ -49,6 +49,11 @@ class ModelOptions:
       process semantics (cloud_cover_binary.py:109-117).  Default False keeps
       the reference's branch assignment so statistical parity holds; True
       applies the arguably-intended assignment.
+    * ``advance_cloudy_hour`` — the reference's rollover cascade never
+      advances the cloudy-csi sampler (no ``next`` call for it anywhere in
+      clearskyindexmodel.py:101-111), so that sampler interpolates between
+      its two construction-time draws forever.  Default True advances it on
+      hour rollovers (evident intent); False reproduces the frozen pair.
     * the ``gamma.pdf(x, ...)`` NameError in the 6/8<=cc<7/8 band
       (clearskyindexmodel.py:80) is unconditionally fixed to ``gamma.rvs``
       (a crash is not behaviour worth reproducing).
@@ -56,6 +61,7 @@ class ModelOptions:
 
     persistent_cloud_chain: bool = True
     swap_covered_branches: bool = False
+    advance_cloudy_hour: bool = True
     #: cap applied to hourly cloud cover before driving the binary renewal
     #: process (cloud_cover_binary.py:71)
     max_binary_cloudcover: float = 0.95

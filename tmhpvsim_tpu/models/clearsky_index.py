@@ -1,0 +1,270 @@
+"""Composed clear-sky-index model — multi-rate TPU formulation.
+
+The reference (clearskyindexmodel.py:44-160, after Bright et al. 2015) keeps
+seven "interpolated samplers" — (before, after) pairs of random draws,
+linearly interpolated by the fraction of the current day/hour/minute — and
+advances them in a rollover cascade as wall time crosses day/hour/minute
+boundaries, composing per second:
+
+    csi(t) = base(t) * (minute_noise(t) + second_noise(t))
+
+with base/minute samplers chosen by whether the binary renewal process says
+the sky is covered.
+
+TPU-first re-design (the heart of SURVEY.md §7 steps 3-5): instead of
+advancing stateful samplers second by second, every sampler *value* gets a
+global interval index (precomputed on the host: models/timegrid.py) and is
+generated on-device at its own natural rate:
+
+  * hourly cloud cover  — `lax.scan` over hours (models/markov_hourly.py),
+    the only sequential dependency above 1 s resolution;
+  * hourly cloudy-csi, daily clear-csi, daily windspeed — index-keyed
+    i.i.d. draws (`fold_in(key, value_index)`), randomly accessible, so
+    any time block can be generated without replaying history;
+  * minute-noise values — index-keyed draws whose sigma depends on the
+    hourly cloud cover interpolated at their *draw instant*
+    (clearskyindexmodel.py:86-95), gathered from the hourly array;
+  * the per-second renewal + composition — one `lax.scan` over the seconds
+    of a block with an O(1) carry (models/renewal.py), vmapped over chains.
+
+Sampler-advance semantics preserved exactly (clearskyindexmodel.py:101-126):
+the clear-sky-day sampler advances on *both* hour and day rollovers (its
+pair index is hour_idx + day_idx), windspeed on day rollovers, cloud cover
+and cloudy-csi on hour rollovers, minute noise on minute rollovers.
+
+Reference-bug policies (see config.ModelOptions):
+  * cloudy-csi sampler: the reference *never* advances it (no `next` call
+    anywhere in the cascade, clearskyindexmodel.py:101-111), so it
+    interpolates between the same two construction-time draws forever.
+    Default here: advance on hour rollovers (the evident intent);
+    `ModelOptions.advance_cloudy_hour=False` reproduces the frozen pair.
+  * the 6/8<=cc<7/8 cloudy draw calls `gamma.pdf(x, ...)` with undefined
+    `x` (NameError, clearskyindexmodel.py:80); fixed to a Gamma(5, 0.1)
+    *sample*, per the comment above that line.
+  * `covered` selects the clear-sky samplers and vice versa
+    (clearskyindexmodel.py:149-160); kept by default for parity,
+    `ModelOptions.swap_covered_branches=True` applies the evident intent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmhpvsim_tpu.config import ModelOptions
+from tmhpvsim_tpu.models import distributions as dist
+from tmhpvsim_tpu.models import markov_hourly, renewal
+from tmhpvsim_tpu.models.timegrid import TimeGridSpec
+
+# Bright et al. 2015 parameters as used by the reference
+# (clearskyindexmodel.py:64-95,146-147)
+CSI_CLEAR_DAY_LOC = 0.99
+CSI_CLEAR_DAY_SCALE = 0.08
+CSI_CLOUDY_NORM_LOC = 0.6784
+CSI_CLOUDY_NORM_SCALE = 0.2046
+CSI_CLOUDY_GAMMA_MID = (5.0, 0.1)      # 6/8 <= cc < 7/8 (bug-fixed draw)
+CSI_CLOUDY_GAMMA_HIGH = (3.5624, 0.0867)  # cc >= 7/8
+SIGMA_MIN_FACTOR = np.sqrt(0.9)        # minute-noise variance split
+SIGMA_SEC_FACTOR = np.sqrt(0.1 * 60)   # second-noise variance split
+NOISE_CLOUDY = (0.01, 0.003)           # (sigma0, sigma1) minute, cloudy
+NOISE_CLEAR = (0.001, 0.0015)          # minute, clear — also used per-second
+                                       # by *both* branches
+                                       # (clearskyindexmodel.py:152,158)
+
+
+@dataclasses.dataclass
+class HostFeatures:
+    """Host-precomputed, chain-independent arrays for one simulation run."""
+
+    n_hours: int          # hour-interval count (sampler needs n_hours+1 values)
+    n_days: int
+    n_minutes: int
+    f0_hour: float        # hour fraction at the grid start (primer draw instant)
+
+    @classmethod
+    def from_spec(cls, spec: TimeGridSpec):
+        b0 = spec.block(0, 1)
+        return cls(
+            n_hours=spec.n_hour_intervals,
+            n_days=spec.n_day_intervals,
+            n_minutes=spec.n_minute_intervals,
+            f0_hour=float(b0.hour_fraction[0]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Per-run sampler value arrays (one chain; vmap over keys for a batch)
+# ---------------------------------------------------------------------------
+
+
+def _cloudy_csi_draw(key, cc, dtype):
+    """One cloudy-csi sample given the cloud cover at the draw instant
+    (clearskyindexmodel.py:68-84, with the NameError band fixed to rvs)."""
+    k_n, k_g = jax.random.split(key)
+    z = dist.normal(k_n, CSI_CLOUDY_NORM_LOC, CSI_CLOUDY_NORM_SCALE,
+                    jnp.shape(cc), dtype)
+    a = jnp.where(cc < 7 / 8, CSI_CLOUDY_GAMMA_MID[0], CSI_CLOUDY_GAMMA_HIGH[0])
+    scale = jnp.where(cc < 7 / 8, CSI_CLOUDY_GAMMA_MID[1], CSI_CLOUDY_GAMMA_HIGH[1])
+    g = scale * jax.random.gamma(k_g, a, jnp.shape(cc), dtype)
+    return jnp.where(cc < 6 / 8, z, g)
+
+
+def build_chain_arrays(key, feats: HostFeatures, options: ModelOptions,
+                       dtype=jnp.float32):
+    """All above-second-rate sampler values for ONE chain.
+
+    Returns dict of arrays:
+      cc     [n_hours+1]           hourly cloud cover (Markov chain states)
+      cloudy [n_hours+1]           cloudy-csi values (frozen pair if compat)
+      clear_day [n_hours+n_days+1] clear-sky-day values (advances hour+day)
+      ws     [n_days+1]            daily windspeed
+    """
+    k_cc, k_cloudy, k_day, k_ws = jax.random.split(key, 4)
+
+    if options.persistent_cloud_chain:
+        cc = markov_hourly.chain(k_cc, feats.n_hours + 1, dtype=dtype)
+    else:
+        cc = markov_hourly.iid_from_one(k_cc, feats.n_hours + 1, dtype=dtype)
+
+    # cloudy-csi: value k>=2 is drawn at hour rollover k-1, where
+    # hour_fraction == 0, so it sees cc == cc[k-1]; the two primer values see
+    # the construction-time interpolation lerp(cc[0], cc[1], f0_hour).
+    cc0 = cc[0] * (1 - feats.f0_hour) + cc[1] * feats.f0_hour
+    n_cloudy = feats.n_hours + 1
+    idx = jnp.arange(n_cloudy)
+    cc_at_draw = jnp.where(idx < 2, cc0, cc[jnp.maximum(idx - 1, 0)])
+    keys = jax.vmap(lambda i: jax.random.fold_in(k_cloudy, i))(idx)
+    cloudy = jax.vmap(lambda k, c: _cloudy_csi_draw(k, c, dtype))(keys, cc_at_draw)
+    # (reference-compat frozen pair is handled at gather time in
+    # csi_scan_block: the pair index is pinned to 0 so (cloudy[0], cloudy[1])
+    # interpolate forever, exactly like a sampler that never advances)
+
+    n_cd = feats.n_hours + feats.n_days + 1
+    clear_day = dist.normal(
+        k_day, CSI_CLEAR_DAY_LOC, CSI_CLEAR_DAY_SCALE, (n_cd,), dtype
+    )
+    ws = dist.windspeed(k_ws, (feats.n_days + 1,), dtype)
+    return {"cc": cc, "cloudy": cloudy, "clear_day": clear_day, "ws": ws}
+
+
+def minute_noise_values(key, cc, spec: TimeGridSpec, lo: int, hi: int,
+                        dtype=jnp.float32):
+    """Minute-noise sampler values with indices [lo, hi) for one chain.
+
+    Index-keyed draws: value i uses fold_in(key, i), so any block of the run
+    can regenerate its minute values without history.  sigma depends on the
+    hourly cloud cover interpolated at the value's draw instant
+    (clearskyindexmodel.py:86-95): sigma = sqrt(0.9)*(s0 + s1*8*cc).
+    """
+    h_idx, h_frac = spec.minute_value_features(lo, hi)
+    h_idx = jnp.asarray(h_idx)
+    h_frac = jnp.asarray(h_frac, dtype=dtype)
+    cc_at = cc[h_idx] * (1 - h_frac) + cc[h_idx + 1] * h_frac
+
+    i = jnp.arange(lo, hi)
+    keys = jax.vmap(lambda j: jax.random.fold_in(key, j))(i)
+    k_cloudy = jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys)
+    k_clear = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+
+    def draw(kz, s0, s1):
+        sigma = SIGMA_MIN_FACTOR * (s0 + s1 * 8.0 * cc_at)
+        z = jax.vmap(lambda k: jax.random.normal(k, (), dtype))(kz)
+        return 1.0 + sigma * z
+
+    return {
+        "noise_min_cloudy": draw(k_cloudy, *NOISE_CLOUDY),
+        "noise_min_clear": draw(k_clear, *NOISE_CLEAR),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Per-second scan over one time block (single chain; vmap over chains)
+# ---------------------------------------------------------------------------
+
+
+def init_renewal(key, arrays, dtype=jnp.float32):
+    """Initial renewal carry, matching the reference's construction: the
+    binary process starts from interpolate(0) == the *before* values of the
+    cloud-cover and windspeed samplers (clearskyindexmodel.py:98-99)."""
+    return renewal.init(key, arrays["cc"][0], arrays["ws"][0], dtype)
+
+
+def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
+                   options: ModelOptions, dtype=jnp.float32):
+    """Scan the seconds of one block for one chain.
+
+    Parameters
+    ----------
+    key : per-chain scan key; draw t uses fold_in(key, global second index)
+    arrays : per-chain sampler arrays (build_chain_arrays)
+    minute_vals : per-chain minute-noise values covering the block
+    minute_lo : global index of minute_vals[0] (for gather rebasing)
+    carry : renewal carry (init_renewal or previous block's)
+    block_idx : dict of shared int32/float arrays over the block's seconds:
+        t (global second), hour_idx, day_idx, min_idx, hour_frac, day_frac,
+        min_frac
+    Returns (carry', csi[T], covered[T]).
+    """
+    cc, cloudy, clear_day, ws = (
+        arrays["cc"], arrays["cloudy"], arrays["clear_day"], arrays["ws"],
+    )
+    mc = minute_vals["noise_min_cloudy"]
+    ml = minute_vals["noise_min_clear"]
+
+    def body(c, x):
+        t, h, d, m, hf, df, mf, cd = (
+            x["t"], x["hour_idx"], x["day_idx"], x["min_idx"],
+            x["hour_frac"], x["day_frac"], x["min_frac"], x["cd_idx"],
+        )
+        kt = jax.random.fold_in(key, t)
+        k_renew, k_sec = jax.random.split(kt)
+
+        cc_t = cc[h] * (1 - hf) + cc[h + 1] * hf
+        ws_t = ws[d] * (1 - df) + ws[d + 1] * df
+
+        c2, covered = renewal.step(c, k_renew, cc_t, ws_t, dtype)
+
+        # second-scale noise: both branches use the *clear* sigmas
+        # (clearskyindexmodel.py:146-147,152,158)
+        s0, s1 = NOISE_CLEAR
+        sigma_sec = SIGMA_SEC_FACTOR * (s0 + s1 * 8.0 * cc_t)
+        noise_sec = sigma_sec * jax.random.normal(k_sec, (), dtype)
+
+        base_clear = clear_day[cd] * (1 - df) + clear_day[cd + 1] * df
+        # reference-compat: the cloudy sampler never advances, so its pair
+        # index stays 0 (clearskyindexmodel.py:101-111 advances every sampler
+        # except this one)
+        h_c = h if options.advance_cloudy_hour else jnp.zeros_like(h)
+        base_cloudy = cloudy[h_c] * (1 - hf) + cloudy[h_c + 1] * hf
+        mrel = m - minute_lo
+        nmin_clear = ml[mrel] * (1 - mf) + ml[mrel + 1] * mf
+        nmin_cloudy = mc[mrel] * (1 - mf) + mc[mrel + 1] * mf
+
+        is_cov = covered > 0.5
+        use_clear = is_cov if not options.swap_covered_branches else ~is_cov
+        base = jnp.where(use_clear, base_clear, base_cloudy)
+        nmin = jnp.where(use_clear, nmin_clear, nmin_cloudy)
+        return c2, (base * (nmin + noise_sec), covered)
+
+    xs = dict(block_idx)
+    xs["cd_idx"] = block_idx["hour_idx"] + block_idx["day_idx"]
+    carry, (csi, covered) = jax.lax.scan(body, carry, xs)
+    return carry, csi, covered
+
+
+def host_block_index(spec: TimeGridSpec, offset: int, length: int,
+                     dtype=jnp.float32):
+    """Shared (chain-independent) scan inputs for one block, as device arrays."""
+    blk = spec.block(offset, length)
+    return {
+        "t": jnp.asarray(blk.offset + np.arange(len(blk.epoch)), dtype=jnp.int32),
+        "hour_idx": jnp.asarray(blk.hour_idx, dtype=jnp.int32),
+        "day_idx": jnp.asarray(blk.day_idx, dtype=jnp.int32),
+        "min_idx": jnp.asarray(blk.min_idx, dtype=jnp.int32),
+        "hour_frac": jnp.asarray(blk.hour_fraction, dtype=dtype),
+        "day_frac": jnp.asarray(blk.day_fraction, dtype=dtype),
+        "min_frac": jnp.asarray(blk.min_fraction, dtype=dtype),
+    }, (int(blk.min_idx[0]), int(blk.min_idx[-1]) + 2)

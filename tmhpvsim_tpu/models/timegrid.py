@@ -177,15 +177,45 @@ class TimeGridSpec:
         base = np.searchsorted(self.midnight_epochs, self.start_epoch, "right")
         return int(np.searchsorted(self.midnight_epochs, last, "right") - base) + 1
 
+    # ---- hour features at arbitrary epochs -----------------------------
+    def _hour_features(self, epoch: np.ndarray):
+        """(hour_idx, hour_fraction) at given epochs — shared by block() and
+        minute_value_features()."""
+        off = self.tz_offsets[np.searchsorted(self.tz_breaks, epoch, "right") - 1]
+        local = epoch + off
+        rel = epoch - self.start_epoch
+        n_back = np.searchsorted(self.backward_transitions, epoch, "right") \
+            - np.searchsorted(self.backward_transitions, self.start_epoch, "right")
+        hour_idx = (rel + self.hour_phase) // 3600 - n_back
+        return local, hour_idx, (local % 3600) / 3600.0
+
+    def minute_value_features(self, lo: int, hi: int):
+        """Hour-interpolation features at the *draw instants* of minute-sampler
+        values with indices in [lo, hi).
+
+        Value i of a minute-rate InterpolatedSampler is drawn at the (i-1)-th
+        minute rollover for i >= 2; values 0 and 1 are primed at the grid
+        start (clearskyindexmodel.py:29-32,90-95).  The minute-noise draw
+        reads the hourly cloud cover interpolated at its draw instant
+        (clearskyindexmodel.py:86-88), so each value needs (hour pair index,
+        hour fraction) at that instant.
+
+        Returns (hour_idx[int64], hour_fraction[float64]) of length hi-lo.
+        """
+        i = np.arange(lo, hi, dtype=np.int64)
+        j = np.maximum(i - 1, 1)
+        rel = np.where(i >= 2, 60 * j - self.min_phase, 0)
+        epoch = self.start_epoch + rel
+        _, hour_idx, hour_frac = self._hour_features(epoch)
+        return hour_idx, hour_frac
+
     # ---- blockwise feature materialisation -----------------------------
     def block(self, offset: int, length: int) -> TimeBlock:
         length = min(length, self.duration_s - offset)
         epoch = self.start_epoch + offset + np.arange(length, dtype=np.int64)
-        off = self.tz_offsets[np.searchsorted(self.tz_breaks, epoch, "right") - 1]
-        local = epoch + off
+        local, hour_idx, hour_fraction = self._hour_features(epoch)
 
         min_fraction = (local % 60) / 60.0
-        hour_fraction = (local % 3600) / 3600.0
         day_fraction = (local % 86400) / 86400.0
 
         rel = epoch - self.start_epoch
@@ -197,10 +227,6 @@ class TimeGridSpec:
         hour_boundary = (rel + self.hour_phase) % 3600 == 0
         is_backward = np.isin(epoch, self.backward_transitions)
         new_hour = hour_boundary & ~is_backward & t_pos
-        # raw hour count, corrected for backward DST hours (field repeats)
-        n_back = np.searchsorted(self.backward_transitions, epoch, "right") \
-            - np.searchsorted(self.backward_transitions, self.start_epoch, "right")
-        hour_idx = (rel + self.hour_phase) // 3600 - n_back
 
         base = np.searchsorted(self.midnight_epochs, self.start_epoch, "right")
         day_pos = np.searchsorted(self.midnight_epochs, epoch, "right")
