@@ -19,6 +19,20 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The environment's sitecustomize (PYTHONPATH=/root/.axon_site) imports jax
+# during interpreter startup — *before* this conftest runs — so the env-var
+# override above comes too late for the platform choice jax captured at
+# import.  Backends initialise lazily, so updating the config here still
+# redirects everything to CPU; assert no device backend has been created yet
+# (if it has, tests would silently run on the remote chip).
+jax.config.update("jax_platforms", "cpu")
+if jax._src.xla_bridge.backends_are_initialized():
+    # Too late to redirect — only acceptable if the chosen backend is
+    # already CPU (the hazard is the remote 'axon' chip, not CPU itself).
+    assert jax.default_backend() == "cpu", (
+        "a non-CPU JAX backend initialised before conftest could force CPU"
+    )
+
 jax.config.update("jax_enable_x64", True)  # float64 golden paths on CPU
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,146 @@
+"""PV electrical chain tests: SAPM + Sandia inverter + full csi->AC chain."""
+
+import datetime as dt
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import Site
+from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
+from tmhpvsim_tpu.models import pv, solar
+
+
+def epoch(*args):
+    return dt.datetime(*args, tzinfo=dt.timezone.utc).timestamp()
+
+
+def day_geometry(date_args=(2025, 6, 21), step=60.0, xp=np):
+    site = Site()
+    t0 = epoch(*date_args)
+    t = t0 + np.arange(0, 86400, step)
+    doy = np.full(t.shape, dt.date(*date_args[:3]).timetuple().tm_yday,
+                  dtype=np.float64)
+    return solar.block_geometry(xp.asarray(t), xp.asarray(doy), site, xp=xp)
+
+
+class TestSAPM:
+    def test_reference_conditions(self):
+        # At 1 sun effective irradiance and 25 C cell temperature the module
+        # must reproduce its nameplate max-power point.
+        dc = pv.sapm_dc(np.array([1.0]), np.array([25.0]), SAPM_MODULE, xp=np)
+        assert dc["v_mp"][0] == pytest.approx(SAPM_MODULE["Vmpo"], rel=1e-6)
+        imp_ref = SAPM_MODULE["Impo"] * (SAPM_MODULE["C0"] + SAPM_MODULE["C1"])
+        assert dc["i_mp"][0] == pytest.approx(imp_ref, rel=1e-6)
+        assert 245 < dc["p_mp"][0] < 255
+
+    def test_temperature_derating(self):
+        hot = pv.sapm_dc(np.array([1.0]), np.array([60.0]), SAPM_MODULE, xp=np)
+        cold = pv.sapm_dc(np.array([1.0]), np.array([10.0]), SAPM_MODULE, xp=np)
+        assert hot["p_mp"][0] < cold["p_mp"][0]
+
+    def test_zero_irradiance_is_zero_not_nan(self):
+        dc = pv.sapm_dc(np.array([0.0]), np.array([20.0]), SAPM_MODULE, xp=np)
+        assert dc["p_mp"][0] == 0.0
+        assert np.isfinite(dc["v_mp"][0])
+
+    def test_cell_temp_noct_scale(self):
+        # Open-rack at 800 W/m^2, 20 C ambient, no wind: cell temp in the
+        # NOCT neighbourhood (42-50 C).
+        tc = pv.sapm_cell_temp(np.array([800.0]), SAPM_MODULE, xp=np)
+        assert 40 < tc[0] < 52
+
+    def test_effective_irradiance_normal_incidence(self):
+        # Beam-normal 1000 W/m^2, airmass 1.5, no diffuse: Ee ~ F1(1.5) suns.
+        ee = pv.sapm_effective_irradiance(
+            np.array([1000.0]), np.array([0.0]), np.array([1.5]),
+            np.array([1.0]), SAPM_MODULE, xp=np,
+        )
+        f1 = (SAPM_MODULE["A0"] + SAPM_MODULE["A1"] * 1.5
+              + SAPM_MODULE["A2"] * 1.5**2 + SAPM_MODULE["A3"] * 1.5**3
+              + SAPM_MODULE["A4"] * 1.5**4)
+        assert ee[0] == pytest.approx(f1, rel=1e-6)
+
+
+class TestInverter:
+    def test_rated_point(self):
+        ac = pv.sandia_inverter_ac(
+            np.array([SANDIA_INVERTER["Vdco"]]),
+            np.array([SANDIA_INVERTER["Pdco"]]),
+            SANDIA_INVERTER, xp=np,
+        )
+        assert ac[0] == pytest.approx(SANDIA_INVERTER["Paco"], rel=1e-6)
+
+    def test_clipping_at_paco(self):
+        ac = pv.sandia_inverter_ac(
+            np.array([40.0]), np.array([400.0]), SANDIA_INVERTER, xp=np
+        )
+        assert ac[0] <= SANDIA_INVERTER["Paco"] + 1e-9
+
+    def test_night_tare(self):
+        ac = pv.sandia_inverter_ac(
+            np.array([0.0]), np.array([0.0]), SANDIA_INVERTER, xp=np
+        )
+        assert ac[0] == pytest.approx(-SANDIA_INVERTER["Pnt"])
+
+    def test_monotone_in_pdc(self):
+        pdc = np.linspace(5.0, 250.0, 50)
+        ac = pv.sandia_inverter_ac(np.full_like(pdc, 38.0), pdc,
+                                   SANDIA_INVERTER, xp=np)
+        assert np.all(np.diff(ac) > 0)
+
+
+class TestFullChain:
+    def test_clear_day_profile(self):
+        # csi = 1 over a summer day: zero at night, peak 150-260 W around
+        # noon for the 250 W system, everything finite and >= 0 — the
+        # reference invariant (tests/test_pvmodel.py in the reference).
+        geom = day_geometry()
+        csi = np.ones_like(geom["ghi_clear"])
+        ac = pv.power_from_csi(csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=np)
+        assert np.all(np.isfinite(ac))
+        assert np.all(ac >= 0)
+        assert 150 < ac.max() < 260
+        night = geom["cos_zenith"] < -0.1
+        assert np.all(ac[night] == 0)
+
+    def test_cloud_reduces_power(self):
+        geom = day_geometry()
+        i = int(np.argmax(geom["ghi_clear"]))
+        sl = {
+            k: (v[i : i + 1] if isinstance(v, np.ndarray) else v)
+            for k, v in geom.items()
+        }
+        clear = pv.power_from_csi(np.array([1.0]), sl, SAPM_MODULE,
+                                  SANDIA_INVERTER, xp=np)
+        cloudy = pv.power_from_csi(np.array([0.3]), sl, SAPM_MODULE,
+                                   SANDIA_INVERTER, xp=np)
+        assert cloudy[0] < 0.6 * clear[0]
+        assert cloudy[0] > 0
+
+    def test_batched_csi_broadcasts(self):
+        geom = day_geometry(step=600.0)
+        n_t = geom["ghi_clear"].shape[0]
+        csi = np.linspace(0.2, 1.2, 8)[:, None] * np.ones((1, n_t))
+        ac = pv.power_from_csi(csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=np)
+        assert ac.shape == (8, n_t)
+
+    def test_jit_float32_close_to_numpy64(self):
+        geom64 = day_geometry(step=300.0)
+        geom32 = {
+            k: (jnp.asarray(v, dtype=jnp.float32)
+                if isinstance(v, np.ndarray) else v)
+            for k, v in geom64.items()
+        }
+        csi = np.full(geom64["ghi_clear"].shape, 0.8)
+        ref = pv.power_from_csi(csi, geom64, SAPM_MODULE, SANDIA_INVERTER,
+                                xp=np)
+
+        f = jax.jit(
+            lambda c, g: pv.power_from_csi(c, g, SAPM_MODULE,
+                                           SANDIA_INVERTER, xp=jnp)
+        )
+        got = np.asarray(f(jnp.asarray(csi, dtype=jnp.float32), geom32))
+        # float32 end-to-end: absolute watt-level agreement on a 250 W system
+        np.testing.assert_allclose(got, ref, atol=0.5)

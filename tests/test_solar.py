@@ -1,0 +1,174 @@
+"""Solar geometry & irradiance model tests.
+
+Ground truth strategy (pvlib is deliberately not a dependency, SURVEY.md §7
+step 6): astronomical invariants with known tolerances — solstice/equinox
+declination via the noon zenith, equation-of-time bounds, hemispheric
+symmetry — plus numpy-float64 vs jitted-float32 cross-checks of the exact
+same formulas.
+"""
+
+import datetime as dt
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import Site
+from tmhpvsim_tpu.models import solar
+
+DEG = np.pi / 180.0
+
+
+def epoch(*args):
+    return dt.datetime(*args, tzinfo=dt.timezone.utc).timestamp()
+
+
+def noon_zenith_deg(date_args, lat, lon):
+    """Minimum zenith over the UTC day, scanned at 30 s resolution."""
+    t0 = epoch(*date_args)
+    t = t0 + np.arange(0, 86400, 30.0)
+    pos = solar.sun_position(t, lat, lon, xp=np)
+    return np.degrees(pos["zenith"].min())
+
+
+class TestSunPosition:
+    def test_solstice_declination_june(self):
+        # Munich local solar noon, 2025-06-21: zenith = lat - dec(23.44)
+        z = noon_zenith_deg((2025, 6, 21), 48.12, 11.60)
+        assert z == pytest.approx(48.12 - 23.44, abs=0.3)
+
+    def test_solstice_declination_december(self):
+        z = noon_zenith_deg((2025, 12, 21), 48.12, 11.60)
+        assert z == pytest.approx(48.12 + 23.44, abs=0.3)
+
+    def test_equinox_declination(self):
+        # 2025-03-20 equinox: noon zenith ~= latitude
+        z = noon_zenith_deg((2025, 3, 20), 48.12, 11.60)
+        assert z == pytest.approx(48.12, abs=0.3)
+
+    def test_equation_of_time_bounds(self):
+        # At lon=0 the daily zenith minimum must occur within +-17 min of
+        # 12:00 UTC, any day of the year (equation of time envelope).
+        for month, day in [(2, 11), (5, 14), (7, 26), (11, 3)]:
+            t0 = epoch(2025, month, day)
+            t = t0 + np.arange(0, 86400, 30.0)
+            pos = solar.sun_position(t, 20.0, 0.0, xp=np)
+            t_noon = t[np.argmin(pos["zenith"])]
+            offset_min = (t_noon - (t0 + 12 * 3600)) / 60.0
+            assert abs(offset_min) < 17.5, (month, day, offset_min)
+
+    def test_azimuth_convention(self):
+        # Northern mid-latitudes: sun rises east (az ~90), noon south
+        # (az ~180), sets west (az ~270) — pvlib's degrees-east-of-north.
+        t0 = epoch(2025, 6, 21)
+        t = t0 + np.arange(0, 86400, 30.0)
+        pos = solar.sun_position(t, 48.12, 11.60, xp=np)
+        az_deg = np.degrees(pos["azimuth"])
+        i_noon = np.argmin(pos["zenith"])
+        assert az_deg[i_noon] == pytest.approx(180.0, abs=1.0)
+        day = pos["cos_zenith"] > 0
+        rise, set_ = np.nonzero(day)[0][[0, -1]]
+        assert 30 < az_deg[rise] < 90
+        assert 270 < az_deg[set_] < 330
+
+    def test_night_below_horizon(self):
+        pos = solar.sun_position(epoch(2025, 6, 21, 0, 0), 48.12, 11.60, xp=np)
+        assert pos["cos_zenith"] < 0
+
+    def test_float32_jax_matches_numpy64(self):
+        t = epoch(2025, 8, 1) + np.arange(0, 86400, 997.0)
+        ref = solar.sun_position(t, 48.12, 11.60, xp=np)
+        got = solar.sun_position(
+            jnp.asarray(t, dtype=jnp.float64), 48.12, 11.60, xp=jnp
+        )
+        np.testing.assert_allclose(got["zenith"], ref["zenith"], atol=1e-9)
+
+    def test_refraction_lifts_horizon_sun(self):
+        # ~0.5 deg of refraction at the horizon, ~0 overhead.
+        z_true = np.array([90.0, 30.0]) * DEG
+        e_app = solar.apparent_elevation(z_true, xp=np)
+        lift_deg = np.degrees(e_app) - (90.0 - np.degrees(z_true))
+        assert 0.4 < lift_deg[0] < 0.6
+        assert lift_deg[1] < 0.05
+
+
+class TestIrradiance:
+    def geom(self, date_args=(2025, 6, 21), step=60.0):
+        site = Site()
+        t0 = epoch(*date_args)
+        t = t0 + np.arange(0, 86400, step)
+        doy = np.full(t.shape, dt.date(*date_args[:3]).timetuple().tm_yday,
+                      dtype=np.float64)
+        return solar.block_geometry(t, doy, site, xp=np)
+
+    def test_clearsky_summer_magnitude(self):
+        g = self.geom()
+        assert 800 < g["ghi_clear"].max() < 1000  # Munich summer noon
+        night = g["cos_zenith"] < -0.1
+        assert np.all(g["ghi_clear"][night] == 0)
+
+    def test_clearsky_winter_magnitude(self):
+        g = self.geom((2025, 12, 21))
+        assert 150 < g["ghi_clear"].max() < 450
+
+    def test_airmass_range(self):
+        g = self.geom()
+        day = g["cos_zenith"] > 0.05
+        am = g["airmass_abs"][day]
+        assert np.all(am >= 0.99)
+        assert am.min() == pytest.approx(
+            1.0 / g["cos_zenith"].max() * solar.alt2pres(34.0)
+            / solar.STD_PRESSURE,
+            rel=0.01,
+        )
+
+    def test_disc_clear_sky_split(self):
+        # Under clear sky (csi=1), DISC should attribute most horizontal
+        # irradiance to beam at noon: DNI in (600, 1100) W/m^2.
+        g = self.geom()
+        dni = solar.disc_dni(g["ghi_clear"], g["zenith"], g["doy"], xp=np)
+        i = np.argmax(g["ghi_clear"])
+        assert 600 < dni[i] < 1100
+        assert np.all(dni >= 0)
+        assert np.all(dni[g["ghi_clear"] == 0] == 0)
+
+    def test_disc_zero_for_low_kt(self):
+        dni = solar.disc_dni(
+            np.array([5.0]), np.array([30 * DEG]), np.array([172.0]), xp=np
+        )
+        assert dni[0] < 10.0
+
+    def test_csi_cap_shape(self):
+        # Overhead sun: cap close to 1.08; near horizon: large.
+        cap = solar.csi_zenith_cap(np.array([0.0, 85 * DEG]), xp=np)
+        assert cap[0] == pytest.approx(1.08, abs=0.02)
+        assert cap[1] > 2.0
+
+    def test_linke_turbidity_interpolation(self):
+        monthly = Site().linke_turbidity_monthly
+        tl = solar.linke_turbidity(np.arange(1.0, 366.0), monthly, xp=np)
+        assert tl.min() >= min(monthly) - 1e-9
+        assert tl.max() <= max(monthly) + 1e-9
+        # mid-January equals the January anchor
+        assert tl[14] == pytest.approx(monthly[0], abs=1e-9)
+
+    def test_haydavies_tilt_gain(self):
+        # A south-tilted plane at 48N should beat the horizontal in POA
+        # at winter noon (low sun).
+        g = self.geom((2025, 12, 21))
+        dni = solar.disc_dni(g["ghi_clear"], g["zenith"], g["doy"], xp=np)
+        dhi = np.maximum(g["ghi_clear"] - dni * g["cos_zenith"], 0.0)
+        poa = solar.haydavies_poa(
+            48.12, g["cos_aoi"], g["apparent_zenith"], g["ghi_clear"],
+            dni, dhi, g["dni_extra"], xp=np,
+        )
+        i = np.argmax(g["ghi_clear"])
+        assert poa["poa_global"][i] > 1.5 * g["ghi_clear"][i]
+        assert np.all(poa["poa_global"] >= 0)
+
+    def test_extra_radiation_annual_cycle(self):
+        ext = solar.extra_radiation_spencer(np.arange(1.0, 366.0), xp=np)
+        # perihelion (early Jan) ~1412, aphelion (early Jul) ~1321
+        assert np.argmax(ext) < 20 or np.argmax(ext) > 350
+        assert 150 < np.argmin(ext) < 210
+        assert ext.max() / ext.min() == pytest.approx(1.069, abs=0.01)

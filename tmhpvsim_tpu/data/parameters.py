@@ -95,10 +95,10 @@ SAPM_MODULE = {
     "B0": 1.0, "B1": -0.002438, "B2": 0.0003103,
     "B3": -1.246e-05, "B4": 2.112e-07, "B5": -1.359e-09,
     "FD": 1.0,          # diffuse utilisation fraction
-    # SAPM thermal model, open-rack glass/cell/polymer-back mount
-    # (pvlib sapm_celltemp defaults used at pvmodel.py:69-70)
-    "T_a": -3.56,       # irradiance coefficient a
-    "T_b": -0.075,      # wind coefficient b
+    # SAPM thermal model, open-rack cell/glassback mount (the
+    # sapm_celltemp default model the reference uses at pvmodel.py:69-70)
+    "T_a": -3.47,       # irradiance coefficient a
+    "T_b": -0.0594,     # wind coefficient b
     "T_deltaT": 3.0,    # cell-vs-module back temperature delta [C]
 }
 

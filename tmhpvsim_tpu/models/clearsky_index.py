@@ -154,17 +154,30 @@ def minute_noise_values(key, cc, spec: TimeGridSpec, lo: int, hi: int,
                         dtype=jnp.float32):
     """Minute-noise sampler values with indices [lo, hi) for one chain.
 
+    Host-convenience wrapper over :func:`minute_noise_values_device`.
+    """
+    h_idx, h_frac = spec.minute_value_features(lo, hi)
+    feats = (jnp.asarray(h_idx), jnp.asarray(h_frac, dtype=dtype))
+    return minute_noise_values_device(key, cc, lo, feats, dtype)
+
+
+def minute_noise_values_device(key, cc, lo, feats, dtype=jnp.float32):
+    """Device-side minute-noise values; jit-safe (``lo`` may be traced).
+
     Index-keyed draws: value i uses fold_in(key, i), so any block of the run
     can regenerate its minute values without history.  sigma depends on the
     hourly cloud cover interpolated at the value's draw instant
     (clearskyindexmodel.py:86-95): sigma = sqrt(0.9)*(s0 + s1*8*cc).
+
+    ``feats`` is the (hour_idx, hour_frac) pair from
+    ``TimeGridSpec.minute_value_features(lo, hi)`` — host-precomputed, its
+    static length fixes hi - lo.
     """
-    h_idx, h_frac = spec.minute_value_features(lo, hi)
-    h_idx = jnp.asarray(h_idx)
-    h_frac = jnp.asarray(h_frac, dtype=dtype)
+    h_idx, h_frac = feats
+    h_frac = h_frac.astype(dtype)
     cc_at = cc[h_idx] * (1 - h_frac) + cc[h_idx + 1] * h_frac
 
-    i = jnp.arange(lo, hi)
+    i = lo + jnp.arange(h_idx.shape[0])
     keys = jax.vmap(lambda j: jax.random.fold_in(key, j))(i)
     k_cloudy = jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys)
     k_clear = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)

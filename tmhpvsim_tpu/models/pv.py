@@ -1,0 +1,159 @@
+"""PV electrical chain: POA irradiance -> cell temperature -> DC -> AC.
+
+Re-derivation of the reference's pvlib call sequence (pvmodel.py:69-80) from
+the primary models, as flat array math:
+
+* SAPM cell temperature (King et al. 2004 eq. 11-12), the
+  ``sapm_celltemp`` default mount, evaluated at the reference's fixed
+  ambient conditions wind = 0 m/s, T_amb = 20 C (pvmodel.py:69-70);
+* SAPM effective irradiance (King et al. 2004 eq. 7, in "suns");
+* SAPM I-V points Imp/Vmp -> DC power (King et al. 2004 eq. 2-5);
+* Sandia grid-inverter model (King et al. 2007) for AC power;
+* final ``clip(lower=0).fillna(0)`` exactly as the reference's cache fill
+  (pvmodel.py:80) — night tare and NaN become 0 W.
+
+Functions take ``xp`` (numpy | jax.numpy) like models/solar.py, and read
+coefficients from plain dicts (data/parameters.py vendored tables), so they
+jit cleanly with coefficients baked in as constants.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+DEG = np.pi / 180.0
+BOLTZMANN = 1.380649e-23  # J/K
+ELEM_CHARGE = 1.602176634e-19  # C
+T0_C = 25.0  # SAPM reference cell temperature
+
+
+def sapm_cell_temp(poa_global, module, wind_speed=0.0, temp_air_c=20.0,
+                   xp=jnp):
+    """SAPM back-of-module + cell temperature [C].
+
+        T_mod  = POA * exp(a + b*wind) + T_amb
+        T_cell = T_mod + POA/1000 * deltaT
+    """
+    t_mod = poa_global * xp.exp(module["T_a"] + module["T_b"] * wind_speed) \
+        + temp_air_c
+    return t_mod + poa_global / 1000.0 * module["T_deltaT"]
+
+
+def sapm_effective_irradiance(poa_direct, poa_diffuse, airmass_abs, cos_aoi,
+                              module, xp=jnp):
+    """SAPM effective irradiance in suns (reference irradiance 1000 W/m^2).
+
+        F1(AMa) = A0 + A1*AMa + ... + A4*AMa^4     (spectral modifier)
+        F2(AOI) = B0 + B1*AOI + ... + B5*AOI^5     (AOI in degrees)
+        Ee = F1 * (Eb * F2 + FD * Ed) / 1000
+    """
+    ama = airmass_abs
+    f1 = (
+        module["A0"]
+        + module["A1"] * ama
+        + module["A2"] * ama**2
+        + module["A3"] * ama**3
+        + module["A4"] * ama**4
+    )
+    aoi_deg = xp.arccos(xp.clip(cos_aoi, -1.0, 1.0)) / DEG
+    f2 = (
+        module["B0"]
+        + module["B1"] * aoi_deg
+        + module["B2"] * aoi_deg**2
+        + module["B3"] * aoi_deg**3
+        + module["B4"] * aoi_deg**4
+        + module["B5"] * aoi_deg**5
+    )
+    f2 = xp.maximum(f2, 0.0)
+    ee = f1 * (poa_direct * f2 + module["FD"] * poa_diffuse) / 1000.0
+    return xp.maximum(ee, 0.0)
+
+
+def sapm_dc(effective_irradiance, temp_cell_c, module, xp=jnp):
+    """SAPM max-power point: returns dict(i_mp, v_mp, p_mp).
+
+    King et al. 2004 eq. 3-5 with the thermal-voltage log terms; Ee in suns.
+    Zero-irradiance steps produce v_mp = i_mp = 0 (the log is masked, not
+    NaN'd — reference reaches the same end state via fillna(0) at
+    pvmodel.py:80).
+    """
+    ee = effective_irradiance
+    dt = temp_cell_c - T0_C
+    ns = module["Cells_in_Series"]
+
+    # Thermal voltage per cell times diode factor.
+    delta = module["N"] * BOLTZMANN * (temp_cell_c + 273.15) / ELEM_CHARGE
+
+    pos = ee > 0.0
+    log_ee = xp.log(xp.where(pos, ee, 1.0))
+
+    i_mp = (
+        module["Impo"]
+        * (module["C0"] * ee + module["C1"] * ee**2)
+        * (1.0 + module["Aimp"] * dt)
+    )
+    bvmp = module["Bvmpo"] + module["Mbvmp"] * (1.0 - ee)
+    v_mp = (
+        module["Vmpo"]
+        + module["C2"] * ns * delta * log_ee
+        + module["C3"] * ns * (delta * log_ee) ** 2
+        + bvmp * dt
+    )
+    i_mp = xp.where(pos, xp.maximum(i_mp, 0.0), 0.0)
+    v_mp = xp.where(pos, xp.maximum(v_mp, 0.0), 0.0)
+    return {"i_mp": i_mp, "v_mp": v_mp, "p_mp": i_mp * v_mp}
+
+
+def sandia_inverter_ac(v_dc, p_dc, inverter, xp=jnp):
+    """Sandia grid-connected inverter model: AC power [W].
+
+    King et al. 2007 performance-model quadratic with voltage-dependent
+    coefficients; output saturates at Paco, and below the start-up power the
+    inverter draws the night tare (-Pnt), matching the reference's
+    ``snlinverter`` call at pvmodel.py:78.
+    """
+    paco = inverter["Paco"]
+    dv = v_dc - inverter["Vdco"]
+    a = inverter["Pdco"] * (1.0 + inverter["C1"] * dv)
+    b = inverter["Pso"] * (1.0 + inverter["C2"] * dv)
+    c = inverter["C0"] * (1.0 + inverter["C3"] * dv)
+
+    a_b = xp.where(xp.abs(a - b) > 1e-12, a - b, 1e-12)
+    pd = p_dc - b
+    ac = (paco / a_b - c * a_b) * pd + c * pd * pd
+    ac = xp.minimum(ac, paco)
+    return xp.where(p_dc < inverter["Pso"], -xp.abs(inverter["Pnt"]), ac)
+
+
+def power_from_csi(csi, geom, module, inverter, xp=jnp):
+    """Clear-sky index -> AC watts, given precomputed block geometry.
+
+    The chain-dependent half of the reference's ``populate_cache``
+    (pvmodel.py:52-80): every input except ``csi`` comes from
+    ``solar.block_geometry`` and is shared across chains; ``csi`` may carry
+    leading batch dimensions, all geometry arrays broadcast against it.
+
+    Steps: zenith-cap clip of csi -> GHI = csi*GHI_clear -> DISC DNI ->
+    DHI closure -> Hay-Davies POA -> SAPM temp/Ee/DC -> Sandia AC ->
+    clip(>=0) & NaN->0.
+    """
+    from tmhpvsim_tpu.models import solar
+
+    csi = xp.minimum(csi, geom["csi_cap"])
+    ghi = csi * geom["ghi_clear"]
+    dni = solar.disc_dni(ghi, geom["zenith"], geom["doy"], xp=xp)
+    dhi = xp.maximum(ghi - dni * geom["cos_zenith"], 0.0)
+
+    poa = solar.haydavies_poa(
+        geom["surface_tilt"], geom["cos_aoi"], geom["apparent_zenith"],
+        ghi, dni, dhi, geom["dni_extra"], albedo=geom["albedo"], xp=xp,
+    )
+    t_cell = sapm_cell_temp(poa["poa_global"], module, xp=xp)
+    ee = sapm_effective_irradiance(
+        poa["poa_direct"], poa["poa_diffuse"], geom["airmass_abs"],
+        geom["cos_aoi"], module, xp=xp,
+    )
+    dc = sapm_dc(ee, t_cell, module, xp=xp)
+    ac = sandia_inverter_ac(dc["v_mp"], dc["p_mp"], inverter, xp=xp)
+    return xp.maximum(ac, 0.0)
