@@ -1,0 +1,68 @@
+"""Benchmark: simulated site-seconds per wall second per chip.
+
+Runs the JAX-backend block loop (per-second stochastic csi scan + PV
+physics + meter stream, device-side reduction) for a large chain batch on
+whatever accelerator is available, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference caps at ~100 simulated seconds/sec/process under
+``--no-realtime`` (the 10 ms sleep floor in fixedclock, utils.py:36;
+SURVEY.md §6) — vs_baseline is the speedup over that ceiling per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine import Simulation
+
+# Sized so one block's trace (chains x block_s) fits comfortably in HBM:
+# 8192 chains x 8640 s x 4 B x ~4 live arrays ~= 1.1 GB.
+N_CHAINS = 8192
+BLOCK_S = 8640
+N_BLOCKS = 5  # timed steady-state blocks
+
+
+def main() -> None:
+    cfg = SimConfig(
+        start="2019-09-05 00:00:00",
+        duration_s=BLOCK_S * (N_BLOCKS + 1),
+        n_chains=N_CHAINS,
+        seed=0,
+        block_s=BLOCK_S,
+        dtype="float32",
+    )
+    sim = Simulation(cfg)
+    state = sim.init_state()
+    sim.state = state
+
+    # Warm-up block: triggers compilation of init + block step.
+    inputs, _ = sim.host_inputs(0)
+    sim.state, stats = sim._block_reduced_jit(sim.state, inputs)
+    jax.block_until_ready(stats)
+
+    t0 = time.perf_counter()
+    for bi in range(1, N_BLOCKS + 1):
+        inputs, _ = sim.host_inputs(bi)
+        sim.state, stats = sim._block_reduced_jit(sim.state, inputs)
+    jax.block_until_ready(stats)
+    dt = time.perf_counter() - t0
+
+    site_seconds = N_CHAINS * BLOCK_S * N_BLOCKS
+    rate = site_seconds / dt
+    ref_ceiling = 100.0  # simulated s/s/process, reference --no-realtime
+    print(json.dumps({
+        "metric": "simulated site-seconds/sec/chip",
+        "value": round(rate, 1),
+        "unit": "site-s/s/chip",
+        "vs_baseline": round(rate / ref_ceiling, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Application-level tests: metersim + pvsim over the in-process broker,
+and the CLI surface (SURVEY.md §4: the reference has no app tests at all)."""
+
+import asyncio
+import csv
+import datetime as dt
+
+import pytest
+from click.testing import CliRunner
+
+from tmhpvsim_tpu.apps.metersim import metersim_main
+from tmhpvsim_tpu.apps.pvsim import pvsim_main
+from tmhpvsim_tpu.cli import main as cli_main
+
+
+def test_end_to_end_local_broker(tmp_path):
+    """Producer and consumer in one process over local:// fanout: the CSV
+    must contain joined rows with residual == meter - pv."""
+    out = tmp_path / "out.csv"
+    url = "local://e2e"
+    start = dt.datetime(2019, 9, 5, 12, 0, 0)
+    n = 30
+
+    async def both():
+        consumer = asyncio.create_task(
+            pvsim_main(str(out), url, "meter", realtime=False, seed=1,
+                       duration_s=None, start=start)
+        )
+        await asyncio.sleep(0.05)  # let the consumer bind before publishing
+        await metersim_main(url, "meter", realtime=False, seed=2,
+                            duration_s=n, start=start)
+        # give the join a moment to drain, then stop the consumer
+        await asyncio.sleep(0.3)
+        consumer.cancel()
+        try:
+            await consumer
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.new_event_loop().run_until_complete(both())
+
+    with open(out) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["time", "meter", "pv", "residual load"]
+    assert len(rows) > n // 2  # most rows joined
+    for time_s, meter, pv, residual in rows[1:]:
+        assert float(meter) - float(pv) == pytest.approx(float(residual))
+        assert 0 <= float(meter) < 9000
+        assert float(pv) >= 0
+        assert time_s.startswith("2019-09-05 12:")
+
+
+def test_cli_pvsim_jax_backend(tmp_path):
+    out = tmp_path / "jax.csv"
+    r = CliRunner().invoke(
+        cli_main,
+        ["pvsim", str(out), "--backend=jax", "--no-realtime",
+         "--duration", "180", "--seed", "5",
+         "--start", "2019-09-05 10:00:00"],
+    )
+    assert r.exit_code == 0, r.output
+    with open(out) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["time", "meter", "pv", "residual load"]
+    assert len(rows) == 1 + 180
+
+
+def test_cli_jax_requires_duration(tmp_path):
+    r = CliRunner().invoke(
+        cli_main, ["pvsim", str(tmp_path / "x.csv"), "--backend=jax"]
+    )
+    assert r.exit_code != 0
+    assert "--duration" in r.output
+
+
+def test_cli_metersim_bounded():
+    r = CliRunner().invoke(
+        cli_main,
+        ["metersim", "--no-realtime", "--duration", "5", "--seed", "0",
+         "--amqp-url", "local://cli-meter"],
+    )
+    assert r.exit_code == 0, r.output
+
+
+def test_cli_help_surfaces():
+    for args in (["--help"], ["metersim", "--help"], ["pvsim", "--help"]):
+        r = CliRunner().invoke(cli_main, args)
+        assert r.exit_code == 0
+    r = CliRunner().invoke(cli_main, ["pvsim", "--help"])
+    for flag in ("--amqp-url", "--exchange", "--realtime", "--backend",
+                 "--chains", "--duration"):
+        assert flag in r.output

@@ -1,0 +1,197 @@
+"""Runtime primitives: fixedclock grid, funnel join, retry policy, broker."""
+
+import asyncio
+import datetime as dt
+import math
+from collections import namedtuple
+
+import pytest
+
+from tmhpvsim_tpu.runtime import (
+    SynchronizingFunnel,
+    asyncretry,
+    fixedclock,
+    forever,
+)
+from tmhpvsim_tpu.runtime.broker import LocalTransport, make_transport
+
+Data = namedtuple("Data", ["meter", "pv"])
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestFixedclock:
+    def test_ideal_grid(self):
+        """Yields start + i/rate exactly — never wall time (utils.py:13-45)."""
+
+        async def collect():
+            start = dt.datetime(2019, 9, 5, 12, 0, 0)
+            return [
+                t async for t in fixedclock(rate=1, realtime=False,
+                                            start=start, duration_s=5)
+            ]
+
+        times = run(collect())
+        assert times == [
+            dt.datetime(2019, 9, 5, 12, 0, s) for s in range(5)
+        ]
+
+    def test_subsecond_rate(self):
+        async def collect():
+            start = dt.datetime(2019, 9, 5)
+            return [
+                t async for t in fixedclock(rate=4, realtime=False,
+                                            start=start, duration_s=1)
+            ]
+
+        times = run(collect())
+        assert len(times) == 4
+        assert times[1] - times[0] == dt.timedelta(seconds=0.25)
+
+    def test_no_realtime_is_fast(self):
+        """The reference's 10 ms floor sleep is deliberately absent: 1000
+        ticks must take well under 10 s (utils.py:36; SURVEY.md §6)."""
+        import time
+
+        async def collect():
+            n = 0
+            async for _ in fixedclock(rate=1, realtime=False,
+                                      duration_s=1000):
+                n += 1
+            return n
+
+        t0 = time.perf_counter()
+        assert run(collect()) == 1000
+        assert time.perf_counter() - t0 < 2.0
+
+
+class TestFunnel:
+    def test_join_emits_only_complete(self):
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out)
+            await funnel.put(1, meter=5.0)
+            assert out.empty() and len(funnel) == 1
+            await funnel.put(1, pv=2.0)
+            assert out.qsize() == 1 and len(funnel) == 0
+            return await out.get()
+
+        time, rec = run(go())
+        assert (time, rec) == (1, Data(meter=5.0, pv=2.0))
+
+    def test_out_of_order_timestamps(self):
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out)
+            await funnel.put(2, meter=1.0)
+            await funnel.put(1, meter=2.0)
+            await funnel.put(1, pv=0.5)
+            await funnel.put(2, pv=0.25)
+            return [await out.get(), await out.get()]
+
+        emitted = run(go())
+        assert [t for t, _ in emitted] == [1, 2]  # completion order
+
+    def test_eviction_bounds_cache(self):
+        """The reference's unbounded leak (SURVEY.md §5) is fixed: a stalled
+        pv stream cannot grow the cache past max_pending."""
+
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out, max_pending=100)
+            for t in range(500):
+                await funnel.put(t, meter=float(t))
+            return len(funnel), funnel.n_evicted
+
+        size, evicted = run(go())
+        assert size == 100
+        assert evicted == 400
+
+
+class TestAsyncretry:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        @asyncretry(attempts=5, delay=0)
+        async def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("down")
+            return "up"
+
+        assert run(flaky()) == "up"
+        assert len(calls) == 3
+
+    def test_exhaustion_propagates(self):
+        @asyncretry(attempts=2, delay=0)
+        async def bad():
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError):
+            run(bad())
+
+    def test_fallback_value(self):
+        @asyncretry(attempts=1, delay=0, fallback=42)
+        async def bad():
+            raise ValueError
+
+        assert run(bad()) == 42
+
+    def test_cancellation_is_fatal(self):
+        """CancelledError must never be retried (utils.py:78,116-117)."""
+        calls = []
+
+        async def go():
+            @asyncretry(attempts=forever, delay=0)
+            async def loops():
+                calls.append(1)
+                await asyncio.sleep(3600)
+
+            task = asyncio.get_event_loop().create_task(loops())
+            await asyncio.sleep(0.01)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+
+        run(go())
+        assert len(calls) == 1
+
+
+class TestBroker:
+    def test_fanout_all_consumers_see_all(self):
+        """Fanout semantics: N consumers each get every message
+        (pvsim.py:62-63)."""
+
+        async def go():
+            t = dt.datetime(2019, 9, 5, 12, 0, 0)
+            pub = LocalTransport("local://t1", "meter")
+            subs = [LocalTransport("local://t1", "meter") for _ in range(2)]
+            received = [[], []]
+
+            async def consume(i):
+                async for time, value in subs[i].subscribe():
+                    received[i].append((time, value))
+                    if len(received[i]) == 3:
+                        return
+
+            tasks = [asyncio.create_task(consume(i)) for i in range(2)]
+            await asyncio.sleep(0.01)
+            for k in range(3):
+                await pub.publish(float(k), t + dt.timedelta(seconds=k))
+            await asyncio.gather(*tasks)
+            return received
+
+        r = run(go())
+        assert r[0] == r[1]
+        assert [v for _, v in r[0]] == [0.0, 1.0, 2.0]
+        assert r[0][0][0] == dt.datetime(2019, 9, 5, 12, 0, 0)
+
+    def test_make_transport_local_default(self):
+        assert isinstance(make_transport(None, "meter"), LocalTransport)
+        assert isinstance(make_transport("local://x", "m"), LocalTransport)
+
+    def test_amqp_without_aio_pika_raises(self):
+        with pytest.raises(RuntimeError, match="aio_pika"):
+            make_transport("amqp://localhost:5672/", "meter")

@@ -75,13 +75,20 @@ class TestSunPosition:
         pos = solar.sun_position(epoch(2025, 6, 21, 0, 0), 48.12, 11.60, xp=np)
         assert pos["cos_zenith"] < 0
 
-    def test_float32_jax_matches_numpy64(self):
+    def test_jax_x64_matches_numpy(self):
         t = epoch(2025, 8, 1) + np.arange(0, 86400, 997.0)
         ref = solar.sun_position(t, 48.12, 11.60, xp=np)
         got = solar.sun_position(
             jnp.asarray(t, dtype=jnp.float64), 48.12, 11.60, xp=jnp
         )
         np.testing.assert_allclose(got["zenith"], ref["zenith"], atol=1e-9)
+
+    def test_float32_epoch_rejected(self):
+        # float32 absolute epochs quantize to ±64-128 s — a silent ~1 deg
+        # hour-angle error; sun_position must refuse them.
+        t = np.asarray([epoch(2025, 8, 1)], dtype=np.float32)
+        with pytest.raises(TypeError, match="float64"):
+            solar.sun_position(t, 48.12, 11.60, xp=np)
 
     def test_refraction_lifts_horizon_sun(self):
         # ~0.5 deg of refraction at the horizon, ~0 overhead.

@@ -1,0 +1,1 @@
+"""Application orchestrators behind the metersim / pvsim entrypoints."""

@@ -1,0 +1,81 @@
+"""metersim: 1 Hz random electricity-demand producer.
+
+Reference behaviour (metersim.py): sample uniform [0, 9000) W once per
+second on the fixedclock grid, queue, and publish each value as a JSON
+float to a fanout exchange with the measurement time in the message
+timestamp.  The publisher coroutine retries forever with 5 s delay on
+broker failures; on shutdown, queued-but-unsent values are counted and
+warned about (metersim.py:76-77).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import logging
+from typing import Optional
+
+import numpy as np
+
+from tmhpvsim_tpu.runtime import asyncretry, fixedclock, forever
+from tmhpvsim_tpu.runtime.broker import make_transport
+
+logger = logging.getLogger(__name__)
+
+
+def get_meter_value(rng: Optional[np.random.Generator] = None,
+                    max_w: float = 9000.0) -> float:
+    """One uniform [0, max_w) demand sample (metersim.py:49-51)."""
+    rng = rng if rng is not None else np.random.default_rng()
+    return float(max_w * rng.random())
+
+
+async def read_meter_values(queue: asyncio.Queue, realtime: bool,
+                            rng=None, duration_s=None,
+                            start: Optional[_dt.datetime] = None) -> None:
+    """Producer loop: one (time, value) per clock tick (metersim.py:53-62)."""
+    rng = rng if rng is not None else np.random.default_rng()
+    async for time in fixedclock(rate=1, realtime=realtime, start=start,
+                                 duration_s=duration_s):
+        await queue.put((time, get_meter_value(rng)))
+
+
+async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
+    """Publisher loop with forever-retry (metersim.py:13-47)."""
+
+    @asyncretry(delay=5, attempts=forever)
+    async def run():
+        async with make_transport(url, exchange) as transport:
+            while True:
+                time, value = await queue.get()
+                await transport.publish(value, time)
+                queue.task_done()
+
+    await run()
+
+
+async def metersim_main(amqp_url, exchange, realtime, seed=None,
+                        duration_s=None, start=None) -> None:
+    """App orchestrator (metersim.py:64-77): producer + publisher tasks."""
+    queue: asyncio.Queue = asyncio.Queue()
+    rng = np.random.default_rng(seed)
+    read = asyncio.create_task(
+        read_meter_values(queue, realtime, rng, duration_s, start)
+    )
+    send = asyncio.create_task(send_queue_to_transport(queue, amqp_url,
+                                                       exchange))
+    try:
+        done, _ = await asyncio.wait(
+            {read, send}, return_when=asyncio.FIRST_COMPLETED
+        )
+        for t in done:
+            t.result()
+        # bounded run: wait for the queue to drain before stopping the sender
+        await queue.join()
+    finally:
+        for t in (read, send):
+            t.cancel()
+        if not queue.empty():
+            logger.warning(
+                "%d sampled meter_values have not been sent", queue.qsize()
+            )

@@ -1,0 +1,130 @@
+"""pvsim: consume the meter stream, simulate PV, join, write CSV.
+
+Reference behaviour (pvsim.py): three concurrent tasks — a 1 Hz PV
+simulation loop, an AMQP consumer with forever-retry, and a CSV writer —
+joined through a SynchronizingFunnel keyed by timestamp; rows are
+``time, meter, pv, residual load`` (pvsim.py:72-84).  On shutdown the
+number of stranded half-records is warned about (pvsim.py:100-101).
+
+The JAX backend (``backend='jax'``) replaces all of it with the blockwise
+device simulation (engine/simulation.py): both streams are generated on the
+common grid in-process, so there is no broker, no funnel, and the same CSV
+comes out orders of magnitude faster (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import logging
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+from tmhpvsim_tpu.config import ModelOptions, Site
+from tmhpvsim_tpu.runtime import SynchronizingFunnel, asyncretry, fixedclock, \
+    forever
+from tmhpvsim_tpu.runtime.broker import make_transport
+
+logger = logging.getLogger(__name__)
+
+#: Joined record (pvsim.py:19).
+Data = namedtuple("Data", ["meter", "pv"])
+
+
+async def read_pv_values(funnel: SynchronizingFunnel, realtime: bool,
+                         seed=None, duration_s=None,
+                         start: Optional[_dt.datetime] = None) -> None:
+    """1 Hz PV loop feeding the funnel (pvsim.py:21-41)."""
+    from tmhpvsim_tpu.engine.golden import GoldenPVModel
+
+    if start is None:
+        start = _dt.datetime.now()
+    start = start.replace(microsecond=0)
+    model = GoldenPVModel(start, Site(), ModelOptions(),
+                          np.random.default_rng(seed))
+    async for time in fixedclock(rate=1, realtime=realtime, start=start,
+                                 duration_s=duration_s):
+        time = time.replace(microsecond=0)
+        await funnel.put(time, pv=model.next(time))
+
+
+async def read_transport(funnel: SynchronizingFunnel, url, exchange) -> None:
+    """Meter consumer with forever-retry (pvsim.py:43-70)."""
+
+    @asyncretry(delay=5, attempts=forever)
+    async def run():
+        async with make_transport(url, exchange) as transport:
+            async for time, value in transport.subscribe():
+                await funnel.put(time, meter=value)
+
+    await run()
+
+
+async def write_file(filename: str, queue: asyncio.Queue) -> None:
+    """CSV sink, line-buffered for tail-ability (pvsim.py:72-84)."""
+    import csv
+
+    with open(filename, mode="w", newline="", buffering=1) as file:
+        writer = csv.writer(file)
+        writer.writerow(["time"] + list(Data._fields) + ["residual load"])
+        while True:
+            time, data = await queue.get()
+            writer.writerow([time] + list(data) + [data.meter - data.pv])
+            queue.task_done()
+
+
+async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
+                     duration_s=None, start=None) -> None:
+    """App orchestrator (pvsim.py:86-101)."""
+    queue: asyncio.Queue = asyncio.Queue()
+    funnel = SynchronizingFunnel(Data, queue)
+    tasks = [
+        asyncio.create_task(read_pv_values(funnel, realtime, seed,
+                                           duration_s, start)),
+        asyncio.create_task(read_transport(funnel, amqp_url, exchange)),
+        asyncio.create_task(write_file(file, queue)),
+    ]
+    try:
+        done, _ = await asyncio.wait(tasks,
+                                     return_when=asyncio.FIRST_COMPLETED)
+        for t in done:
+            t.result()
+        await queue.join()
+    finally:
+        for t in tasks:
+            t.cancel()
+        if len(funnel) > 0:
+            logger.warning(
+                "%d undelivered meter_values have been lost", len(funnel)
+            )
+
+
+def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
+              start: Optional[str] = None, chain: int = 0,
+              sharded: bool = False) -> None:
+    """The JAX backend: blockwise device simulation straight to CSV."""
+    from tmhpvsim_tpu.config import SimConfig
+    from tmhpvsim_tpu.engine import Simulation
+    from tmhpvsim_tpu.engine.simulation import write_csv
+
+    if start is None:
+        start = _dt.datetime.now().replace(microsecond=0).isoformat(" ")
+    cfg = SimConfig(
+        start=start,
+        duration_s=duration_s,
+        n_chains=n_chains,
+        seed=seed,
+        block_s=min(8640, max(60, (duration_s // 60) * 60)),
+    )
+    if sharded:
+        from tmhpvsim_tpu.parallel import ShardedSimulation
+
+        sim = ShardedSimulation(cfg)
+    else:
+        sim = Simulation(cfg)
+    from zoneinfo import ZoneInfo
+
+    write_csv(file, sim.run_blocks(), chain=chain,
+              tz=ZoneInfo(cfg.site.timezone))

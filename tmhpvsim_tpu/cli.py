@@ -1,0 +1,119 @@
+"""Console entrypoints: ``metersim`` and ``pvsim``.
+
+Same commands, flags and env vars as the reference (SURVEY.md §2.5):
+``--amqp-url`` (env AMQP_URL), ``--exchange`` (env TMHPVSIM_EXCHANGE,
+default 'meter'), counted ``-v`` (WARN - 10/level), ``--realtime/
+--no-realtime`` (default realtime), positional FILE on pvsim — plus the
+TPU-era extensions: ``--backend {asyncio,jax}``, ``--seed``, ``--chains``,
+``--duration``, ``--start``, ``--sharded``.
+
+The default transport URL is ``local://default`` (in-process fanout) so
+the two apps run out of the box without a broker; any amqp:// URL selects
+real AMQP (runtime/broker.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import click
+
+from tmhpvsim_tpu.runtime import asyncrun
+
+
+def _common_options(f):
+    f = click.option(
+        "--amqp-url", default=lambda: os.environ.get("AMQP_URL"),
+        help="AMQP URL, or local://NAME for the in-process broker "
+             "(defaults to 'local://default')",
+    )(f)
+    f = click.option(
+        "--exchange",
+        default=lambda: os.environ.get("TMHPVSIM_EXCHANGE", "meter"),
+        help="The name of the exchange (defaults to 'meter')",
+    )(f)
+    f = click.option(
+        "-v", "--verbose", count=True,
+        help="Increase logging level from default WARN",
+    )(f)
+    f = click.option(
+        "--realtime/--no-realtime", default=True,
+        help="Switch off rate limiting (for simulation)",
+    )(f)
+    f = click.option("--seed", type=int, default=None,
+                     help="PRNG seed (default: nondeterministic)")(f)
+    f = click.option("--duration", "duration_s", type=int, default=None,
+                     help="Stop after this many simulated seconds "
+                          "(default: run forever)")(f)
+    f = click.option("--start", default=None,
+                     help="Simulation start time 'YYYY-MM-DD HH:MM:SS' "
+                          "(default: now)")(f)
+    return f
+
+
+def _setup_logging(verbose: int) -> None:
+    # -v -> INFO, -vv -> DEBUG (metersim.py:92-93)
+    logging.basicConfig(level=logging.WARN - 10 * verbose)
+
+
+def _parse_start(start):
+    import datetime as dt
+
+    return dt.datetime.fromisoformat(start) if start else None
+
+
+@click.command()
+@_common_options
+def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start):
+    """1 Hz electricity-demand producer (reference metersim.py:79-95)."""
+    from tmhpvsim_tpu.apps.metersim import metersim_main
+
+    _setup_logging(verbose)
+    asyncrun(metersim_main(amqp_url, exchange, realtime, seed, duration_s,
+                           _parse_start(start)))
+
+
+@click.command()
+@click.argument("file")
+@_common_options
+@click.option("--backend", type=click.Choice(["asyncio", "jax"]),
+              default="asyncio",
+              help="asyncio: reference-compatible streaming; jax: blockwise "
+                   "device simulation (no broker)")
+@click.option("--chains", "n_chains", type=int, default=1,
+              help="Independent stochastic chains (jax backend)")
+@click.option("--chain", type=int, default=0,
+              help="Which chain to write to FILE (jax backend)")
+@click.option("--sharded/--no-sharded", default=False,
+              help="Shard chains over all available devices (jax backend)")
+def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
+          start, backend, n_chains, chain, sharded):
+    """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
+    _setup_logging(verbose)
+    if backend == "jax":
+        from tmhpvsim_tpu.apps.pvsim import pvsim_jax
+
+        if duration_s is None:
+            raise click.UsageError("--duration is required with --backend=jax")
+        pvsim_jax(file, duration_s, n_chains, seed or 0, start, chain,
+                  sharded)
+        return
+
+    from tmhpvsim_tpu.apps.pvsim import pvsim_main
+
+    asyncrun(pvsim_main(file, amqp_url, exchange, realtime, seed, duration_s,
+                        _parse_start(start)))
+
+
+@click.group()
+def main():
+    """tmhpvsim-tpu: TPU-native PV simulation & streaming."""
+
+
+main.add_command(metersim)
+main.add_command(pvsim)
+
+
+if __name__ == "__main__":
+    main()

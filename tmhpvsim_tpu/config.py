@@ -82,8 +82,10 @@ class SimConfig:
     #: (metersim.py:49-51)
     meter_max_w: float = 9000.0
 
-    #: seconds per scan block (device memory / dispatch granularity)
-    block_s: int = 8192
+    #: seconds per scan block (device memory / dispatch granularity);
+    #: must be a multiple of 60 so blocks span whole minute-sampler
+    #: intervals and every block compiles to the same shapes
+    block_s: int = 8640
 
     #: 'trace'  -> per-second (meter, pv, residual) arrays are returned
     #: 'reduce' -> only per-chain running statistics (sum/min/max/count)

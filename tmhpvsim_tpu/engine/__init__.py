@@ -1,0 +1,3 @@
+"""Blockwise simulation engine (single-host orchestration layer)."""
+
+from tmhpvsim_tpu.engine.simulation import Simulation, BlockResult  # noqa: F401

@@ -1,0 +1,310 @@
+"""Single-host blockwise simulation: the JAX-backend core loop.
+
+The reference's pvsim joins two 1 Hz streams — a random meter-demand stream
+(metersim.py:49-51) and the PV stream driven by the clear-sky-index model —
+by timestamp, and appends ``time, meter, pv, residual load`` rows to a CSV
+(pvsim.py:72-101).  Under the JAX backend both streams are generated on a
+common time grid directly on device, so the reference's AMQP fan-out and
+``SynchronizingFunnel`` collapse into array slots of one jitted block step
+(SURVEY.md §2.4).
+
+Execution layout (SURVEY.md §7 step 7):
+
+* time is processed in fixed-size blocks of ``config.block_s`` seconds —
+  the analogue of the reference's 5000-step ``populate_cache`` window
+  (pvmodel.py:38-80), sized instead for device memory and dispatch overlap;
+  ``block_s`` must be a multiple of 60 so every block spans the same number
+  of minute-sampler values (constant shapes -> exactly one XLA compile);
+* the time grid is *padded* to whole blocks, never shortened: padding rows
+  are trimmed on the host after the gather;
+* chain-independent per-block inputs — solar geometry (models/solar.py) and
+  the calendar index arrays — are precomputed on the host in float64 (epoch
+  seconds do not fit float32; ±64 s of quantisation would wreck the hour
+  angle) and shipped as compact float32 arrays, O(block_s) bytes vs the
+  O(n_chains × block_s) device-side work they parameterise;
+* all chain state lives in one pytree carried block to block: sampler value
+  arrays + renewal carry + per-chain keys.  Serialising it (plus the block
+  offset) IS the checkpoint (SURVEY.md §5 "checkpoint/resume");
+* every random draw is keyed by a *global* index (second / sampler-value
+  index), so results are bit-identical under any block partition — verified
+  by test_block_split_invariance and the engine block-size test.
+
+The per-block device work is one fused computation: per-second csi scan
+(VPU, O(1) carry) -> elementwise PV physics over (chains × block_s) ->
+keyed meter draws -> residual; the only host traffic is the result gather
+(trace mode) or per-chain running statistics (reduce mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
+from tmhpvsim_tpu.models import clearsky_index as ci
+from tmhpvsim_tpu.models import pv as pvmod
+from tmhpvsim_tpu.models import solar
+from tmhpvsim_tpu.models.timegrid import TimeGridSpec
+
+
+@dataclasses.dataclass
+class BlockResult:
+    """One simulated block, gathered to host (trace mode).
+
+    Arrays are (n_chains, length); ``epoch`` is (length,) int64 UTC epoch
+    seconds; ``offset`` is the block start in simulation seconds.
+    """
+
+    offset: int
+    epoch: np.ndarray
+    meter: np.ndarray
+    pv: np.ndarray
+    residual: np.ndarray
+    #: cross-chain ensemble statistics (set by ShardedSimulation)
+    ensemble: dict = None
+
+
+def _round_up(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k
+
+
+class Simulation:
+    """Blockwise JAX simulation of ``config.n_chains`` independent sites.
+
+    Usage::
+
+        sim = Simulation(config)
+        for block in sim.run_blocks():   # BlockResult per block, in order
+            ...
+        stats = sim.run_reduced()        # or: per-chain running statistics
+    """
+
+    def __init__(self, config: SimConfig):
+        if config.block_s % 60 != 0:
+            raise ValueError("block_s must be a multiple of 60 (minute grid)")
+        self.config = config
+        self._padded_s = _round_up(config.duration_s, config.block_s)
+        self.spec = TimeGridSpec.from_local_start(
+            config.start, self._padded_s, config.site.timezone
+        )
+        self.feats = ci.HostFeatures.from_spec(self.spec)
+        self.dtype = jnp.dtype(config.dtype)
+        self.n_blocks = self._padded_s // config.block_s
+        self._n_minute_vals = None  # fixed after first block (constant shape)
+
+        root = jax.random.key(config.seed)
+        self._k_chains, _ = jax.random.split(root)
+        self._block_jit = jax.jit(self._block_step)
+        self._block_reduced_jit = jax.jit(self._block_step_reduced)
+
+    # ------------------------------------------------------------------
+    # chain state
+    # ------------------------------------------------------------------
+
+    def init_state(self):
+        """Initial carried pytree for all chains: sampler arrays, renewal
+        carry, and per-chain keys.  With the block offset this is a complete
+        checkpoint of the simulation."""
+        opts = self.config.options
+        feats = self.feats
+        dtype = self.dtype
+
+        def one(key):
+            k_arr, k_min, k_renew, k_scan, k_meter = jax.random.split(key, 5)
+            arrays = ci.build_chain_arrays(k_arr, feats, opts, dtype)
+            carry = ci.init_renewal(k_renew, arrays, dtype)
+            return {
+                "arrays": arrays,
+                "carry": carry,
+                "k_min": k_min,
+                "k_scan": k_scan,
+                "k_meter": k_meter,
+            }
+
+        keys = jax.random.split(self._k_chains, self.config.n_chains)
+        return jax.jit(jax.vmap(one))(keys)
+
+    # ------------------------------------------------------------------
+    # host-side per-block inputs (chain-independent, float64 precompute)
+    # ------------------------------------------------------------------
+
+    def host_inputs(self, block_i: int):
+        """All chain-independent device inputs for one block.
+
+        Geometry is evaluated here in float64 numpy — it is O(block_s) and
+        shared by every chain — then cast to the compute dtype.
+        """
+        cfg = self.config
+        off = block_i * cfg.block_s
+        block_idx, (mlo, mhi) = ci.host_block_index(
+            self.spec, off, cfg.block_s, self.dtype
+        )
+        if self._n_minute_vals is None:
+            self._n_minute_vals = mhi - mlo
+        if mhi - mlo != self._n_minute_vals:
+            raise AssertionError(
+                "minute-value count changed across blocks; block_s must keep "
+                "the minute grid aligned"
+            )
+        h_idx, h_frac = self.spec.minute_value_features(mlo, mhi)
+        mfeats = (
+            jnp.asarray(h_idx, dtype=jnp.int32),
+            jnp.asarray(h_frac, dtype=self.dtype),
+        )
+
+        blk = self.spec.block(off, cfg.block_s)
+        geom64 = solar.block_geometry(
+            blk.epoch.astype(np.float64), blk.doy.astype(np.float64),
+            cfg.site, xp=np,
+        )
+        geom = {
+            k: (jnp.asarray(v, dtype=self.dtype)
+                if isinstance(v, np.ndarray) else v)
+            for k, v in geom64.items()
+        }
+        return {
+            "block_idx": block_idx,
+            "mlo": jnp.asarray(mlo, dtype=jnp.int32),
+            "mfeats": mfeats,
+            "geom": geom,
+        }, blk.epoch
+
+    # ------------------------------------------------------------------
+    # device block step (jitted once; shapes constant across blocks)
+    # ------------------------------------------------------------------
+
+    def _block_step(self, state, inputs):
+        """(state, inputs) -> (state', meter, pv, residual), all on device."""
+        cfg = self.config
+        block_idx = inputs["block_idx"]
+        geom = inputs["geom"]
+        mlo = inputs["mlo"]
+        mfeats = inputs["mfeats"]
+        dtype = self.dtype
+
+        def one_chain(chain):
+            mvals = ci.minute_noise_values_device(
+                chain["k_min"], chain["arrays"]["cc"], mlo, mfeats, dtype
+            )
+            carry, csi, _covered = ci.csi_scan_block(
+                chain["k_scan"], chain["arrays"], mvals, mlo,
+                chain["carry"], block_idx, cfg.options, dtype,
+            )
+            ac = pvmod.power_from_csi(
+                csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
+            )
+            meter_keys = jax.vmap(
+                lambda i: jax.random.fold_in(chain["k_meter"], i)
+            )(block_idx["t"])
+            meter = cfg.meter_max_w * jax.vmap(
+                lambda k: jax.random.uniform(k, (), dtype)
+            )(meter_keys)
+            return dict(chain, carry=carry), meter, ac, meter - ac
+
+        return jax.vmap(one_chain)(state)
+
+    def _block_step_reduced(self, state, inputs):
+        """Block step + on-device per-chain reduction: ships only O(n_chains)
+        bytes to the host.  Grid-padding seconds are masked out."""
+        state, meter, pv, residual = self._block_step(state, inputs)
+        valid = (inputs["block_idx"]["t"] < self.config.duration_s)
+        nv = valid.sum()
+        big = jnp.asarray(jnp.finfo(self.dtype).max, self.dtype)
+        vz = jnp.where(valid, 1.0, 0.0).astype(self.dtype)
+        stats = {
+            "pv_sum": (pv * vz).sum(axis=1),
+            "pv_max": jnp.where(valid, pv, -big).max(axis=1),
+            "meter_sum": (meter * vz).sum(axis=1),
+            "residual_sum": (residual * vz).sum(axis=1),
+            "residual_min": jnp.where(valid, residual, big).min(axis=1),
+            "residual_max": jnp.where(valid, residual, -big).max(axis=1),
+            "n_seconds": jnp.broadcast_to(nv, (pv.shape[0],)),
+        }
+        return state, stats
+
+    # ------------------------------------------------------------------
+    # run loops
+    # ------------------------------------------------------------------
+
+    def run_blocks(self, state=None, start_block: int = 0
+                   ) -> Iterator[BlockResult]:
+        """Yield BlockResults in time order; padding trimmed from the last."""
+        cfg = self.config
+        if state is None:
+            state = self.init_state()
+        self.state = state
+        for bi in range(start_block, self.n_blocks):
+            inputs, epoch = self.host_inputs(bi)
+            self.state, meter, pv, residual = self._block_jit(
+                self.state, inputs
+            )
+            off = bi * cfg.block_s
+            n_valid = min(cfg.block_s, cfg.duration_s - off)
+            yield BlockResult(
+                offset=off,
+                epoch=np.asarray(epoch[:n_valid]),
+                meter=np.asarray(meter)[:, :n_valid],
+                pv=np.asarray(pv)[:, :n_valid],
+                residual=np.asarray(residual)[:, :n_valid],
+            )
+
+    def run_reduced(self, state=None):
+        """Run everything, keeping only per-chain running statistics.
+
+        The trace never reaches the host: each block is reduced on device
+        (``_block_step_reduced``) and only (n_chains,) accumulators are
+        gathered.  Returns dict of (n_chains,) arrays: pv_sum, pv_max,
+        meter_sum, residual_sum, residual_min, residual_max, n_seconds.
+        """
+        if state is None:
+            state = self.init_state()
+        self.state = state
+        acc = None
+        for bi in range(self.n_blocks):
+            inputs, _ = self.host_inputs(bi)
+            self.state, stats = self._block_reduced_jit(self.state, inputs)
+            # np.array (copy): asarray views of device buffers are read-only
+            cur = {k: np.array(v) for k, v in stats.items()}
+            if acc is None:
+                acc = cur
+            else:
+                for k in ("pv_sum", "meter_sum", "residual_sum", "n_seconds"):
+                    acc[k] += cur[k]
+                acc["pv_max"] = np.maximum(acc["pv_max"], cur["pv_max"])
+                acc["residual_min"] = np.minimum(acc["residual_min"],
+                                                 cur["residual_min"])
+                acc["residual_max"] = np.maximum(acc["residual_max"],
+                                                 cur["residual_max"])
+        return acc
+
+
+def write_csv(path: str, blocks: Iterator[BlockResult], chain: int = 0,
+              tz=None):
+    """Write the reference CSV format — header ``time,meter,pv,residual
+    load``, one row per second (pvsim.py:78-83) — for one selected chain.
+
+    ``tz`` converts the grid's UTC epochs to wall time for the ``time``
+    column; rows are written as naive local datetimes like the reference's
+    (which prints the fixedclock's naive local grid).  Default: the
+    process's local timezone.  Pass the site's ZoneInfo to get site-local
+    rows regardless of host timezone.
+    """
+    import csv
+
+    with open(path, mode="w", newline="", buffering=1) as f:
+        w = csv.writer(f)
+        w.writerow(["time", "meter", "pv", "residual load"])
+        for blk in blocks:
+            for e, m, p, r in zip(
+                blk.epoch, blk.meter[chain], blk.pv[chain], blk.residual[chain]
+            ):
+                t = _dt.datetime.fromtimestamp(int(e), tz)
+                if tz is not None:
+                    t = t.replace(tzinfo=None)
+                w.writerow([t, m, p, r])

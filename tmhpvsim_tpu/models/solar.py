@@ -59,15 +59,28 @@ def alt2pres(altitude_m):
 def sun_position(epoch_s, latitude_deg, longitude_deg, xp=jnp):
     """PSA+ sun position at UTC epoch seconds.
 
+    ``epoch_s`` MUST be float64 (or int64): absolute epoch seconds (~1.7e9)
+    quantize to ±64-128 s in float32 — about a degree of hour angle — so a
+    float32 input is a silent correctness bug, rejected here.  The intended
+    pattern is the engine's: evaluate geometry on the host in float64
+    (it is chain-independent and O(block)) and ship float32 *results* to
+    the device (engine/simulation.py host_inputs).
+
     Parameters are broadcastable arrays.  Returns a dict:
-      ``zenith``            true topocentric zenith angle [rad]
-      ``apparent_zenith``   refraction is applied separately (apparent_zenith)
-      ``azimuth``           [rad], 0 = North, increasing eastward (pvlib
-                            convention)
-      ``cos_zenith``        cos of the true zenith
+      ``zenith``      true topocentric zenith angle [rad] (no refraction;
+                      apply :func:`apparent_elevation` separately)
+      ``azimuth``     [rad], 0 = North, increasing eastward (pvlib
+                      convention)
+      ``cos_zenith``  cos of the true zenith
 
     Coefficients: Blanco et al. 2020 update of the PSA ephemeris.
     """
+    dt_ = np.dtype(getattr(epoch_s, "dtype", np.float64))
+    if dt_.kind == "f" and dt_.itemsize < 8:
+        raise TypeError(
+            "sun_position requires float64/int64 epoch seconds; float32 "
+            "quantizes absolute epochs to >±64 s (see docstring)"
+        )
     lat = latitude_deg * DEG
     lon = longitude_deg * DEG
 
@@ -204,20 +217,22 @@ def ineichen_ghi(apparent_zenith, airmass_absolute, tl, altitude_m,
     """Ineichen & Perez 2002 clear-sky GHI [W/m^2].
 
     Same formulation the reference evaluates via Location.get_clearsky
-    (pvmodel.py:60): altitude-corrected coefficients, Linke-turbidity
-    attenuation, and the airmass^1.8 brightening term.
+    (pvmodel.py:60): altitude-corrected coefficients and Linke-turbidity
+    attenuation (no Perez enhancement factor — see NOTE below).
     """
     fh1 = xp.exp(-altitude_m / 8000.0)
     fh2 = xp.exp(-altitude_m / 1250.0)
     cg1 = 5.09e-5 * altitude_m + 0.868
     cg2 = 3.92e-5 * altitude_m + 0.0387
     cos_zen = xp.maximum(xp.cos(apparent_zenith), 0.0)
+    # NOTE: the classical Perez enhancement factor exp(0.01*am^1.8) is
+    # deliberately absent — pvlib disables it by default since 0.6.0, so the
+    # reference's Location.get_clearsky path never applies it.
     ghi = (
         cg1
         * dni_extra
         * cos_zen
         * xp.exp(-cg2 * airmass_absolute * (fh1 + fh2 * (tl - 1.0)))
-        * xp.exp(0.01 * airmass_absolute**1.8)
     )
     return xp.maximum(ghi, 0.0)
 
@@ -243,7 +258,9 @@ def disc_dni(ghi, zenith, doy, xp=jnp):
     """
     i0 = extra_radiation_spencer(doy, DISC_SOLAR_CONSTANT, xp=xp)
     cos_zen = xp.cos(zenith)
-    i0h = i0 * xp.maximum(cos_zen, 1e-4)
+    # 0.065 = pvlib's min_cos_zenith for kt (disc default since 0.6.0):
+    # keeps kt bounded through the 86.3-87 deg twilight band
+    i0h = i0 * xp.maximum(cos_zen, 0.065)
 
     kt = xp.clip(ghi / i0h, 0.0, 2.0)
     am = relative_airmass_kasten1966(zenith, xp=xp)

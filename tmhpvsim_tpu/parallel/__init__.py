@@ -1,0 +1,7 @@
+"""Multi-chip / multi-host execution layer."""
+
+from tmhpvsim_tpu.parallel.mesh import (  # noqa: F401
+    ShardedSimulation,
+    chain_sharding,
+    make_mesh,
+)

@@ -1,0 +1,76 @@
+"""Multi-host initialisation and host-local data movement.
+
+The reference has no multi-node story at all — its cross-process transport
+is a RabbitMQ broker on localhost (SURVEY.md §2.4).  For pod slices the
+TPU-native framework uses the standard JAX runtime instead: one Python
+process per host, ``jax.distributed`` over DCN for control, ICI for the
+collectives issued inside ``shard_map`` (parallel/mesh.py).
+
+Nothing here opens sockets itself; it wires up the JAX runtime from the
+standard environment (TPU pods export everything needed) and provides the
+host-local views a CSV-writing process needs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_from_env() -> bool:
+    """Initialise ``jax.distributed`` when running under a multi-host
+    launcher; no-op (returns False) in single-process runs.
+
+    TPU pod runtimes set the coordinator address and process ids in the
+    environment; GPU/CPU launchers can export ``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES`` and ``JAX_PROCESS_ID`` explicitly.
+    """
+    if jax.process_count() > 1:
+        return True  # already initialised by the runtime
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    if not addr or not nproc or int(nproc) <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(nproc),
+        process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )
+    logger.info(
+        "jax.distributed initialised: process %d/%d, %d local / %d global "
+        "devices", jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def local_chain_slice(n_chains: int, mesh) -> slice:
+    """The [start, stop) chain indices owned by this host process.
+
+    The mesh lays chains out contiguously over the flat device list, so a
+    host's chains are a contiguous slice aligned to its addressable
+    devices — the slice a per-host CSV writer or checkpointer owns.
+    """
+    n_dev = mesh.devices.size
+    per_dev = n_chains // n_dev
+    flat = list(mesh.devices.flat)
+    local = [i for i, d in enumerate(flat)
+             if d.process_index == jax.process_index()]
+    if not local:
+        return slice(0, 0)
+    lo, hi = min(local), max(local) + 1
+    return slice(lo * per_dev, hi * per_dev)
+
+
+def host_gather_ensemble(arr) -> np.ndarray:
+    """Fetch a replicated (ensemble) array to host numpy.
+
+    Replicated outputs of the sharded block step are fully addressable on
+    every host; this is a plain device->host copy, no DCN traffic.
+    """
+    return np.asarray(arr)

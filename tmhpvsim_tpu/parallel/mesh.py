@@ -1,0 +1,135 @@
+"""Chain-parallel execution over a TPU device mesh.
+
+The reference's only parallelism is "run N independent pvsim consumer
+processes against one RabbitMQ fanout exchange" (SURVEY.md §2.3,
+metersim.py:25-28 / pvsim.py:62-63) — replication with a broker as the
+fan-out.  The TPU-native equivalent shards the *chain* batch axis of one
+simulation across the chips of a ``jax.sharding.Mesh`` and replaces the
+broker with in-process XLA collectives over ICI:
+
+* every per-chain quantity (sampler arrays, renewal carry, keys, traces)
+  is sharded on the ``chains`` mesh axis — pure data parallelism, zero
+  communication in the hot loop;
+* cross-chain *ensemble* statistics (the "grid operator" view: aggregate
+  residual load per second over the whole fleet) are one ``psum`` per
+  block over ICI — the only collective the workload needs, exactly where
+  the reference's AMQP fan-out + funnel join used to sit (SURVEY.md §2.4);
+* multi-host slices extend the same mesh over DCN via
+  ``jax.distributed`` (parallel/distributed.py); each host feeds and
+  gathers only its addressable shard.
+
+Tested on 8 virtual CPU devices (tests/conftest.py sets
+``--xla_force_host_platform_device_count=8``; SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine.simulation import BlockResult, Simulation
+
+CHAIN_AXIS = "chains"
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over all (or the given) devices, axis name ``chains``.
+
+    The workload is embarrassingly parallel over chains, so a flat 1-D mesh
+    is the right topology on any slice shape: XLA maps the single axis onto
+    the physical ICI torus itself, and the one collective we issue (psum of
+    per-second ensemble sums) rides nearest-neighbour rings.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    return Mesh(np.asarray(devices), (CHAIN_AXIS,))
+
+
+def chain_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding that splits the leading (chain) axis across the mesh."""
+    return NamedSharding(mesh, P(CHAIN_AXIS))
+
+
+class ShardedSimulation(Simulation):
+    """`engine.Simulation` with the chain axis sharded across a mesh.
+
+    Differences from the single-chip parent:
+
+    * ``init_state()`` lays out every chain-indexed leaf with a
+      ``NamedSharding`` over the ``chains`` axis (n_chains must divide by
+      the mesh size);
+    * the block step runs under ``shard_map`` and additionally returns the
+      per-second ensemble sums of pv and residual over *all* chains,
+      reduced with ``psum`` over ICI and replicated on every chip;
+    * BlockResults carry the global ensemble means in ``.ensemble``.
+    """
+
+    def __init__(self, config: SimConfig, mesh: Optional[Mesh] = None):
+        super().__init__(config)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_dev = self.mesh.devices.size
+        if config.n_chains % n_dev != 0:
+            raise ValueError(
+                f"n_chains={config.n_chains} must be divisible by the mesh "
+                f"size {n_dev}"
+            )
+        self._sharded_block = self._build_sharded_block()
+
+    def init_state(self):
+        state = super().init_state()
+        sharding = chain_sharding(self.mesh)
+        return jax.device_put(state, sharding)
+
+    def _build_sharded_block(self):
+        spec_state = P(CHAIN_AXIS)
+        spec_repl = P()
+
+        def block(state, inputs):
+            # Inside shard_map: `state` is this chip's chain shard, inputs
+            # are replicated.  The parent's vmapped step runs unchanged on
+            # the shard; the ensemble reduction is the one collective.
+            new_state, meter, pv, residual = self._block_step(state, inputs)
+            pv_sum = jax.lax.psum(pv.sum(axis=0), CHAIN_AXIS)
+            res_sum = jax.lax.psum(residual.sum(axis=0), CHAIN_AXIS)
+            return new_state, meter, pv, residual, pv_sum, res_sum
+
+        mapped = shard_map(
+            block,
+            mesh=self.mesh,
+            in_specs=(spec_state, spec_repl),
+            out_specs=(spec_state, spec_state, spec_state, spec_state,
+                       spec_repl, spec_repl),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
+    def run_blocks(self, state=None, start_block: int = 0
+                   ) -> Iterator[BlockResult]:
+        cfg = self.config
+        if state is None:
+            state = self.init_state()
+        self.state = state
+        inv_n = 1.0 / cfg.n_chains
+        for bi in range(start_block, self.n_blocks):
+            inputs, epoch = self.host_inputs(bi)
+            (self.state, meter, pv, residual, pv_sum, res_sum
+             ) = self._sharded_block(self.state, inputs)
+            off = bi * cfg.block_s
+            n_valid = min(cfg.block_s, cfg.duration_s - off)
+            blk = BlockResult(
+                offset=off,
+                epoch=np.asarray(epoch[:n_valid]),
+                meter=np.asarray(meter)[:, :n_valid],
+                pv=np.asarray(pv)[:, :n_valid],
+                residual=np.asarray(residual)[:, :n_valid],
+            )
+            blk.ensemble = {
+                "pv_mean": np.asarray(pv_sum)[:n_valid] * inv_n,
+                "residual_mean": np.asarray(res_sum)[:n_valid] * inv_n,
+            }
+            yield blk

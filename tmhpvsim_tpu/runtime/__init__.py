@@ -1,0 +1,6 @@
+"""Asyncio streaming runtime (the reference-compatible default backend)."""
+
+from tmhpvsim_tpu.runtime.clock import fixedclock  # noqa: F401
+from tmhpvsim_tpu.runtime.funnel import SynchronizingFunnel  # noqa: F401
+from tmhpvsim_tpu.runtime.retry import asyncretry, forever  # noqa: F401
+from tmhpvsim_tpu.runtime.run import asyncrun  # noqa: F401

@@ -1,0 +1,175 @@
+"""Message transport: AMQP fanout semantics behind one small interface.
+
+The reference's cross-process boundary is a RabbitMQ fanout exchange
+(SURVEY.md §2.4): the producer declares exchange ``name`` and publishes
+JSON floats with the measurement time in the AMQP ``timestamp`` property
+(metersim.py:25-42); each consumer binds an exclusive queue so every
+consumer sees every message (pvsim.py:56-67).
+
+Two interchangeable transports implement those semantics:
+
+* :class:`AmqpTransport` — real AMQP via ``aio_pika`` when a broker URL is
+  given AND aio_pika is importable (it is not part of this image's baked
+  dependency set, so the import is gated);
+* :class:`LocalTransport` — an in-process fanout broker with identical
+  pub/sub behaviour, selected by ``amqp_url='local://...'``.  It is the
+  test transport (SURVEY.md §4: "fake the transport with an in-memory
+  broker") and the default when no broker is reachable, letting the two
+  apps run in one process out of the box.
+
+Wire format matches the reference: UTF-8 JSON float body + POSIX-seconds
+timestamp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import datetime as _dt
+import json
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Message:
+    body: bytes
+    timestamp: Optional[_dt.datetime]
+
+
+def encode(value: float, time: _dt.datetime) -> Message:
+    """JSON float body + timestamp property (metersim.py:38-42)."""
+    return Message(body=json.dumps(value).encode(), timestamp=time)
+
+
+def decode(msg: Message) -> Tuple[_dt.datetime, float]:
+    """(measurement time, value) — the consumer's view (pvsim.py:66-70)."""
+    return msg.timestamp, json.loads(msg.body.decode())
+
+
+# ---------------------------------------------------------------------------
+# in-process fanout broker
+# ---------------------------------------------------------------------------
+
+
+class _LocalBroker:
+    """Named fanout exchanges; one per-consumer unbounded queue each."""
+
+    _registry: Dict[str, "_LocalBroker"] = {}
+
+    def __init__(self):
+        self._exchanges: Dict[str, List[asyncio.Queue]] = {}
+
+    @classmethod
+    def get(cls, url: str) -> "_LocalBroker":
+        """One broker instance per local:// URL (vhost-like isolation)."""
+        return cls._registry.setdefault(url, cls())
+
+    def publish(self, exchange: str, msg: Message) -> None:
+        for q in self._exchanges.get(exchange, []):
+            q.put_nowait(msg)
+
+    def bind(self, exchange: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._exchanges.setdefault(exchange, []).append(q)
+        return q
+
+    def unbind(self, exchange: str, q: asyncio.Queue) -> None:
+        try:
+            self._exchanges.get(exchange, []).remove(q)
+        except ValueError:
+            pass
+
+
+class LocalTransport:
+    """Fanout pub/sub inside one process (``local://`` URLs)."""
+
+    def __init__(self, url: str, exchange: str):
+        self._broker = _LocalBroker.get(url)
+        self._exchange = exchange
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+    async def publish(self, value: float, time: _dt.datetime) -> None:
+        self._broker.publish(self._exchange, encode(value, time))
+
+    async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
+        q = self._broker.bind(self._exchange)
+        try:
+            while True:
+                yield decode(await q.get())
+        finally:
+            self._broker.unbind(self._exchange, q)
+
+
+# ---------------------------------------------------------------------------
+# real AMQP (gated on aio_pika availability)
+# ---------------------------------------------------------------------------
+
+
+class AmqpTransport:
+    """Fanout pub/sub over a RabbitMQ broker via aio_pika.
+
+    Mirrors the reference topology: durable-less named fanout exchange,
+    publisher without confirms but with ``asyncio.shield`` around publish
+    (metersim.py:43-45); consumer with an exclusive auto-delete queue and
+    prefetch 1 (pvsim.py:53-63).
+    """
+
+    def __init__(self, url: str, exchange: str):
+        try:
+            import aio_pika  # noqa: F401
+        except ImportError as err:
+            raise RuntimeError(
+                "aio_pika is not installed; use a local:// URL for the "
+                "in-process transport or install aio-pika for AMQP"
+            ) from err
+        self._aio_pika = __import__("aio_pika")
+        self._url = url
+        self._exchange_name = exchange
+        self._conn = None
+
+    async def __aenter__(self):
+        ap = self._aio_pika
+        self._conn = await ap.connect_robust(self._url)
+        self._channel = await self._conn.channel()
+        self._exchange = await self._channel.declare_exchange(
+            self._exchange_name, ap.ExchangeType.FANOUT
+        )
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._conn is not None:
+            await self._conn.close()
+        return False
+
+    async def publish(self, value: float, time: _dt.datetime) -> None:
+        ap = self._aio_pika
+        msg = ap.Message(
+            body=json.dumps(value).encode(),
+            timestamp=time,
+        )
+        await asyncio.shield(self._exchange.publish(msg, routing_key=""))
+
+    async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
+        await self._channel.set_qos(prefetch_count=1)
+        queue = await self._channel.declare_queue(exclusive=True)
+        await queue.bind(self._exchange)
+        async with queue.iterator() as it:
+            async for message in it:
+                async with message.process():
+                    ts = message.timestamp
+                    if isinstance(ts, (int, float)):
+                        ts = _dt.datetime.fromtimestamp(ts)
+                    yield ts, json.loads(message.body.decode())
+
+
+def make_transport(url: Optional[str], exchange: str):
+    """Transport from a URL: ``local://`` -> in-process, else AMQP."""
+    url = url or "local://default"
+    if url.startswith("local://"):
+        return LocalTransport(url, exchange)
+    return AmqpTransport(url, exchange)

@@ -1,0 +1,53 @@
+"""Fixed-rate simulation clock.
+
+Reference semantics (utils.py:13-45): an async generator yielding *ideal
+grid* timestamps ``start + i/rate`` — never the actual wall time — so
+downstream joins see a perfectly regular series even when the loop lags.
+In realtime mode it sleeps until the wall clock reaches each tick and warns
+when more than two periods behind (with the reference's f-string bug fixed,
+utils.py:41).
+
+Deliberate deviation: the reference sleeps >= 10 ms even with
+``realtime=False`` (utils.py:36), capping every CPU simulation at ~100
+simulated s/s — its de-facto throughput ceiling (SURVEY.md §6).  Here
+non-realtime mode yields back to the event loop without a floor sleep
+(``asyncio.sleep(0)``), which preserves cooperative scheduling but removes
+the artificial cap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import logging
+import time
+from typing import AsyncIterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+async def fixedclock(
+    rate: float = 1.0,
+    realtime: bool = True,
+    start: Optional[_dt.datetime] = None,
+    duration_s: Optional[float] = None,
+) -> AsyncIterator[_dt.datetime]:
+    """Yield naive-local datetimes on the ideal ``start + i/rate`` grid.
+
+    ``duration_s`` bounds the stream (None = infinite, as the reference).
+    """
+    period = 1.0 / rate
+    if start is None:
+        start = _dt.datetime.now()
+    start_wall = time.monotonic()
+    i = 0
+    while duration_s is None or i * period < duration_s:
+        yield start + _dt.timedelta(seconds=i * period)
+        i += 1
+        if realtime:
+            behind = (time.monotonic() - start_wall) - i * period
+            if behind > 2 * period:
+                logger.warning("We are %.2f seconds behind realtime", behind)
+            await asyncio.sleep(max(0.0, -behind))
+        else:
+            await asyncio.sleep(0)

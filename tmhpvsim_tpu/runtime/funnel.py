@@ -1,0 +1,66 @@
+"""Timestamp-join primitive for merging partial records from N streams.
+
+Reference semantics (utils.py:47-67): a dict cache keyed by timestamp;
+each ``put(time, field=value)`` merges into the cached record; when every
+field is present the completed record is moved to the output queue.  It is
+the entire stream-join machinery between the AMQP meter feed and the local
+PV feed (pvsim.py:86-101).
+
+Deviation (leak fix): the reference's cache grows without bound if one
+stream stalls (SURVEY.md §5).  ``max_pending`` (default 10 000) evicts the
+oldest incomplete records with a warning instead of exhausting memory;
+``None`` restores the unbounded behaviour.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+from typing import NamedTuple, Optional, Type
+
+logger = logging.getLogger(__name__)
+
+
+class SynchronizingFunnel:
+    """Merge per-timestamp partial records; emit completed ones in put-order.
+
+    ``record_type`` is a NamedTuple class whose fields are the joined
+    streams (the reference's ``Data = namedtuple(..., ['meter', 'pv'])``,
+    pvsim.py:19); missing fields are NaN until every stream delivered.
+    """
+
+    def __init__(self, record_type: Type[NamedTuple],
+                 queue: "asyncio.Queue",
+                 max_pending: Optional[int] = 10_000):
+        self._type = record_type
+        self._blank = record_type(*([math.nan] * len(record_type._fields)))
+        self._queue = queue
+        self._cache: dict = {}
+        self.max_pending = max_pending
+        self.n_evicted = 0
+
+    def __len__(self):
+        return len(self._cache)
+
+    async def put(self, time, **fields) -> None:
+        rec = self._cache.get(time, self._blank)._replace(**fields)
+        if any(isinstance(v, float) and math.isnan(v) for v in rec):
+            self._cache[time] = rec
+            await self._evict_if_needed()
+        else:
+            self._cache.pop(time, None)
+            await self._queue.put((time, rec))
+
+    async def _evict_if_needed(self):
+        if self.max_pending is None or len(self._cache) <= self.max_pending:
+            return
+        oldest = min(self._cache)
+        self._cache.pop(oldest)
+        self.n_evicted += 1
+        if self.n_evicted == 1 or self.n_evicted % 1000 == 0:
+            logger.warning(
+                "funnel cache exceeded %d pending records; evicted %d "
+                "incomplete (one input stream is stalled?)",
+                self.max_pending, self.n_evicted,
+            )

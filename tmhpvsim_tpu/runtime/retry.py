@@ -1,0 +1,75 @@
+"""Retry decorator for long-lived connection coroutines.
+
+Reference semantics (utils.py:69-161): wrap an async function so failures
+re-invoke it after ``delay`` seconds, up to ``attempts`` times (the
+``forever`` sentinel means unbounded — how both AMQP coroutines ride out
+broker outages, metersim.py:13, pvsim.py:43).  ``asyncio.CancelledError``
+is always fatal (shutdown must win over resilience).  On exhaustion the
+``fallback`` policy applies: re-raise (default), a constant, or a callable
+receiving the exception.
+
+The reference's latent bugs in the callable-fallback path
+(``isinstance(Exception)`` with one argument, undefined ``loop``,
+utils.py:134,138) are simply not reproduced.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+import logging
+
+logger = logging.getLogger(__name__)
+
+#: Sentinel for unbounded retries (the reference's ``forever = ...``,
+#: utils.py:71).
+forever = ...
+
+
+class _Propagate:
+    pass
+
+
+propagate = _Propagate()
+
+
+def asyncretry(func=None, *, attempts=3, delay: float = 0.0,
+               fallback=propagate):
+    """Decorator: retry an async callable on exception.
+
+    Usable bare (``@asyncretry``) or parameterised
+    (``@asyncretry(delay=5, attempts=forever)``).
+    """
+    if func is None:
+        return functools.partial(
+            asyncretry, attempts=attempts, delay=delay, fallback=fallback
+        )
+
+    @functools.wraps(func)
+    async def wrapper(*args, **kwargs):
+        n = 0
+        while True:
+            try:
+                return await func(*args, **kwargs)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                n += 1
+                if attempts is not forever and n >= attempts:
+                    if fallback is propagate:
+                        raise
+                    if callable(fallback):
+                        res = fallback(exc)
+                        if inspect.isawaitable(res):
+                            res = await res
+                        return res
+                    return fallback
+                logger.info(
+                    "%s failed (%s: %s); retrying in %.1f s (attempt %s)",
+                    func.__qualname__, type(exc).__name__, exc, delay,
+                    f"{n}/{attempts}" if attempts is not forever else n,
+                )
+                await asyncio.sleep(delay)
+
+    return wrapper
