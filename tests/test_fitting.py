@@ -1,0 +1,98 @@
+"""Offline fitting pipeline: synthetic round-trips (the reference's
+pipeline is broken and untested; SURVEY.md §2.2/§3.4)."""
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.data import MARKOV_STEP_BINS, MARKOV_STEP_PARAMS
+from tmhpvsim_tpu.models.markov_hourly import chain_numpy
+from tmhpvsim_tpu.offline import fitting
+
+
+def sample_al(rng, loc, scale, kappa, n):
+    """Inverse-CDF sampler of the reference's asymmetric Laplace."""
+    u = rng.uniform(size=n)
+    k2 = kappa * kappa
+    lo = kappa * np.log((1 + k2) / k2 * u)
+    hi = -np.log((1 + k2) * (1 - u)) / kappa
+    return loc + scale * np.where(u < k2 / (1 + k2), lo, hi)
+
+
+class TestALFit:
+    def test_recovers_parameters(self):
+        rng = np.random.default_rng(0)
+        x = sample_al(rng, loc=0.02, scale=0.05, kappa=1.8, n=20_000)
+        fit = fitting.fit_asymmetric_laplace(x)
+        assert fit.loc == pytest.approx(0.02, abs=0.01)
+        assert fit.scale == pytest.approx(0.05, rel=0.1)
+        assert fit.kappa == pytest.approx(1.8, rel=0.1)
+
+    def test_skewness_direction(self):
+        rng = np.random.default_rng(1)
+        right_heavy = sample_al(rng, 0.0, 0.1, 0.5, 5000)   # kappa<1
+        left_heavy = sample_al(rng, 0.0, 0.1, 2.0, 5000)    # kappa>1
+        assert fitting.fit_asymmetric_laplace(right_heavy).kappa < 1
+        assert fitting.fit_asymmetric_laplace(left_heavy).kappa > 1
+
+
+class TestTFit:
+    def test_recovers_parameters(self):
+        rng = np.random.default_rng(2)
+        x = 0.01 + 0.17 * rng.standard_t(df=8, size=20_000)
+        fit = fitting.fit_student_t(x)
+        assert fit.loc == pytest.approx(0.01, abs=0.01)
+        assert fit.scale == pytest.approx(0.17, rel=0.1)
+        assert 4 < fit.df < 16
+
+
+class TestSelection:
+    def test_aic_prefers_al_for_al_data(self):
+        rng = np.random.default_rng(3)
+        x = sample_al(rng, 0.0, 0.04, 2.2, 10_000)
+        fit = fitting.fit_bin(x)
+        assert not fit.is_t
+
+    def test_thin_bin_returns_none(self):
+        assert fitting.fit_bin(np.zeros(5)) is None
+
+
+class TestPipeline:
+    def test_round_trip_from_synthetic_chain(self):
+        """Generate a year of hourly cloud cover with the shipped params,
+        re-fit, and require agreement for the well-populated bins."""
+        rng = np.random.default_rng(4)
+        series = chain_numpy(rng, 5 * 8760, initial_state=0.5)
+        fits = fitting.fit_all(series)
+        params = np.asarray(MARKOV_STEP_PARAMS)
+        checked = 0
+        for b, fit in enumerate(fits):
+            if fit is None or fit.n < 2000:
+                continue
+            loc, scale = params[b, 0], params[b, 1]
+            assert fit.loc == pytest.approx(loc, abs=0.05)
+            assert fit.scale == pytest.approx(scale, rel=0.6)
+            checked += 1
+        assert checked >= 3  # the chain dwells in several bins over 5 years
+
+    def test_bin_membership_matches_runtime(self):
+        """bin_steps uses the same searchsorted convention as the chain."""
+        series = np.asarray([0.05, 0.5, 0.95, 1.0, 0.05])
+        per_bin = fitting.bin_steps(series)
+        assert per_bin[0].size == 1   # from 0.05
+        assert per_bin[2].size == 1   # from 0.5
+        assert per_bin[4].size == 1   # from 0.95
+        assert per_bin[5].size == 1   # from 1.0
+
+    def test_format_table(self):
+        rng = np.random.default_rng(5)
+        series = chain_numpy(rng, 8760)
+        out = fitting.format_params_table(fitting.fit_all(series))
+        assert out.startswith("MARKOV_STEP_PARAMS = (")
+        assert out.count("\n") >= 12
+
+
+def test_load_csv(tmp_path):
+    p = tmp_path / "tcc.csv"
+    np.savetxt(p, np.asarray([10.0, 50.0, 90.0]), delimiter=",")
+    v = fitting.load_total_cloud_cover(str(p))
+    np.testing.assert_allclose(v, [0.1, 0.5, 0.9])

@@ -41,14 +41,26 @@ async def read_meter_values(queue: asyncio.Queue, realtime: bool,
 
 
 async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
-    """Publisher loop with forever-retry (metersim.py:13-47)."""
+    """Publisher loop with forever-retry (metersim.py:13-47).
+
+    A value dequeued when publish fails is held across the reconnect and
+    re-sent first (the reference gets the same no-loss property from
+    ``asyncio.shield``, metersim.py:43-45) — and ``task_done`` always
+    matches its ``get``, so a bounded run's ``queue.join()`` cannot hang on
+    a failed publish.
+    """
+    pending = None
 
     @asyncretry(delay=5, attempts=forever)
     async def run():
+        nonlocal pending
         async with make_transport(url, exchange) as transport:
             while True:
-                time, value = await queue.get()
+                if pending is None:
+                    pending = await queue.get()
+                time, value = pending
                 await transport.publish(value, time)
+                pending = None
                 queue.task_done()
 
     await run()

@@ -50,16 +50,37 @@ async def read_pv_values(funnel: SynchronizingFunnel, realtime: bool,
         await funnel.put(time, pv=model.next(time))
 
 
-async def read_transport(funnel: SynchronizingFunnel, url, exchange) -> None:
+async def read_transport(funnel: SynchronizingFunnel, url, exchange,
+                         counter: Optional[dict] = None) -> None:
     """Meter consumer with forever-retry (pvsim.py:43-70)."""
 
     @asyncretry(delay=5, attempts=forever)
     async def run():
         async with make_transport(url, exchange) as transport:
             async for time, value in transport.subscribe():
+                if counter is not None:
+                    counter["meter"] = counter.get("meter", 0) + 1
                 await funnel.put(time, meter=value)
 
     await run()
+
+
+async def _no_meter_watchdog(counter: dict, url, timeout_s: float = 10.0):
+    """Warn once when no meter message arrived within ``timeout_s`` — the
+    symptom of pointing pvsim at a broker no metersim publishes to (and,
+    with local:// URLs, of running the pair in separate processes: the
+    in-process broker cannot span OS processes)."""
+    await asyncio.sleep(timeout_s)
+    if counter.get("meter", 0) == 0:
+        extra = (
+            " local:// transports are in-process only — metersim must run "
+            "inside the same process to join." if (url or "local://")
+            .startswith("local://") else ""
+        )
+        logger.warning(
+            "no meter messages received after %.0f s; is metersim "
+            "publishing to this exchange?%s", timeout_s, extra,
+        )
 
 
 async def write_file(filename: str, queue: asyncio.Queue) -> None:
@@ -80,10 +101,13 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
     """App orchestrator (pvsim.py:86-101)."""
     queue: asyncio.Queue = asyncio.Queue()
     funnel = SynchronizingFunnel(Data, queue)
+    counter: dict = {}
+    watchdog = asyncio.create_task(_no_meter_watchdog(counter, amqp_url))
     tasks = [
         asyncio.create_task(read_pv_values(funnel, realtime, seed,
                                            duration_s, start)),
-        asyncio.create_task(read_transport(funnel, amqp_url, exchange)),
+        asyncio.create_task(read_transport(funnel, amqp_url, exchange,
+                                           counter)),
         asyncio.create_task(write_file(file, queue)),
     ]
     try:
@@ -95,6 +119,7 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
     finally:
         for t in tasks:
             t.cancel()
+        watchdog.cancel()
         if len(funnel) > 0:
             logger.warning(
                 "%d undelivered meter_values have been lost", len(funnel)
@@ -103,10 +128,20 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
 
 def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               start: Optional[str] = None, chain: int = 0,
-              sharded: bool = False) -> None:
-    """The JAX backend: blockwise device simulation straight to CSV."""
+              sharded: bool = False,
+              checkpoint: Optional[str] = None) -> None:
+    """The JAX backend: blockwise device simulation straight to CSV.
+
+    With ``checkpoint``, state is saved after every block and an existing
+    checkpoint resumes the run (appending to the CSV) — restart-safe long
+    simulations, which the reference cannot do at all (SURVEY.md §5).
+    """
+    import os
+    from zoneinfo import ZoneInfo
+
     from tmhpvsim_tpu.config import SimConfig
-    from tmhpvsim_tpu.engine import Simulation
+    from tmhpvsim_tpu.engine import Simulation, checkpoint as ckpt
+    from tmhpvsim_tpu.engine.profiling import BlockTimer
     from tmhpvsim_tpu.engine.simulation import write_csv
 
     if start is None:
@@ -124,7 +159,46 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         sim = ShardedSimulation(cfg)
     else:
         sim = Simulation(cfg)
-    from zoneinfo import ZoneInfo
 
-    write_csv(file, sim.run_blocks(), chain=chain,
-              tz=ZoneInfo(cfg.site.timezone))
+    state, start_block = None, 0
+    if checkpoint and os.path.exists(checkpoint):
+        state, start_block = ckpt.load(checkpoint, cfg)
+        logger.info("resuming from %s at block %d", checkpoint, start_block)
+        # Exactly-once CSV rows: a crash can land between "rows of block b
+        # written" and "checkpoint for b saved", leaving extra rows from
+        # block start_block in the file.  Truncate back to the checkpoint.
+        _truncate_csv(file, 1 + min(cfg.duration_s,
+                                    start_block * cfg.block_s))
+
+    timer = BlockTimer(cfg.n_chains, cfg.block_s)
+
+    def blocks():
+        for bi, blk in enumerate(
+            sim.run_blocks(state=state, start_block=start_block),
+            start=start_block,
+        ):
+            timer.tick()
+            yield blk
+            # control returns here after write_csv wrote (and line-flushed)
+            # this block's rows — only then is the checkpoint advanced, so
+            # a crash can duplicate work but never lose rows
+            if checkpoint:
+                ckpt.save(checkpoint, sim.state, bi + 1, cfg)
+
+    write_csv(file, blocks(), chain=chain, tz=ZoneInfo(cfg.site.timezone),
+              append=start_block > 0)
+    timer.summary()
+
+
+def _truncate_csv(path: str, keep_lines: int) -> None:
+    """Truncate ``path`` to its first ``keep_lines`` lines (no-op when the
+    file is missing or already short enough)."""
+    import os
+
+    if not os.path.exists(path):
+        return
+    with open(path, "r+") as f:
+        for _ in range(keep_lines):
+            if not f.readline():
+                return  # fewer lines than the checkpoint expects
+        f.truncate(f.tell())

@@ -87,8 +87,11 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start):
               help="Which chain to write to FILE (jax backend)")
 @click.option("--sharded/--no-sharded", default=False,
               help="Shard chains over all available devices (jax backend)")
+@click.option("--checkpoint", default=None,
+              help="Checkpoint file: saved per block, resumed when present "
+                   "(jax backend)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
-          start, backend, n_chains, chain, sharded):
+          start, backend, n_chains, chain, sharded, checkpoint):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     if backend == "jax":
@@ -96,8 +99,24 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 
         if duration_s is None:
             raise click.UsageError("--duration is required with --backend=jax")
-        pvsim_jax(file, duration_s, n_chains, seed or 0, start, chain,
-                  sharded)
+        if seed is None:
+            import os as _os
+
+            if checkpoint and _os.path.exists(checkpoint):
+                # resuming without --seed: adopt the checkpoint's seed (a
+                # fresh random one would fail the config echo check)
+                from tmhpvsim_tpu.engine import checkpoint as _ckpt
+
+                seed = _ckpt.peek_meta(checkpoint).get(
+                    "config", {}).get("seed")
+            if seed is None:
+                # honour the advertised nondeterministic default ('seed or
+                # 0' would collapse every unseeded run onto seed 0)
+                import secrets
+
+                seed = secrets.randbits(31)
+        pvsim_jax(file, duration_s, n_chains, seed, start, chain,
+                  sharded, checkpoint)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

@@ -1,0 +1,110 @@
+"""Checkpoint/resume for the blockwise simulation.
+
+The reference has no checkpointing at all — every restart loses the whole
+stochastic state (SURVEY.md §5).  Here the design makes it nearly free: all
+simulation state is one pytree of arrays plus a block offset
+(engine/simulation.py), and every random draw is keyed by global index, so
+``save -> restart -> load -> resume`` reproduces the uninterrupted run
+bit-for-bit (verified by test_checkpoint.py).
+
+Format: a single ``.npz`` with '/'-joined pytree paths; PRNG key arrays are
+stored via ``jax.random.key_data`` under a ``key:`` prefix and re-wrapped on
+load.  No orbax dependency — the state is a few MB and plain npz keeps the
+file greppable and future-proof.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+import jax
+import numpy as np
+
+_KEY_PREFIX = "key:"
+_META = "__meta__"
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for name, value in tree.items():
+        path = f"{prefix}{name}"
+        if isinstance(value, dict):
+            out.update(_flatten(value, path + "/"))
+        elif jax.dtypes.issubdtype(value.dtype, jax.dtypes.prng_key):
+            out[_KEY_PREFIX + path] = np.asarray(jax.random.key_data(value))
+        else:
+            out[path] = np.asarray(value)
+    return out
+
+
+def _unflatten(flat):
+    tree = {}
+    for path, value in flat.items():
+        if path.startswith(_KEY_PREFIX):
+            path = path[len(_KEY_PREFIX):]
+            value = jax.random.wrap_key_data(value)
+        node = tree
+        *parents, leaf = path.split("/")
+        for p in parents:
+            node = node.setdefault(p, {})
+        node[leaf] = value
+    return tree
+
+
+def save(path: str, state, next_block: int, config=None) -> None:
+    """Write state + resume point (+ config echo for sanity checks).
+
+    Atomic: writes ``path + '.tmp'`` then ``os.replace``s it, so a crash
+    mid-save never corrupts the previous good checkpoint.  Writing through
+    an open file object also keeps the exact filename (bare ``np.savez``
+    silently appends '.npz', which would break resume-by-existence checks).
+    """
+    import os
+
+    flat = _flatten(state)
+    meta = {"next_block": int(next_block)}
+    if config is not None:
+        meta["config"] = {
+            "start": config.start,
+            "duration_s": config.duration_s,
+            "n_chains": config.n_chains,
+            "seed": config.seed,
+            "block_s": config.block_s,
+            "dtype": config.dtype,
+        }
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat, **{_META: json.dumps(meta)})
+    os.replace(tmp, path)
+
+
+def peek_meta(path: str) -> dict:
+    """Read only the metadata record (resume point + config echo)."""
+    with np.load(path, allow_pickle=False) as data:
+        return json.loads(str(data[_META]))
+
+
+def load(path: str, config=None) -> Tuple[dict, int]:
+    """Read (state, next_block); verifies the config echo when given."""
+    with np.load(path, allow_pickle=False) as data:
+        meta = json.loads(str(data[_META]))
+        flat = {k: data[k] for k in data.files if k != _META}
+    if config is not None and "config" in meta:
+        saved = meta["config"]
+        current = {
+            "start": config.start,
+            "duration_s": config.duration_s,
+            "n_chains": config.n_chains,
+            "seed": config.seed,
+            "block_s": config.block_s,
+            "dtype": config.dtype,
+        }
+        if saved != current:
+            diffs = {k: (saved[k], current[k]) for k in saved
+                     if saved[k] != current.get(k)}
+            raise ValueError(
+                f"checkpoint was written by a different configuration: "
+                f"{diffs}"
+            )
+    return _unflatten(flat), meta["next_block"]

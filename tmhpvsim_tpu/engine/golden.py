@@ -27,13 +27,9 @@ from typing import Optional
 import numpy as np
 
 from tmhpvsim_tpu.config import ModelOptions, Site
-from tmhpvsim_tpu.data import (
-    MARKOV_STEP_BINS,
-    MARKOV_STEP_PARAMS,
-    SANDIA_INVERTER,
-    SAPM_MODULE,
-)
+from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
 from tmhpvsim_tpu.models import pv as pvmod
+from tmhpvsim_tpu.models.markov_hourly import transition_numpy
 from tmhpvsim_tpu.models import solar
 from tmhpvsim_tpu.models.clearsky_index import (
     CSI_CLEAR_DAY_LOC,
@@ -48,33 +44,6 @@ from tmhpvsim_tpu.models.clearsky_index import (
     SIGMA_SEC_FACTOR,
 )
 from tmhpvsim_tpu.models.renewal import ReferenceRenewal
-
-_BINS = np.asarray(MARKOV_STEP_BINS)
-_PARAMS = np.asarray(MARKOV_STEP_PARAMS)
-
-
-def _asymmetric_laplace_rvs(rng, loc, scale, kappa):
-    """Inverse-CDF sample of the asymmetric Laplace (same closed form as
-    models/distributions.py, float64)."""
-    u = rng.uniform()
-    k2 = kappa * kappa
-    if u < k2 / (1 + k2):
-        x = kappa * np.log((1 + k2) / k2 * u)
-    else:
-        x = -np.log((1 + k2) * (1 - u)) / kappa
-    return loc + scale * x
-
-
-def markov_step(rng, state: float) -> float:
-    """One hourly cloud-cover Markov transition (cloud_cover_hourly.py:313-316)."""
-    loc, scale, kappa, df, is_t = _PARAMS[
-        np.searchsorted(_BINS, state, side="left")
-    ]
-    if is_t > 0.5:
-        step = loc + scale * rng.standard_t(df)
-    else:
-        step = _asymmetric_laplace_rvs(rng, loc, scale, kappa)
-    return float(np.clip(state + step, 0.0, 1.0))
 
 
 class _Sampler:
@@ -113,7 +82,7 @@ class GoldenClearskyIndex:
         self._cc_state = 1.0
 
         def draw_cc():
-            nxt = markov_step(self.rng, self._cc_state)
+            nxt = transition_numpy(self.rng, self._cc_state)
             if self.options.persistent_cloud_chain:
                 self._cc_state = nxt
             return nxt

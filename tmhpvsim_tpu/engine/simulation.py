@@ -285,7 +285,7 @@ class Simulation:
 
 
 def write_csv(path: str, blocks: Iterator[BlockResult], chain: int = 0,
-              tz=None):
+              tz=None, append: bool = False):
     """Write the reference CSV format — header ``time,meter,pv,residual
     load``, one row per second (pvsim.py:78-83) — for one selected chain.
 
@@ -293,13 +293,16 @@ def write_csv(path: str, blocks: Iterator[BlockResult], chain: int = 0,
     column; rows are written as naive local datetimes like the reference's
     (which prints the fixedclock's naive local grid).  Default: the
     process's local timezone.  Pass the site's ZoneInfo to get site-local
-    rows regardless of host timezone.
+    rows regardless of host timezone.  ``append`` skips the header and adds
+    to an existing file (checkpoint resume).
     """
     import csv
 
-    with open(path, mode="w", newline="", buffering=1) as f:
+    mode = "a" if append else "w"
+    with open(path, mode=mode, newline="", buffering=1) as f:
         w = csv.writer(f)
-        w.writerow(["time", "meter", "pv", "residual load"])
+        if not append:
+            w.writerow(["time", "meter", "pv", "residual load"])
         for blk in blocks:
             for e, m, p, r in zip(
                 blk.epoch, blk.meter[chain], blk.pv[chain], blk.residual[chain]

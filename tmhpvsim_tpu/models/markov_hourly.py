@@ -96,29 +96,39 @@ def iid_from_one(key, n_samples, dtype=jnp.float32):
 # ---------------------------------------------------------------------------
 
 
-def chain_numpy(rng: np.random.Generator, n_samples, initial_state=1.0):
-    """Pure-numpy persistent chain for distributional parity tests.
+_BINS64 = np.asarray(MARKOV_STEP_BINS, dtype=np.float64)
+_PARAMS64 = np.asarray(MARKOV_STEP_PARAMS, dtype=np.float64)
+
+
+def transition_numpy(rng: np.random.Generator, state: float) -> float:
+    """One float64 transition — shared by the golden streaming model
+    (engine/golden.py) and `chain_numpy`.
 
     Independent implementation of the same mathematical model (inverse-CDF
-    sampling from numpy uniforms / standard_t), *not* the same RNG stream as
-    `chain` — comparisons are distributional (SURVEY.md §7 hard part (c)).
+    sampling from numpy uniforms / standard_t), *not* the same RNG stream
+    as `transition` — comparisons are distributional (SURVEY.md §7 hard
+    part (c)).
     """
-    p = np.asarray(MARKOV_STEP_PARAMS, dtype=np.float64)
-    bins = np.asarray(MARKOV_STEP_BINS, dtype=np.float64)
+    idx = np.searchsorted(_BINS64, state, side="left")
+    loc, scale, kappa, df, is_t = _PARAMS64[min(idx, len(_PARAMS64) - 1)]
+    if is_t > 0.5:
+        step = loc + scale * rng.standard_t(df)
+    else:
+        u = rng.uniform()
+        k2 = kappa * kappa
+        if u < k2 / (1 + k2):
+            x = kappa * np.log((1 + k2) / k2 * u)
+        else:
+            x = -np.log((1 + k2) * (1 - u)) / kappa
+        step = loc + scale * x
+    return float(np.clip(state + step, 0.0, 1.0))
+
+
+def chain_numpy(rng: np.random.Generator, n_samples, initial_state=1.0):
+    """Pure-numpy persistent chain for distributional parity tests."""
     state = float(np.clip(initial_state, 0.0, 1.0))
     out = np.empty(n_samples)
     for i in range(n_samples):
-        loc, scale, kappa, df, is_t = p[np.searchsorted(bins, state, side="left")]
-        if is_t > 0.5:
-            step = loc + scale * rng.standard_t(df)
-        else:
-            u = rng.uniform()
-            k2 = kappa * kappa
-            if u < k2 / (1 + k2):
-                x = kappa * np.log((1 + k2) / k2 * u)
-            else:
-                x = -np.log((1 + k2) * (1 - u)) / kappa
-            step = loc + scale * x
-        state = float(np.clip(state + step, 0.0, 1.0))
+        state = transition_numpy(rng, state)
         out[i] = state
     return out

@@ -1,0 +1,1 @@
+"""Offline tools (parameter fitting) — never imported by the runtime."""

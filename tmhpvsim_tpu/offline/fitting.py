@@ -1,0 +1,205 @@
+"""Markov-step shape-parameter fitting — a *working* offline pipeline.
+
+The reference ships an MCMC fitting pipeline for the hourly cloud-cover
+step distributions that is broken end to end (undefined names, impossible
+bins, wrong call signatures; SURVEY.md §2.2: cloud_cover_hourly.py:118-267)
+— its only surviving artifact is the shipped CSV of fitted shapes.  This
+module re-implements the pipeline so the vendored parameters
+(data/parameters.py MARKOV_STEP_PARAMS) can actually be re-derived from
+data:
+
+1. bin an hourly cloud-cover series by *current* state into the six
+   model bins (cloud_cover_hourly.py:1-21 module docstring semantics —
+   the broken code's ``bins=[-2e-4, -1.0, ...]`` is nonsense and its
+   ``shift(-2)`` contradicts the documented one-step process);
+2. collect the one-hour steps taken from each bin;
+3. fit an asymmetric-Laplace and a location-scale Student-t to each bin's
+   steps by maximum likelihood (scipy.optimize — deterministic and
+   dependency-light, replacing 8000-draw NUTS chains);
+4. select per bin by AIC and emit rows in the MARKOV_STEP_PARAMS layout
+   ``(loc, scale, kappa, df, is_t)``.
+
+Input series can come from any source; ``load_total_cloud_cover`` reads
+ERA-5 netcdf when xarray is available (gated import — the runtime never
+needs it), or a plain CSV of hourly values in [0, 1].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from tmhpvsim_tpu.data import MARKOV_STEP_BINS
+
+_LOG2PI = math.log(2.0 * math.pi)
+
+
+# ---------------------------------------------------------------------------
+# data loading / binning
+# ---------------------------------------------------------------------------
+
+
+def load_total_cloud_cover(path: str) -> np.ndarray:
+    """Hourly total cloud cover in [0, 1] from a .nc (ERA-5 'tcc') or a
+    single-column CSV file."""
+    if path.endswith(".nc"):
+        try:
+            import xarray as xr
+        except ImportError as err:
+            raise RuntimeError(
+                "reading netcdf requires xarray; convert to CSV instead"
+            ) from err
+        ds = xr.open_dataset(path)
+        name = "tcc" if "tcc" in ds else list(ds.data_vars)[0]
+        values = np.asarray(ds[name]).ravel()
+    else:
+        values = np.loadtxt(path, delimiter=",", ndmin=1).ravel()
+    values = values[np.isfinite(values)]
+    if values.size and values.max() > 1.5:
+        values = values / 100.0  # percent-encoded cloud cover
+    return np.clip(values, 0.0, 1.0)
+
+
+def bin_steps(series: np.ndarray,
+              bins: Sequence[float] = MARKOV_STEP_BINS):
+    """Per-bin one-hour step samples.
+
+    Returns a list (one entry per bin) of arrays of ``x[i+1] - x[i]`` for
+    all i whose *current* state x[i] falls in that bin — the documented
+    Markov semantics (cloud_cover_hourly.py:1-21) with the same
+    ``searchsorted(side='left')`` membership the runtime chain uses.
+    """
+    series = np.asarray(series, dtype=np.float64)
+    steps = np.diff(series)
+    state = series[:-1]
+    idx = np.searchsorted(np.asarray(bins), state, side="left")
+    idx = np.clip(idx, 0, len(bins) - 1)
+    return [steps[idx == b] for b in range(len(bins))]
+
+
+# ---------------------------------------------------------------------------
+# maximum-likelihood fits
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Fit:
+    loc: float
+    scale: float
+    kappa: float       # AL only (1.0 for t)
+    df: float          # t only (1.0 for AL)
+    is_t: bool
+    nll: float         # negative log-likelihood at the optimum
+    n: int
+
+    @property
+    def aic(self) -> float:
+        return 2 * 3 + 2 * self.nll  # both families have 3 parameters
+
+    def as_row(self) -> Tuple[float, float, float, float, float]:
+        """(loc, scale, kappa, df, is_t) — MARKOV_STEP_PARAMS layout."""
+        return (self.loc, self.scale, self.kappa, self.df,
+                1.0 if self.is_t else 0.0)
+
+
+def _al_nll(params, x):
+    """Negative log-likelihood of the asymmetric Laplace in the reference's
+    parameterisation (cloud_cover_hourly.py:93-106): density
+    exp(-kappa*z) for z >= 0, exp(z/kappa) for z < 0, z=(x-loc)/scale,
+    normalised by 1/(scale*(kappa + 1/kappa))."""
+    loc, log_scale, log_kappa = params
+    scale, kappa = math.exp(log_scale), math.exp(log_kappa)
+    z = (x - loc) / scale
+    expo = np.where(z >= 0, kappa * z, -z / kappa)
+    return x.size * math.log(scale * (kappa + 1.0 / kappa)) + expo.sum()
+
+
+def fit_asymmetric_laplace(x: np.ndarray) -> Fit:
+    from scipy.optimize import minimize
+
+    x = np.asarray(x, dtype=np.float64)
+    med, mad = np.median(x), np.median(np.abs(x - np.median(x))) + 1e-9
+    best = None
+    for kappa0 in (0.5, 1.0, 2.0):
+        res = minimize(
+            _al_nll, x0=[med, math.log(mad), math.log(kappa0)], args=(x,),
+            method="Nelder-Mead",
+            options={"xatol": 1e-10, "fatol": 1e-10, "maxiter": 4000},
+        )
+        if best is None or res.fun < best.fun:
+            best = res
+    loc, log_scale, log_kappa = best.x
+    return Fit(loc=float(loc), scale=math.exp(log_scale),
+               kappa=math.exp(log_kappa), df=1.0, is_t=False,
+               nll=float(best.fun), n=x.size)
+
+
+def _t_nll(params, x):
+    from scipy.special import gammaln
+
+    loc, log_scale, log_df = params
+    scale, df = math.exp(log_scale), math.exp(log_df)
+    z = (x - loc) / scale
+    return -(
+        x.size * (
+            gammaln((df + 1) / 2) - gammaln(df / 2)
+            - 0.5 * math.log(df * math.pi) - math.log(scale)
+        )
+        - (df + 1) / 2 * np.log1p(z * z / df).sum()
+    )
+
+
+def fit_student_t(x: np.ndarray) -> Fit:
+    from scipy.optimize import minimize
+
+    x = np.asarray(x, dtype=np.float64)
+    med, mad = np.median(x), np.median(np.abs(x - np.median(x))) + 1e-9
+    res = minimize(
+        _t_nll, x0=[med, math.log(mad), math.log(5.0)], args=(x,),
+        method="Nelder-Mead",
+        options={"xatol": 1e-10, "fatol": 1e-10, "maxiter": 4000},
+    )
+    loc, log_scale, log_df = res.x
+    return Fit(loc=float(loc), scale=math.exp(log_scale), kappa=1.0,
+               df=math.exp(log_df), is_t=True, nll=float(res.fun), n=x.size)
+
+
+def fit_bin(x: np.ndarray, min_samples: int = 30) -> Optional[Fit]:
+    """Best-AIC fit of one bin's steps; None when the bin is too thin."""
+    if x.size < min_samples:
+        return None
+    al, st = fit_asymmetric_laplace(x), fit_student_t(x)
+    return al if al.aic <= st.aic else st
+
+
+def fit_all(series: np.ndarray,
+            bins: Sequence[float] = MARKOV_STEP_BINS,
+            min_samples: int = 30):
+    """Fit every bin; returns list of Optional[Fit] aligned with ``bins``."""
+    return [fit_bin(x, min_samples) for x in bin_steps(series, bins)]
+
+
+def format_params_table(fits, bins: Sequence[float] = MARKOV_STEP_BINS
+                        ) -> str:
+    """Render fits as a MARKOV_STEP_PARAMS-style Python tuple literal,
+    ready to paste into data/parameters.py (the modern equivalent of the
+    reference's shapes.csv artifact)."""
+    lines = ["MARKOV_STEP_PARAMS = ("]
+    prev = -1e-4
+    for edge, fit in zip(bins, fits):
+        lines.append(f"    # ({prev:g}, {edge:g}]  "
+                     + ("Student-t" if fit and fit.is_t
+                        else "asymmetric Laplace" if fit else "UNFIT"))
+        if fit is None:
+            lines.append("    # (insufficient samples)")
+        else:
+            loc, scale, kappa, df, is_t = fit.as_row()
+            lines.append(
+                f"    ({loc!r}, {scale!r}, {kappa!r}, {df!r}, {is_t!r}),"
+            )
+        prev = edge
+    lines.append(")")
+    return "\n".join(lines)
