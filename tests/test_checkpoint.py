@@ -69,45 +69,77 @@ def test_config_mismatch_rejected(tmp_path):
         ckpt.load(path, cfg(seed=14))
 
 
-def test_cli_checkpoint_resume(tmp_path):
-    """Interrupted CLI run + resumed run == single run, row for row."""
-    whole = tmp_path / "whole.csv"
-    r = CliRunner().invoke(cli_main, [
-        "pvsim", str(whole), "--backend=jax", "--duration", "360",
+def _cli_jax(*extra):
+    return CliRunner().invoke(cli_main, [
+        "pvsim", *extra, "--backend=jax", "--duration", "360",
         "--seed", "9", "--start", "2019-09-05 10:00:00",
+        "--block-s", "120",
     ])
+
+
+def test_cli_checkpoint_crash_resume(tmp_path, monkeypatch):
+    """THE resume guarantee, via the real CLI path: crash after block 0,
+    re-invoke with the same --checkpoint, final CSV identical to an
+    uninterrupted run (exercises _truncate_csv, append mode, and the
+    checkpoint flag wiring end to end)."""
+    whole = tmp_path / "whole.csv"
+    r = _cli_jax(str(whole))
     assert r.exit_code == 0, r.output
 
-    # simulate an interrupt: run only the first block by running a shorter
-    # duration against the same checkpoint file, then the full duration
     part = tmp_path / "part.csv"
     ck = tmp_path / "ck.npz"
 
-    cfg_ = SimConfig(start="2019-09-05 10:00:00", duration_s=360,
-                     n_chains=1, seed=9, block_s=180)
-    from tmhpvsim_tpu.engine import Simulation as Sim
-    from tmhpvsim_tpu.engine.simulation import write_csv
-    from zoneinfo import ZoneInfo
+    # crash the run after block 0's rows are written and checkpoint saved:
+    # ckpt.save raises on its second call (i.e. after block 1's rows)
+    import tmhpvsim_tpu.engine.checkpoint as ckmod
 
-    s = Sim(cfg_)
-    it = s.run_blocks()
-    first = next(it)
-    write_csv(str(part), iter([first]), tz=ZoneInfo("Europe/Berlin"))
-    ckpt.save(str(ck), s.state, 1, cfg_)
+    real_save = ckmod.save
+    calls = {"n": 0}
 
-    s2 = Sim(cfg_)
-    state, nb = ckpt.load(str(ck), cfg_)
-    rest = list(s2.run_blocks(state=state, start_block=nb))
-    write_csv(str(part), iter(rest), tz=ZoneInfo("Europe/Berlin"),
-              append=True)
+    def dying_save(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated crash")
+        return real_save(*a, **kw)
+
+    monkeypatch.setattr(ckmod, "save", dying_save)
+    r = _cli_jax(str(part), "--checkpoint", str(ck))
+    assert r.exit_code != 0  # crashed mid-run
+    monkeypatch.setattr(ckmod, "save", real_save)
+
+    # the crash window left rows beyond the checkpoint -> resume must
+    # truncate them and complete the file exactly
+    with open(part) as f:
+        assert len(f.readlines()) > 1 + 120
+
+    r = _cli_jax(str(part), "--checkpoint", str(ck))
+    assert r.exit_code == 0, r.output
 
     with open(part) as f:
         part_rows = list(csv.reader(f))
-    # independent straight run at the same block size for comparison
-    whole2 = tmp_path / "whole2.csv"
-    s3 = Sim(cfg_)
-    write_csv(str(whole2), s3.run_blocks(), tz=ZoneInfo("Europe/Berlin"))
-    with open(whole2) as f:
+    with open(whole) as f:
         whole_rows = list(csv.reader(f))
     assert part_rows == whole_rows
     assert len(part_rows) == 1 + 360
+
+
+def test_cli_resume_missing_csv_rejected(tmp_path):
+    """Resuming against a deleted CSV must fail loudly, not fabricate a
+    headerless partial file."""
+    part = tmp_path / "part.csv"
+    ck = tmp_path / "ck.npz"
+    r = _cli_jax(str(part), "--checkpoint", str(ck))
+    assert r.exit_code == 0, r.output
+    # checkpoint says "done"; shorten it to mid-run and delete the CSV
+    state, _ = ckpt.load(str(ck))
+    meta_cfg = ckpt.peek_meta(str(ck))["config"]
+    cfg_ = SimConfig(
+        start=meta_cfg["start"], duration_s=meta_cfg["duration_s"],
+        n_chains=meta_cfg["n_chains"], seed=meta_cfg["seed"],
+        block_s=meta_cfg["block_s"], dtype=meta_cfg["dtype"],
+    )
+    ckpt.save(str(ck), state, 1, cfg_)
+    part.unlink()
+    r = _cli_jax(str(part), "--checkpoint", str(ck))
+    assert r.exit_code != 0
+    assert "restore the CSV" in str(r.exception)

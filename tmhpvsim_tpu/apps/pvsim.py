@@ -129,7 +129,8 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
 def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               start: Optional[str] = None, chain: int = 0,
               sharded: bool = False,
-              checkpoint: Optional[str] = None) -> None:
+              checkpoint: Optional[str] = None,
+              block_s: Optional[int] = None) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -146,12 +147,14 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
 
     if start is None:
         start = _dt.datetime.now().replace(microsecond=0).isoformat(" ")
+    if block_s is None:
+        block_s = min(8640, max(60, (duration_s // 60) * 60))
     cfg = SimConfig(
         start=start,
         duration_s=duration_s,
         n_chains=n_chains,
         seed=seed,
-        block_s=min(8640, max(60, (duration_s // 60) * 60)),
+        block_s=block_s,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation
@@ -166,9 +169,17 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         logger.info("resuming from %s at block %d", checkpoint, start_block)
         # Exactly-once CSV rows: a crash can land between "rows of block b
         # written" and "checkpoint for b saved", leaving extra rows from
-        # block start_block in the file.  Truncate back to the checkpoint.
-        _truncate_csv(file, 1 + min(cfg.duration_s,
-                                    start_block * cfg.block_s))
+        # block start_block in the file.  Truncate back to the checkpoint —
+        # and refuse to resume against a missing/short CSV (appending there
+        # would silently fabricate a gap-ridden headerless file).
+        expect = 1 + min(cfg.duration_s, start_block * cfg.block_s)
+        got = _truncate_csv(file, expect)
+        if got < expect:
+            raise RuntimeError(
+                f"checkpoint {checkpoint} expects {expect} existing lines "
+                f"in {file} but found {got}; restore the CSV that belongs "
+                f"to this checkpoint or delete the checkpoint to restart"
+            )
 
     timer = BlockTimer(cfg.n_chains, cfg.block_s)
 
@@ -190,15 +201,18 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     timer.summary()
 
 
-def _truncate_csv(path: str, keep_lines: int) -> None:
-    """Truncate ``path`` to its first ``keep_lines`` lines (no-op when the
-    file is missing or already short enough)."""
+def _truncate_csv(path: str, keep_lines: int) -> int:
+    """Truncate ``path`` to its first ``keep_lines`` lines; returns the
+    number of lines actually present afterwards (0 for a missing file)."""
     import os
 
     if not os.path.exists(path):
-        return
+        return 0
     with open(path, "r+") as f:
+        n = 0
         for _ in range(keep_lines):
             if not f.readline():
-                return  # fewer lines than the checkpoint expects
+                return n  # fewer lines than the checkpoint expects
+            n += 1
         f.truncate(f.tell())
+        return n

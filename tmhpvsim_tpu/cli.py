@@ -90,8 +90,11 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start):
 @click.option("--checkpoint", default=None,
               help="Checkpoint file: saved per block, resumed when present "
                    "(jax backend)")
+@click.option("--block-s", type=int, default=None,
+              help="Seconds per device block, multiple of 60 (jax backend; "
+                   "default: min(8640, duration))")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
-          start, backend, n_chains, chain, sharded, checkpoint):
+          start, backend, n_chains, chain, sharded, checkpoint, block_s):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     if backend == "jax":
@@ -116,7 +119,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 
                 seed = secrets.randbits(31)
         pvsim_jax(file, duration_s, n_chains, seed, start, chain,
-                  sharded, checkpoint)
+                  sharded, checkpoint, block_s)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

@@ -15,6 +15,7 @@ file greppable and future-proof.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from typing import Tuple
 
@@ -23,6 +24,23 @@ import numpy as np
 
 _KEY_PREFIX = "key:"
 _META = "__meta__"
+
+
+def _config_echo(config) -> dict:
+    """The full run configuration as JSON-able data — including site and
+    model options, whose silent divergence across a resume would change
+    physics/branch selection mid-trace."""
+    return {
+        "start": config.start,
+        "duration_s": config.duration_s,
+        "n_chains": config.n_chains,
+        "seed": config.seed,
+        "block_s": config.block_s,
+        "dtype": config.dtype,
+        "site": dataclasses.asdict(config.site),
+        "options": dataclasses.asdict(config.options),
+        "meter_max_w": config.meter_max_w,
+    }
 
 
 def _flatten(tree, prefix=""):
@@ -65,14 +83,7 @@ def save(path: str, state, next_block: int, config=None) -> None:
     flat = _flatten(state)
     meta = {"next_block": int(next_block)}
     if config is not None:
-        meta["config"] = {
-            "start": config.start,
-            "duration_s": config.duration_s,
-            "n_chains": config.n_chains,
-            "seed": config.seed,
-            "block_s": config.block_s,
-            "dtype": config.dtype,
-        }
+        meta["config"] = _config_echo(config)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **flat, **{_META: json.dumps(meta)})
@@ -92,14 +103,7 @@ def load(path: str, config=None) -> Tuple[dict, int]:
         flat = {k: data[k] for k in data.files if k != _META}
     if config is not None and "config" in meta:
         saved = meta["config"]
-        current = {
-            "start": config.start,
-            "duration_s": config.duration_s,
-            "n_chains": config.n_chains,
-            "seed": config.seed,
-            "block_s": config.block_s,
-            "dtype": config.dtype,
-        }
+        current = json.loads(json.dumps(_config_echo(config)))  # tuple->list
         if saved != current:
             diffs = {k: (saved[k], current[k]) for k in saved
                      if saved[k] != current.get(k)}

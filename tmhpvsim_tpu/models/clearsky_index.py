@@ -207,7 +207,15 @@ def init_renewal(key, arrays, dtype=jnp.float32):
 
 def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
                    options: ModelOptions, dtype=jnp.float32):
-    """Scan the seconds of one block for one chain.
+    """One block of per-second csi for one chain.
+
+    TPU layout: the *only* sequential dependency is the renewal carry, so
+    the ``lax.scan`` body is ~15 flops consuming pre-drawn uniforms; all
+    RNG hashing (one threefry per global second index — counter-based, so
+    results are block-partition invariant) and the whole sampler-
+    interpolation/composition pipeline run as batched elementwise ops
+    outside the scan, where the VPU parallelises them across lanes instead
+    of serialising them across simulated seconds.
 
     Parameters
     ----------
@@ -226,46 +234,51 @@ def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
     )
     mc = minute_vals["noise_min_cloudy"]
     ml = minute_vals["noise_min_clear"]
+    t, h, d, m = (block_idx["t"], block_idx["hour_idx"],
+                  block_idx["day_idx"], block_idx["min_idx"])
+    hf, df, mf = (block_idx["hour_frac"], block_idx["day_frac"],
+                  block_idx["min_frac"])
+    cd = h + d
 
+    # --- batched counter-based RNG (parallel; same key tree as a per-step
+    # fold_in+split, so traces are bit-identical under any block split)
+    kt = jax.vmap(lambda i: jax.random.fold_in(key, i))(t)
+    ks = jax.vmap(jax.random.split)(kt)
+    u_cycle = jax.vmap(lambda k: jax.random.uniform(k, (), dtype))(ks[:, 0])
+    z_sec = jax.vmap(lambda k: jax.random.normal(k, (), dtype))(ks[:, 1])
+
+    # --- elementwise sampler interpolation over the block
+    cc_t = cc[h] * (1 - hf) + cc[h + 1] * hf
+    ws_t = ws[d] * (1 - df) + ws[d + 1] * df
+
+    # second-scale noise: both branches use the *clear* sigmas
+    # (clearskyindexmodel.py:146-147,152,158)
+    s0, s1 = NOISE_CLEAR
+    noise_sec = SIGMA_SEC_FACTOR * (s0 + s1 * 8.0 * cc_t) * z_sec
+
+    base_clear = clear_day[cd] * (1 - df) + clear_day[cd + 1] * df
+    # reference-compat: the cloudy sampler never advances, so its pair
+    # index stays 0 (clearskyindexmodel.py:101-111 advances every sampler
+    # except this one)
+    h_c = h if options.advance_cloudy_hour else jnp.zeros_like(h)
+    base_cloudy = cloudy[h_c] * (1 - hf) + cloudy[h_c + 1] * hf
+    mrel = m - minute_lo
+    nmin_clear = ml[mrel] * (1 - mf) + ml[mrel + 1] * mf
+    nmin_cloudy = mc[mrel] * (1 - mf) + mc[mrel + 1] * mf
+
+    # --- minimal sequential core: the renewal process alone
     def body(c, x):
-        t, h, d, m, hf, df, mf, cd = (
-            x["t"], x["hour_idx"], x["day_idx"], x["min_idx"],
-            x["hour_frac"], x["day_frac"], x["min_frac"], x["cd_idx"],
-        )
-        kt = jax.random.fold_in(key, t)
-        k_renew, k_sec = jax.random.split(kt)
+        return renewal.step_from_u(c, x["u"], x["cc"], x["ws"], dtype)
 
-        cc_t = cc[h] * (1 - hf) + cc[h + 1] * hf
-        ws_t = ws[d] * (1 - df) + ws[d + 1] * df
+    carry, covered = jax.lax.scan(
+        body, carry, {"u": u_cycle, "cc": cc_t, "ws": ws_t}
+    )
 
-        c2, covered = renewal.step(c, k_renew, cc_t, ws_t, dtype)
-
-        # second-scale noise: both branches use the *clear* sigmas
-        # (clearskyindexmodel.py:146-147,152,158)
-        s0, s1 = NOISE_CLEAR
-        sigma_sec = SIGMA_SEC_FACTOR * (s0 + s1 * 8.0 * cc_t)
-        noise_sec = sigma_sec * jax.random.normal(k_sec, (), dtype)
-
-        base_clear = clear_day[cd] * (1 - df) + clear_day[cd + 1] * df
-        # reference-compat: the cloudy sampler never advances, so its pair
-        # index stays 0 (clearskyindexmodel.py:101-111 advances every sampler
-        # except this one)
-        h_c = h if options.advance_cloudy_hour else jnp.zeros_like(h)
-        base_cloudy = cloudy[h_c] * (1 - hf) + cloudy[h_c + 1] * hf
-        mrel = m - minute_lo
-        nmin_clear = ml[mrel] * (1 - mf) + ml[mrel + 1] * mf
-        nmin_cloudy = mc[mrel] * (1 - mf) + mc[mrel + 1] * mf
-
-        is_cov = covered > 0.5
-        use_clear = is_cov if not options.swap_covered_branches else ~is_cov
-        base = jnp.where(use_clear, base_clear, base_cloudy)
-        nmin = jnp.where(use_clear, nmin_clear, nmin_cloudy)
-        return c2, (base * (nmin + noise_sec), covered)
-
-    xs = dict(block_idx)
-    xs["cd_idx"] = block_idx["hour_idx"] + block_idx["day_idx"]
-    carry, (csi, covered) = jax.lax.scan(body, carry, xs)
-    return carry, csi, covered
+    is_cov = covered > 0.5
+    use_clear = is_cov if not options.swap_covered_branches else ~is_cov
+    base = jnp.where(use_clear, base_clear, base_cloudy)
+    nmin = jnp.where(use_clear, nmin_clear, nmin_cloudy)
+    return carry, base * (nmin + noise_sec), covered
 
 
 def host_block_index(spec: TimeGridSpec, offset: int, length: int,

@@ -72,35 +72,49 @@ CLOUD_LENGTH_XMIN_M = 0.1e3
 CLOUD_LENGTH_XMAX_M = 1e6
 
 
-def truncated_powerlaw(key, xmin, xmax, beta, shape=(), dtype=jnp.float32):
-    """Sample P(x) ~ x**(-beta) truncated to [xmin, xmax] by inverse CDF.
-
-    Same sampling transform the reference applies for cloud lengths
+def truncated_powerlaw_from_u(u, xmin, xmax, beta):
+    """Inverse CDF of P(x) ~ x**(-beta) on [xmin, xmax] applied to
+    uniforms ``u`` — the transform the reference applies for cloud lengths
     (cloud_cover_binary.py:25-40): with a = xmax^(1-beta),
     d = xmin^(1-beta) - a, x = (a + d*U)^(1/(1-beta)).
+
+    Exposed separately so hot scans can consume *pre-generated* uniform
+    arrays (batched counter-based RNG outside the scan) instead of hashing
+    keys inside the sequential body (models/clearsky_index.py).
     """
     one_m_beta = 1.0 - beta
     a = xmax**one_m_beta
     d = xmin**one_m_beta - a
-    u = jax.random.uniform(key, shape, dtype=dtype)
     return (a + d * u) ** (1.0 / one_m_beta)
+
+
+def truncated_powerlaw(key, xmin, xmax, beta, shape=(), dtype=jnp.float32):
+    """Keyed sampling via :func:`truncated_powerlaw_from_u`."""
+    u = jax.random.uniform(key, shape, dtype=dtype)
+    return truncated_powerlaw_from_u(u, xmin, xmax, beta)
+
+
+def cloud_length_seconds_from_u(u, windspeed, xmax_m=CLOUD_LENGTH_XMAX_M):
+    """Cloud transit time [s] from a pre-drawn uniform: power-law length [m]
+    / windspeed [m/s].
+
+    ``xmax_m`` may be an array — the TPU renewal kernel truncates the length
+    distribution instead of rejection-sampling (see models/renewal.py); the
+    clamp keeps the truncation bound above the distribution's support floor.
+    """
+    xmax_m = jnp.maximum(xmax_m, 2.0 * CLOUD_LENGTH_XMIN_M)
+    return truncated_powerlaw_from_u(
+        u, CLOUD_LENGTH_XMIN_M, xmax_m, CLOUD_LENGTH_BETA
+    ) / windspeed
 
 
 def cloud_length_seconds(key, windspeed, xmax_m=CLOUD_LENGTH_XMAX_M, shape=None,
                          dtype=jnp.float32):
-    """Cloud transit time [s]: power-law length [m] / windspeed [m/s].
-
-    ``xmax_m`` may be an array — the TPU renewal kernel truncates the length
-    distribution instead of rejection-sampling (see models/renewal.py).
-    """
+    """Keyed wrapper over :func:`cloud_length_seconds_from_u`."""
     if shape is None:
         shape = jnp.broadcast_shapes(jnp.shape(windspeed), jnp.shape(xmax_m))
-    xmax_m = jnp.maximum(xmax_m, 2.0 * CLOUD_LENGTH_XMIN_M)
-    return (
-        truncated_powerlaw(key, CLOUD_LENGTH_XMIN_M, xmax_m, CLOUD_LENGTH_BETA,
-                           shape, dtype)
-        / windspeed
-    )
+    u = jax.random.uniform(key, shape, dtype=dtype)
+    return cloud_length_seconds_from_u(u, windspeed, xmax_m)
 
 
 # --------------------------------------------------------------------------

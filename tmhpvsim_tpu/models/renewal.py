@@ -54,18 +54,26 @@ MAX_CLOUDCOVER = 0.95
 # ---------------------------------------------------------------------------
 
 
-def _draw_cycle(key, cloudcover, windspeed, dtype):
-    """Draw one (cloud_length, total_length) cycle.
+def _cycle_from_u(u, cloudcover, windspeed):
+    """One (cloud_length, total_length) cycle from a pre-drawn uniform.
 
     Cloud transit time from the power law truncated so that the full cycle
     cloud/cc stays under MAX_CYCLE_S; clear interval from the exact cloud-
-    fraction constraint.
+    fraction constraint.  Taking ``u`` (not a key) lets the per-second scan
+    consume batch-generated uniforms — no RNG hashing in the sequential
+    body (models/clearsky_index.py csi_scan_block).
     """
     cc = jnp.clip(cloudcover, 1e-3, MAX_CLOUDCOVER)
     cap_m = MAX_CYCLE_S * cc * windspeed  # length cap in metres
-    cloud = dist.cloud_length_seconds(key, windspeed, xmax_m=cap_m, dtype=dtype)
+    cloud = dist.cloud_length_seconds_from_u(u, windspeed, xmax_m=cap_m)
     total = cloud / cc
     return cloud, total
+
+
+def _draw_cycle(key, cloudcover, windspeed, dtype):
+    """Keyed wrapper over :func:`_cycle_from_u`."""
+    u = jax.random.uniform(key, jnp.shape(cloudcover), dtype=dtype)
+    return _cycle_from_u(u, cloudcover, windspeed)
 
 
 def init(key, cloudcover, windspeed, dtype=jnp.float32):
@@ -77,9 +85,10 @@ def init(key, cloudcover, windspeed, dtype=jnp.float32):
     return {"cloud_end": cloud, "total_end": total, "sec": sec}
 
 
-def step(carry, key, cloudcover, windspeed, dtype=jnp.float32):
+def step_from_u(carry, u, cloudcover, windspeed, dtype=jnp.float32):
     """Advance one second; returns (carry, covered) with covered in {0., 1.}.
 
+    ``u`` is this step's pre-drawn uniform (consumed only on cycle redraw);
     `cloudcover`/`windspeed` are the *current-second* interpolated values, so
     a redraw sees up-to-date parameters — the same effect as the reference
     calling update_parameters before every step (clearskyindexmodel.py:133-136).
@@ -87,13 +96,19 @@ def step(carry, key, cloudcover, windspeed, dtype=jnp.float32):
     sec = carry["sec"] + 1.0
     redraw = sec >= carry["total_end"]
 
-    cloud_new, total_new = _draw_cycle(key, cloudcover, windspeed, dtype)
+    cloud_new, total_new = _cycle_from_u(u, cloudcover, windspeed)
     cloud_end = jnp.where(redraw, cloud_new, carry["cloud_end"])
     total_end = jnp.where(redraw, total_new, carry["total_end"])
     sec = jnp.where(redraw, jnp.ones_like(sec), sec)
 
     covered = (sec < cloud_end).astype(dtype)
     return {"cloud_end": cloud_end, "total_end": total_end, "sec": sec}, covered
+
+
+def step(carry, key, cloudcover, windspeed, dtype=jnp.float32):
+    """Keyed wrapper over :func:`step_from_u` (tests / ad-hoc use)."""
+    u = jax.random.uniform(key, jnp.shape(cloudcover), dtype=dtype)
+    return step_from_u(carry, u, cloudcover, windspeed, dtype)
 
 
 # ---------------------------------------------------------------------------
