@@ -73,6 +73,25 @@ def test_cli_jax_requires_duration(tmp_path):
     assert "--duration" in r.output
 
 
+def test_cli_pvsim_jax_realtime_paces(tmp_path):
+    """--backend=jax honours --realtime: rows are released on the 1 Hz
+    wall clock (the reference's default streaming mode)."""
+    import time
+
+    out = tmp_path / "rt.csv"
+    t0 = time.perf_counter()
+    r = CliRunner().invoke(
+        cli_main,
+        ["pvsim", str(out), "--backend=jax", "--duration", "3",
+         "--seed", "5", "--start", "2019-09-05 10:00:00"],
+    )
+    elapsed = time.perf_counter() - t0
+    assert r.exit_code == 0, r.output
+    with open(out) as f:
+        assert len(f.readlines()) == 1 + 3
+    assert elapsed >= 2.0  # 3 rows at 1 Hz (first fires immediately)
+
+
 def test_cli_metersim_bounded():
     r = CliRunner().invoke(
         cli_main,

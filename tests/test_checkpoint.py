@@ -71,9 +71,9 @@ def test_config_mismatch_rejected(tmp_path):
 
 def _cli_jax(*extra):
     return CliRunner().invoke(cli_main, [
-        "pvsim", *extra, "--backend=jax", "--duration", "360",
-        "--seed", "9", "--start", "2019-09-05 10:00:00",
-        "--block-s", "120",
+        "pvsim", *extra, "--backend=jax", "--no-realtime",
+        "--duration", "360", "--seed", "9",
+        "--start", "2019-09-05 10:00:00", "--block-s", "120",
     ])
 
 

@@ -130,12 +130,17 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               start: Optional[str] = None, chain: int = 0,
               sharded: bool = False,
               checkpoint: Optional[str] = None,
-              block_s: Optional[int] = None) -> None:
+              block_s: Optional[int] = None,
+              realtime: bool = False) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
     checkpoint resumes the run (appending to the CSV) — restart-safe long
     simulations, which the reference cannot do at all (SURVEY.md §5).
+
+    With ``realtime``, rows are released on the 1 Hz wall-clock grid (the
+    reference's default streaming mode) while the device simulates blocks
+    ahead — tail the CSV and it ticks once a second.
     """
     import os
     from zoneinfo import ZoneInfo
@@ -189,7 +194,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             start=start_block,
         ):
             timer.tick()
-            yield blk
+            if realtime:
+                yield from _paced(blk)
+            else:
+                yield blk
             # control returns here after write_csv wrote (and line-flushed)
             # this block's rows — only then is the checkpoint advanced, so
             # a crash can duplicate work but never lose rows
@@ -199,6 +207,27 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     write_csv(file, blocks(), chain=chain, tz=ZoneInfo(cfg.site.timezone),
               append=start_block > 0)
     timer.summary()
+
+
+def _paced(blk, rate: float = 1.0):
+    """Re-emit a BlockResult as single-row blocks on the wall-clock grid —
+    the jax backend's analogue of fixedclock realtime pacing."""
+    import dataclasses
+    import time
+
+    t0 = time.monotonic()
+    for i in range(len(blk.epoch)):
+        behind = (time.monotonic() - t0) - i / rate
+        if behind < 0:
+            time.sleep(-behind)
+        yield dataclasses.replace(
+            blk,
+            offset=blk.offset + i,
+            epoch=blk.epoch[i : i + 1],
+            meter=blk.meter[:, i : i + 1],
+            pv=blk.pv[:, i : i + 1],
+            residual=blk.residual[:, i : i + 1],
+        )
 
 
 def _truncate_csv(path: str, keep_lines: int) -> int:

@@ -119,7 +119,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 
                 seed = secrets.randbits(31)
         pvsim_jax(file, duration_s, n_chains, seed, start, chain,
-                  sharded, checkpoint, block_s)
+                  sharded, checkpoint, block_s, realtime=realtime)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

@@ -31,6 +31,67 @@ class Site:
 
 
 @dataclasses.dataclass(frozen=True)
+class SiteGrid:
+    """Per-chain site parameters for multi-site runs (BASELINE config #3:
+    "10k-site lat/lon grid").
+
+    Each field is a length-n sequence; chain i simulates site i with its
+    solar geometry evaluated *on device* from a float32-safe split-time
+    representation (models/solar.py device_geometry) — host float64
+    precompute per site would not scale.  The timezone (and hence the
+    stochastic model's rollover calendar) and the turbidity climatology are
+    shared across the grid; per-site climatologies can be added by widening
+    ``linke_turbidity_monthly`` to one row per site.
+    """
+
+    latitude: tuple
+    longitude: tuple
+    altitude: tuple
+    surface_tilt: tuple
+    surface_azimuth: tuple
+    albedo: tuple = None
+    timezone: str = "Europe/Berlin"
+    linke_turbidity_monthly: tuple = LINKE_TURBIDITY_MONTHLY_MUNICH
+
+    def __post_init__(self):
+        n = len(self.latitude)
+        for f in ("longitude", "altitude", "surface_tilt",
+                  "surface_azimuth"):
+            if len(getattr(self, f)) != n:
+                raise ValueError(f"SiteGrid.{f} must have length {n}")
+        if self.albedo is None:
+            object.__setattr__(self, "albedo", (0.25,) * n)
+        elif len(self.albedo) != n:
+            raise ValueError(f"SiteGrid.albedo must have length {n}")
+
+    def __len__(self):
+        return len(self.latitude)
+
+    @classmethod
+    def regular(cls, lat_range, lon_range, n_lat: int, n_lon: int,
+                altitude: float = 100.0, tilt=None, azimuth: float = 180.0,
+                **kw):
+        """A regular n_lat x n_lon lat/lon mesh; tilt defaults to latitude
+        (the reference's tilt-equals-latitude convention, pvmodel.py:24)."""
+        import numpy as _np
+
+        lats = _np.linspace(*lat_range, n_lat)
+        lons = _np.linspace(*lon_range, n_lon)
+        glat, glon = _np.meshgrid(lats, lons, indexing="ij")
+        glat, glon = glat.ravel(), glon.ravel()
+        tilts = glat if tilt is None else _np.full_like(glat, tilt)
+        n = glat.size
+        return cls(
+            latitude=tuple(glat),
+            longitude=tuple(glon),
+            altitude=(altitude,) * n,
+            surface_tilt=tuple(tilts),
+            surface_azimuth=(azimuth,) * n,
+            **kw,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ModelOptions:
     """Behavioural switches for the stochastic model.
 
@@ -76,6 +137,8 @@ class SimConfig:
     n_chains: int = 1                    # independent stochastic realisations
     seed: int = 0
     site: Site = dataclasses.field(default_factory=Site)
+    #: per-chain sites (overrides `site`/`n_chains`: chain i = grid site i)
+    site_grid: Optional[SiteGrid] = None
     options: ModelOptions = dataclasses.field(default_factory=ModelOptions)
 
     #: meter demand upper bound [W]; reference draws uniform [0, 9000)

@@ -88,10 +88,17 @@ class Simulation:
     def __init__(self, config: SimConfig):
         if config.block_s % 60 != 0:
             raise ValueError("block_s must be a multiple of 60 (minute grid)")
+        if config.site_grid is not None and \
+                config.n_chains != len(config.site_grid):
+            config = dataclasses.replace(
+                config, n_chains=len(config.site_grid)
+            )
         self.config = config
+        tz = (config.site_grid.timezone if config.site_grid is not None
+              else config.site.timezone)
         self._padded_s = _round_up(config.duration_s, config.block_s)
         self.spec = TimeGridSpec.from_local_start(
-            config.start, self._padded_s, config.site.timezone
+            config.start, self._padded_s, tz
         )
         self.feats = ci.HostFeatures.from_spec(self.spec)
         self.dtype = jnp.dtype(config.dtype)
@@ -128,7 +135,21 @@ class Simulation:
             }
 
         keys = jax.random.split(self._k_chains, self.config.n_chains)
-        return jax.jit(jax.vmap(one))(keys)
+        state = jax.jit(jax.vmap(one))(keys)
+        grid = self.config.site_grid
+        if grid is not None:
+            # per-chain site parameters live in the state pytree: they get
+            # the chain sharding, ride through shard_map specs, and land in
+            # checkpoints without any special-casing
+            state["site"] = {
+                "latitude": jnp.asarray(grid.latitude, dtype),
+                "longitude": jnp.asarray(grid.longitude, dtype),
+                "altitude": jnp.asarray(grid.altitude, dtype),
+                "surface_tilt": jnp.asarray(grid.surface_tilt, dtype),
+                "surface_azimuth": jnp.asarray(grid.surface_azimuth, dtype),
+                "albedo": jnp.asarray(grid.albedo, dtype),
+            }
+        return state
 
     # ------------------------------------------------------------------
     # host-side per-block inputs (chain-independent, float64 precompute)
@@ -159,21 +180,33 @@ class Simulation:
         )
 
         blk = self.spec.block(off, cfg.block_s)
-        geom64 = solar.block_geometry(
-            blk.epoch.astype(np.float64), blk.doy.astype(np.float64),
-            cfg.site, xp=np,
-        )
-        geom = {
-            k: (jnp.asarray(v, dtype=self.dtype)
-                if isinstance(v, np.ndarray) else v)
-            for k, v in geom64.items()
-        }
-        return {
+        inputs = {
             "block_idx": block_idx,
             "mlo": jnp.asarray(mlo, dtype=jnp.int32),
             "mfeats": mfeats,
-            "geom": geom,
-        }, blk.epoch
+        }
+        if cfg.site_grid is None:
+            # shared site: exact float64 geometry on the host, cast once
+            geom64 = solar.block_geometry(
+                blk.epoch.astype(np.float64), blk.doy.astype(np.float64),
+                cfg.site, xp=np,
+            )
+            inputs["geom"] = {
+                k: (jnp.asarray(v, dtype=self.dtype)
+                    if isinstance(v, np.ndarray) else v)
+                for k, v in geom64.items()
+            }
+        else:
+            # per-chain sites: ship the float32-safe split time; geometry
+            # is evaluated on device per chain (solar.device_geometry)
+            inputs["time_split"] = {
+                "day2000": jnp.asarray(blk.epoch // 86400 - 10957,
+                                       dtype=self.dtype),
+                "sec_of_day": jnp.asarray(blk.epoch % 86400,
+                                          dtype=self.dtype),
+                "doy": jnp.asarray(blk.doy, dtype=self.dtype),
+            }
+        return inputs, blk.epoch
 
     # ------------------------------------------------------------------
     # device block step (jitted once; shapes constant across blocks)

@@ -134,6 +134,72 @@ def sun_position(epoch_s, latitude_deg, longitude_deg, xp=jnp):
     }
 
 
+def sun_position_split(day2000, sec_of_day, latitude_deg, longitude_deg,
+                       xp=jnp):
+    """PSA+ sun position from a float32-safe *split* time representation.
+
+    ``day2000`` = whole days since 2000-01-01 00:00 UT (int or float,
+    < 2^24 so exact in float32), ``sec_of_day`` = seconds within that UT
+    day.  Each ephemeris term multiplies the coefficient by the day and
+    fraction parts separately, so nothing ever forms the raw ~1.7e9 epoch:
+    worst-case float32 error is ~0.01 deg of zenith — the device-side
+    geometry path used for per-chain site grids, where host float64
+    precompute per site would not scale (engine/simulation.py uses the
+    float64 host path when all chains share one site).
+
+    Same return dict as :func:`sun_position`.
+    """
+    lat = latitude_deg * DEG
+    lon = longitude_deg * DEG
+
+    frac = sec_of_day / 86400.0 - 0.5  # days relative to 12:00 UT
+    hour_ut = sec_of_day / 3600.0
+
+    def lin(const, coeff):
+        # const + coeff*te with te = day2000 + frac, parts kept separate
+        return (const + coeff * day2000) + coeff * frac
+
+    omega = lin(2.267127827e0, -9.300339267e-4)
+    mean_lon = lin(4.895036035e0, 1.720279602e-2)
+    mean_anom = lin(6.239468336e0, 1.720200135e-2)
+    ecl_lon = (
+        mean_lon
+        + 3.338320972e-2 * xp.sin(mean_anom)
+        + 3.497596876e-4 * xp.sin(2.0 * mean_anom)
+        - 1.544353226e-4
+        - 8.689729360e-6 * xp.sin(omega)
+    )
+    obliquity = lin(4.090904909e-1, -6.213605399e-9) \
+        + 4.418094944e-5 * xp.cos(omega)
+
+    sin_l = xp.sin(ecl_lon)
+    ra = xp.arctan2(xp.cos(obliquity) * sin_l, xp.cos(ecl_lon)) % TWO_PI
+    dec = xp.arcsin(xp.sin(obliquity) * sin_l)
+
+    # gmst hours: keep the large day product in its own mod-24 reduction
+    gmst_h = (6.697096103e0 + 6.570984737e-2 * day2000) % 24.0 \
+        + 6.570984737e-2 * frac + hour_ut
+    lmst = gmst_h * 15.0 * DEG + lon
+    ha = lmst - ra
+
+    cos_lat, sin_lat = xp.cos(lat), xp.sin(lat)
+    cos_dec, sin_dec = xp.cos(dec), xp.sin(dec)
+    cos_ha = xp.cos(ha)
+
+    cos_zen = cos_lat * cos_ha * cos_dec + sin_dec * sin_lat
+    cos_zen = xp.clip(cos_zen, -1.0, 1.0)
+    zenith = xp.arccos(cos_zen)
+    azimuth = xp.arctan2(
+        -xp.sin(ha), xp.tan(dec) * cos_lat - sin_lat * cos_ha
+    ) % TWO_PI
+    zenith = zenith + _PARALLAX * xp.sin(zenith)
+    return {
+        "zenith": zenith,
+        "azimuth": azimuth,
+        "cos_zenith": xp.cos(zenith),
+    }
+
+
 def apparent_elevation(zenith, pressure=STD_PRESSURE, temperature_c=12.0,
                        xp=jnp):
     """Refraction-corrected elevation [rad] from true zenith.
@@ -330,6 +396,47 @@ def haydavies_poa(surface_tilt_deg, cos_aoi, zenith, ghi, dni, dhi,
         "poa_direct": poa_direct,
         "poa_diffuse": poa_diffuse,
         "poa_global": poa_direct + poa_diffuse,
+    }
+
+
+def device_geometry(day2000, sec_of_day, doy, latitude_deg, longitude_deg,
+                    altitude_m, surface_tilt_deg, surface_azimuth_deg,
+                    albedo, turbidity_monthly, xp=jnp):
+    """All geometry features from split time + scalar site parameters —
+    float32-safe, jit/vmap-friendly (the per-chain site-grid path).
+
+    Site parameters are scalars (vmap them over a grid); time arrays are
+    shared.  Returns the same dict as :func:`block_geometry`.
+    """
+    pos = sun_position_split(day2000, sec_of_day, latitude_deg,
+                             longitude_deg, xp=xp)
+    pressure = alt2pres(altitude_m)
+    app_elev = apparent_elevation(pos["zenith"], pressure, xp=xp)
+    app_zen = np.pi / 2.0 - app_elev
+
+    am_rel = relative_airmass_kasten_young(app_zen, xp=xp)
+    am_abs = am_rel * pressure / STD_PRESSURE
+
+    dni_extra = extra_radiation_spencer(doy, xp=xp)
+    tl = linke_turbidity(doy, turbidity_monthly, xp=xp)
+    ghi_clear = ineichen_ghi(app_zen, am_abs, tl, altitude_m, dni_extra,
+                             xp=xp)
+    cos_aoi = angle_of_incidence_cos(
+        surface_tilt_deg, surface_azimuth_deg, app_zen, pos["azimuth"], xp=xp
+    )
+    return {
+        "zenith": pos["zenith"],
+        "cos_zenith": pos["cos_zenith"],
+        "apparent_zenith": app_zen,
+        "azimuth": pos["azimuth"],
+        "csi_cap": csi_zenith_cap(pos["zenith"], xp=xp),
+        "ghi_clear": ghi_clear,
+        "dni_extra": dni_extra,
+        "airmass_abs": am_abs,
+        "cos_aoi": cos_aoi,
+        "doy": xp.asarray(doy),
+        "surface_tilt": surface_tilt_deg,
+        "albedo": albedo,
     }
 
 
