@@ -92,6 +92,63 @@ def test_cli_pvsim_jax_realtime_paces(tmp_path):
     assert elapsed >= 2.0  # 3 rows at 1 Hz (first fires immediately)
 
 
+def test_cli_pvsim_jax_reduce_mode(tmp_path):
+    """--output=reduce: per-chain summary rows + ensemble row, no trace."""
+    out = tmp_path / "red.csv"
+    r = CliRunner().invoke(
+        cli_main,
+        ["pvsim", str(out), "--backend=jax", "--no-realtime",
+         "--duration", "180", "--seed", "5", "--chains", "4",
+         "--start", "2019-09-05 10:00:00", "--output", "reduce"],
+    )
+    assert r.exit_code == 0, r.output
+    with open(out) as f:
+        rows = list(csv.reader(f))
+    assert rows[0][0] == "chain"
+    assert len(rows) == 1 + 4 + 1  # header + chains + ensemble
+    assert rows[-1][0] == "ensemble"
+    ns = rows[0].index("n_seconds")
+    assert all(int(float(row[ns])) == 180 for row in rows[1:-1])
+    pv_sum = rows[0].index("pv_sum")
+    chain_total = sum(float(row[pv_sum]) for row in rows[1:-1])
+    assert float(rows[-1][pv_sum]) == pytest.approx(chain_total, rel=1e-4)
+
+
+def test_cli_pvsim_site_grid(tmp_path):
+    """--site-grid: one chain per grid site, end to end through the CLI."""
+    out = tmp_path / "grid.csv"
+    r = CliRunner().invoke(
+        cli_main,
+        ["pvsim", str(out), "--backend=jax", "--no-realtime",
+         "--duration", "120", "--seed", "5",
+         "--start", "2019-09-05 10:00:00",
+         "--site-grid", "46:50:2,9:13:2", "--output", "reduce"],
+    )
+    assert r.exit_code == 0, r.output
+    with open(out) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 1 + 4 + 1  # 2x2 grid -> 4 chains
+
+
+def test_cli_pvsim_profile_writes_trace(tmp_path):
+    """--profile: a jax.profiler trace directory is produced."""
+    import os
+
+    out = tmp_path / "prof.csv"
+    tdir = tmp_path / "trace"
+    r = CliRunner().invoke(
+        cli_main,
+        ["pvsim", str(out), "--backend=jax", "--no-realtime",
+         "--duration", "60", "--seed", "5",
+         "--start", "2019-09-05 10:00:00", "--profile", str(tdir)],
+    )
+    assert r.exit_code == 0, r.output
+    # the profiler lays out plugins/profile/<run>/...; existence of any
+    # file under the dir is the contract
+    found = [os.path.join(d, f) for d, _, fs in os.walk(tdir) for f in fs]
+    assert found, f"no profiler output under {tdir}"
+
+
 def test_cli_metersim_bounded():
     r = CliRunner().invoke(
         cli_main,

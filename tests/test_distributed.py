@@ -1,0 +1,129 @@
+"""Multi-host (multi-process) smoke tests: the pod-slice execution model.
+
+The reference's only multi-process story is N consumers on one RabbitMQ
+broker (SURVEY.md §2.4); the TPU-native equivalent is one JAX process per
+host joined through ``jax.distributed`` (parallel/distributed.py), a mesh
+spanning all hosts' devices, and collectives over ICI/DCN.
+
+Real TPU pods aren't available in CI, so this does what the JAX ecosystem
+does: two coordinated CPU processes on localhost, each owning 4 virtual
+devices, forming one 8-device global mesh — which exercises exactly the
+``process_count() > 1`` paths (initialize_from_env, cross-process psum,
+local_chain_slice) that a pod slice uses.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+from tmhpvsim_tpu.parallel.distributed import (
+    initialize_from_env, local_chain_slice,
+)
+
+assert initialize_from_env(), "env vars set; should have initialised"
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 4
+assert jax.device_count() == 8
+
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+from jax.experimental import multihost_utils
+
+from tmhpvsim_tpu.parallel import make_mesh
+from tmhpvsim_tpu.parallel.mesh import CHAIN_AXIS
+
+mesh = make_mesh()  # global: both processes' devices
+assert mesh.devices.size == 8
+
+# Each process contributes its local half of a 16-chain vector; the psum
+# must see all 16 global chains -> the DCN analogue of the block step's
+# ensemble reduction (parallel/mesh.py).
+pid = jax.process_index()
+local = np.full((8,), float(pid + 1))
+arr = multihost_utils.host_local_array_to_global_array(
+    local, mesh, P(CHAIN_AXIS)
+)
+total = jax.jit(shard_map(
+    lambda x: jax.lax.psum(x.sum(), CHAIN_AXIS),
+    mesh=mesh, in_specs=P(CHAIN_AXIS), out_specs=P(),
+    check_vma=False,
+))(arr)
+assert float(total) == 8 * 1.0 + 8 * 2.0, float(total)
+
+# Each host owns the contiguous half of the chain axis its devices hold.
+sl = local_chain_slice(16, mesh)
+expect = slice(0, 8) if pid == 0 else slice(8, 16)
+assert (sl.start, sl.stop) == (expect.start, expect.stop), sl
+
+print(f"DISTOK {pid}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_smoke():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+        )
+        # the parent's 8-device XLA_FLAGS would fight jax_num_cpu_devices
+        env.pop("XLA_FLAGS", None)
+        # the axon sitecustomize (PYTHONPATH) eagerly initialises a backend,
+        # which forbids jax.distributed.initialize afterwards — a TPU pod
+        # launcher initialises distributed first, so drop it here
+        env.pop("PYTHONPATH", None)
+        for k in list(env):
+            if k.startswith(("AXON_", "PALLAS_AXON_")):
+                env.pop(k)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=360)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    assert "DISTOK 0" in outs[0][1]
+    assert "DISTOK 1" in outs[1][1]
+
+
+def test_initialize_from_env_noop_single_process():
+    """Without coordinator env vars the runtime must stay single-process."""
+    from tmhpvsim_tpu.parallel.distributed import initialize_from_env
+
+    saved = {k: os.environ.pop(k, None)
+             for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES")}
+    try:
+        assert initialize_from_env() is False
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v

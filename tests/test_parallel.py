@@ -80,3 +80,50 @@ def test_local_chain_slice_single_process():
     sim = ShardedSimulation(cfg())
     sl = local_chain_slice(8, sim.mesh)
     assert (sl.start, sl.stop) == (0, 8)  # single process owns everything
+
+
+class TestShardedReduce:
+    """Reduce mode under shard_map: the scalable-output path for the 100k+
+    chain configs (BASELINE #4/#5) — per-chain traces never reach the host,
+    the accumulator stays sharded, the ensemble is one psum tree."""
+
+    def test_matches_single_chip(self):
+        r_single = Simulation(cfg()).run_reduced()
+        r_sharded = ShardedSimulation(cfg()).run_reduced()
+        assert set(r_single) == set(r_sharded)
+        for k in r_single:
+            np.testing.assert_allclose(
+                r_sharded[k], r_single[k], rtol=2e-5, atol=2e-2,
+            )
+
+    def test_accumulator_stays_sharded(self):
+        sim = ShardedSimulation(cfg())
+        sim.run_reduced()
+        sh = sim._last_acc["pv_sum"].sharding
+        assert sh.is_equivalent_to(chain_sharding(sim.mesh), ndim=1)
+
+    def test_ensemble_matches_numpy(self):
+        sim = ShardedSimulation(cfg())
+        per_chain = sim.run_reduced()
+        ens = sim.ensemble_stats()
+        assert ens["n_seconds"] == int(per_chain["n_seconds"].sum())
+        np.testing.assert_allclose(ens["pv_sum"], per_chain["pv_sum"].sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(ens["pv_max"], per_chain["pv_max"].max(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            ens["residual_min"], per_chain["residual_min"].min(), rtol=1e-6,
+        )
+
+    def test_counts_only_valid_seconds(self):
+        # duration not a multiple of block_s: padding must not be counted
+        c = cfg(duration_s=2700, block_s=1800)
+        r = ShardedSimulation(c).run_reduced()
+        assert (r["n_seconds"] == 2700).all()
+
+    def test_local_view_single_process(self):
+        sim = ShardedSimulation(cfg())
+        reduced = sim.run_reduced()
+        sl, local = sim.local_reduced_view(reduced)
+        assert (sl.start, sl.stop) == (0, 8)
+        np.testing.assert_array_equal(local["pv_sum"], reduced["pv_sum"])

@@ -1,0 +1,276 @@
+"""Tightened CPU<->JAX parity harness (round-1 verdict items 4/6).
+
+RNG-stream equivalence between scipy/numpy and counter-based ``jax.random``
+is impossible (SURVEY.md §7 hard part (c)), so parity means *statistical*
+parity — and the round-1 harness only bounded ensemble means loosely
+(|dmean| < 0.15 on a mean-1 process).  This file replaces that with tests
+that would actually fail on a mis-set sigma or a swapped branch:
+
+* **component-level two-sample KS tests** at large N, where the iid premise
+  holds: per-bin Markov step distributions, cloudy-csi draws per cloud-
+  cover band, minute/second noise sigmas (golden float64 numpy vs JAX).
+  A whole-stream KS would be statistically invalid here: the csi stream's
+  hour-scale modes (cloud cover, hourly/daily base samplers) give an
+  effective sample size of ~n_chains regardless of stream length, so KS
+  p-values on strided streams reject on shared slow-mode noise, not model
+  error (measured: identical Markov chains, D=0.013-0.021, p>0.35 at
+  N=4000/step — while the composed 16-chain stream shows D=0.08).
+* **end-to-end moment parity with self-calibrated tolerance**: the
+  golden-vs-JAX pooled mean/std must agree within 4 combined standard
+  errors estimated from the per-chain spread — an honest bound that
+  tightens automatically as the ensemble grows;
+* a **sensitivity counterpart** proving the end-to-end statistic rejects a
+  mis-configured model (swapped covered-branches) by a wide margin;
+* a quantified **float32-vs-float64 budget**: pathwise over one simulated
+  year of the deterministic physics chain; moment-level for the stochastic
+  csi path (pathwise is impossible across dtypes: different draw bits).
+"""
+
+import datetime as dt
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+from tmhpvsim_tpu.config import ModelOptions, Site
+from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
+from tmhpvsim_tpu.engine.golden import GoldenClearskyIndex
+from tmhpvsim_tpu.models import clearsky_index as ci
+from tmhpvsim_tpu.models import markov_hourly as mh
+from tmhpvsim_tpu.models import pv as pvmod
+from tmhpvsim_tpu.models import renewal as rnw
+from tmhpvsim_tpu.models import solar
+from tmhpvsim_tpu.models.timegrid import TimeGridSpec
+
+N_CHAINS = 16
+N_SEC = 2 * 3600
+START = dt.datetime(2019, 9, 5, 10, 0)
+START_STR = "2019-09-05 10:00:00"
+
+
+def _golden_ensemble(opts: ModelOptions, seed0: int = 100) -> np.ndarray:
+    out = np.empty((N_CHAINS, N_SEC))
+    for c in range(N_CHAINS):
+        m = GoldenClearskyIndex(START, opts, np.random.default_rng(seed0 + c))
+        for i in range(N_SEC):
+            out[c, i] = m.next(START + dt.timedelta(seconds=i))
+    return out
+
+
+def _jax_ensemble(opts: ModelOptions, dtype=jnp.float64,
+                  seed: int = 3) -> np.ndarray:
+    spec = TimeGridSpec.from_local_start(START_STR, N_SEC)
+    feats = ci.HostFeatures.from_spec(spec)
+    block_idx, (mlo, mhi) = ci.host_block_index(spec, 0, N_SEC, dtype)
+
+    def one(key):
+        k_arr, k_min, k_renew, k_scan = jax.random.split(key, 4)
+        arrays = ci.build_chain_arrays(k_arr, feats, opts, dtype)
+        mvals = ci.minute_noise_values(k_min, arrays["cc"], spec, mlo, mhi,
+                                       dtype)
+        carry = ci.init_renewal(k_renew, arrays, dtype)
+        _, csi, _ = ci.csi_scan_block(k_scan, arrays, mvals, mlo, carry,
+                                      block_idx, opts, dtype)
+        return csi
+
+    keys = jax.random.split(jax.random.key(seed), N_CHAINS)
+    return np.asarray(jax.vmap(one)(keys))
+
+
+def _gap_se(astat: np.ndarray, bstat: np.ndarray):
+    """(|gap|, combined SE) for a per-chain statistic from each ensemble:
+    within-chain samples are correlated, so the only safely independent
+    unit is the chain and SEs come from the chain-level spread."""
+    se = np.sqrt(astat.var(ddof=1) / len(astat)
+                 + bstat.var(ddof=1) / len(bstat))
+    return abs(astat.mean() - bstat.mean()), se
+
+
+def _moment_gap_se(a: np.ndarray, b: np.ndarray):
+    return _gap_se(a.mean(axis=1), b.mean(axis=1))
+
+
+def _std_gap_se(a: np.ndarray, b: np.ndarray):
+    return _gap_se(a.std(axis=1), b.std(axis=1))
+
+
+# ---------------------------------------------------------------------------
+# component-level two-sample tests (iid-valid, high power)
+# ---------------------------------------------------------------------------
+
+
+N_COMPONENT = 4000
+KS_P = 1e-3  # rejects D >~ 0.045 at this N
+
+
+class TestComponentKS:
+    @pytest.mark.parametrize("state", [0.05, 0.2, 0.5, 0.8, 0.95, 0.995])
+    def test_markov_step_per_bin(self, state):
+        """Each cloud-cover bin's step distribution (AL or Student-t with
+        its own loc/scale/kappa/df): a mis-set parameter in any single bin
+        fails exactly that bin's case."""
+        keys = jax.random.split(jax.random.key(int(state * 1e4)), N_COMPONENT)
+        params = mh.step_params(jnp.float64)
+        jx = np.asarray(jax.vmap(
+            lambda k: mh.transition(k, jnp.float64(state), params,
+                                    jnp.float64)
+        )(keys))
+        rng = np.random.default_rng(int(state * 1e4) + 1)
+        gx = np.asarray([mh.transition_numpy(rng, state)
+                         for _ in range(N_COMPONENT)])
+        d, p = sps.ks_2samp(jx, gx)
+        assert p > KS_P, (state, d, p)
+
+    @pytest.mark.parametrize("cc", [0.3, 0.8, 0.95])
+    def test_cloudy_csi_draw_per_band(self, cc):
+        """The three cloudy-csi regimes (normal / gamma-mid / gamma-high,
+        clearskyindexmodel.py:68-84)."""
+        keys = jax.random.split(jax.random.key(int(cc * 100)), N_COMPONENT)
+        jx = np.asarray(jax.vmap(
+            lambda k: ci._cloudy_csi_draw(k, jnp.float64(cc), jnp.float64)
+        )(keys))
+        rng = np.random.default_rng(int(cc * 100) + 1)
+        if cc < 6 / 8:
+            gx = rng.normal(ci.CSI_CLOUDY_NORM_LOC, ci.CSI_CLOUDY_NORM_SCALE,
+                            N_COMPONENT)
+        else:
+            a, s = (ci.CSI_CLOUDY_GAMMA_MID if cc < 7 / 8
+                    else ci.CSI_CLOUDY_GAMMA_HIGH)
+            gx = s * rng.gamma(a, size=N_COMPONENT)
+        d, p = sps.ks_2samp(jx, gx)
+        assert p > KS_P, (cc, d, p)
+
+    @pytest.mark.parametrize("cc", [0.1, 0.6, 0.95])
+    def test_minute_and_second_noise_sigma(self, cc):
+        """Minute noise ~ N(1, sqrt(0.9)*(s0+s1*8*cc)); second noise ~
+        N(0, sqrt(6)*(s0+s1*8*cc)) with the *clear* sigmas in both branches
+        (clearskyindexmodel.py:139-158).  Verified against the analytic
+        sigma to 4 standard errors of the sample std."""
+        n = N_COMPONENT
+        spec = TimeGridSpec.from_local_start(START_STR, 60 * n)
+        feats = ci.HostFeatures.from_spec(spec)
+        cc_arr = jnp.full((feats.n_hours + 1,), jnp.float64(cc))
+        mvals = ci.minute_noise_values(jax.random.key(5), cc_arr, spec, 0,
+                                       n, jnp.float64)
+        for name, (s0, s1) in (("noise_min_cloudy", ci.NOISE_CLOUDY),
+                               ("noise_min_clear", ci.NOISE_CLEAR)):
+            sigma = ci.SIGMA_MIN_FACTOR * (s0 + s1 * 8.0 * cc)
+            vals = np.asarray(mvals[name])
+            se_std = sigma / np.sqrt(2 * (len(vals) - 1))
+            assert abs(vals.mean() - 1.0) < 4 * sigma / np.sqrt(len(vals))
+            assert abs(vals.std(ddof=1) - sigma) < 4 * se_std, (name, cc)
+
+    @pytest.mark.parametrize("cc", [0.15, 0.4, 0.7, 0.9])
+    def test_covered_fraction_per_band(self, cc):
+        """The O(1) renewal kernel must track hourly cloud cover in every
+        band — including low cc, where the reference's own algorithm is
+        infeasible and both implementations deliberately fall back
+        (models/renewal.py)."""
+        windspeed = 5.0
+        horizon = 4 * 3600
+
+        def one(key):
+            k0, k1 = jax.random.split(key)
+            carry = rnw.init(k0, jnp.float64(cc), jnp.float64(windspeed),
+                             jnp.float64)
+            us = jax.random.uniform(k1, (horizon,), dtype=jnp.float64)
+
+            def body(c, u):
+                c, cov = rnw.step_from_u(c, u, cc, windspeed, jnp.float64)
+                return c, cov
+
+            _, covered = jax.lax.scan(body, carry, us)
+            return covered.mean()
+
+        keys = jax.random.split(jax.random.key(int(cc * 1000)), 16)
+        jax_frac = float(np.mean(np.asarray(jax.vmap(one)(keys))))
+
+        fracs = []
+        for s in range(4):
+            r = rnw.ReferenceRenewal(cc, windspeed,
+                                     np.random.default_rng(50 + s))
+            fracs.append(np.mean([next(r) for _ in range(horizon)]))
+        ref_frac = float(np.mean(fracs))
+
+        cc_eff = min(cc, rnw.MAX_CLOUDCOVER)
+        assert abs(jax_frac - cc_eff) < 0.08, (cc, jax_frac)
+        assert abs(ref_frac - cc_eff) < 0.08, (cc, ref_frac)
+        assert abs(jax_frac - ref_frac) < 0.08, (cc, jax_frac, ref_frac)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end moment parity + sensitivity
+# ---------------------------------------------------------------------------
+
+
+class TestEndToEnd:
+    @pytest.mark.parametrize("opts", [
+        ModelOptions(),                              # reference-parity mode
+        ModelOptions(swap_covered_branches=True),    # intended-fix mode
+    ], ids=["reference-branches", "swapped-branches"])
+    def test_mean_parity_4se(self, opts):
+        g = _golden_ensemble(opts)
+        j = _jax_ensemble(opts)
+        gap, se = _moment_gap_se(g, j)
+        assert gap < 4 * se, (gap, se)
+        sgap, sse = _std_gap_se(g, j)
+        assert sgap < 4 * sse, (sgap, sse)
+
+    def test_sensitivity_rejects_swapped_branches(self):
+        """Power check: a swapped-branch model must shift the mean by many
+        SEs — the failure the old 0.15 slack would have waved through."""
+        g = _golden_ensemble(ModelOptions())
+        j = _jax_ensemble(ModelOptions(swap_covered_branches=True))
+        gap, se = _moment_gap_se(g, j)
+        assert gap > 10 * se, (gap, se)
+        assert gap > 0.15, gap  # absolute: covered>90% flips base ~1 -> ~0.5
+
+
+# ---------------------------------------------------------------------------
+# float32 budget
+# ---------------------------------------------------------------------------
+
+
+class TestFloat32Budget:
+    def test_physics_pathwise_year(self):
+        """One simulated year of the deterministic chain (geometry + PV
+        electrical) at hourly cadence: float32 vs float64 on identical csi
+        inputs — the end-to-end precision budget of everything except the
+        stochastic draws."""
+        t0 = 1546300800  # 2019-01-01 00:00 UTC
+        epoch = np.arange(t0, t0 + 365 * 86400, 3600, dtype=np.float64)
+        doy = ((epoch - t0) // 86400 + 1).astype(np.float64)
+        site = Site()
+        rng = np.random.default_rng(9)
+        csi = rng.uniform(0.05, 1.2, size=epoch.shape)
+
+        geom64 = solar.block_geometry(epoch, doy, site, xp=np)
+        ac64 = pvmod.power_from_csi(csi, geom64, SAPM_MODULE,
+                                    SANDIA_INVERTER, xp=np)
+
+        geom32 = {k: (v.astype(np.float32) if isinstance(v, np.ndarray)
+                      else np.float32(v)) for k, v in geom64.items()}
+        ac32 = pvmod.power_from_csi(csi.astype(np.float32), geom32,
+                                    SAPM_MODULE, SANDIA_INVERTER, xp=np)
+
+        err = np.abs(ac32.astype(np.float64) - ac64)
+        # Budget on a ~250 W plant over 8760 hourly samples spanning all
+        # seasons: worst-case sub-watt, mean centi-watt.
+        assert err.max() < 1.0, err.max()
+        assert err.mean() < 0.05, err.mean()
+        # and the annual energy integral moves by < 0.01 %
+        e64, e32 = ac64.sum(), ac32.astype(np.float64).sum()
+        assert abs(e32 - e64) / e64 < 1e-4
+
+    def test_csi_moments_f32_vs_f64(self):
+        """The stochastic path cannot be compared pathwise across dtypes
+        (different draw bits); its float32 moments must match float64
+        within the ensemble's own sampling error."""
+        j64 = _jax_ensemble(ModelOptions(), jnp.float64)
+        j32 = _jax_ensemble(ModelOptions(), jnp.float32, seed=4)
+        gap, se = _moment_gap_se(j64, j32)
+        assert gap < 4 * se, (gap, se)
+        sgap, sse = _std_gap_se(j64, j32)
+        assert sgap < 4 * sse, (sgap, sse)

@@ -1,0 +1,193 @@
+"""Multi-site grid path (BASELINE config #3: "10k-site lat/lon grid").
+
+The reference simulates exactly one hard-coded site (pvmodel.py:19-30); the
+grid path is a pure TPU-era capability: chain i simulates site i, with solar
+geometry evaluated on device from the float32-safe split-time representation
+(models/solar.py sun_position_split / device_geometry) instead of the
+shared-site host-float64 precompute.
+
+Covered here:
+* algebraic equivalence of the split-time ephemeris with the raw-epoch one
+  (same formulas, float64 in = bit-near-identical out);
+* float32 accuracy of the split-time path against the float64 host path
+  (the claim at models/solar.py:137-150: ~0.01 deg worst-case);
+* end-to-end SimConfig(site_grid=...) runs on both the single-chip engine
+  and the 8-device sharded mesh;
+* a grid of identical sites reproduces the shared-site run (same seed ->
+  same csi streams -> pv equal up to geometry-path float error);
+* checkpoint config echo catches a changed grid across resume.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import Site, SiteGrid, SimConfig
+from tmhpvsim_tpu.engine import Simulation
+from tmhpvsim_tpu.engine import checkpoint as ckpt
+from tmhpvsim_tpu.models import solar
+
+SITE = Site()
+
+
+def _day_epochs():
+    # One UTC day at 60 s cadence, 2019-09-05 (the reference's test date).
+    t0 = 1567641600  # 2019-09-05 00:00:00 UTC
+    epoch = np.arange(t0, t0 + 86400, 60, dtype=np.int64)
+    doy = np.full(epoch.shape, 248.0)
+    return epoch, doy
+
+
+def _split(epoch, dtype):
+    return (
+        (epoch // 86400 - 10957).astype(dtype),
+        (epoch % 86400).astype(dtype),
+    )
+
+
+class TestSplitTimeGeometry:
+    def test_split_matches_raw_in_float64(self):
+        """Same ephemeris, different time plumbing: float64 split-time must
+        agree with the raw-epoch path to sub-arcsecond level."""
+        epoch, doy = _day_epochs()
+        raw = solar.sun_position(epoch.astype(np.float64), SITE.latitude,
+                                 SITE.longitude, xp=np)
+        day2000, sec = _split(epoch, np.float64)
+        split = solar.sun_position_split(day2000, sec, SITE.latitude,
+                                         SITE.longitude, xp=np)
+        # 1e-9 rad ~ 2e-4 arcsec: pure float64 rounding from the re-grouped
+        # polynomial evaluation.
+        np.testing.assert_allclose(split["zenith"], raw["zenith"], atol=1e-9)
+        np.testing.assert_allclose(
+            np.unwrap(split["azimuth"] - raw["azimuth"]), 0.0, atol=1e-9
+        )
+
+    def test_split_float32_accuracy(self):
+        """The float32 split path must stay within the documented ~0.01 deg
+        of the float64 host path (models/solar.py:137-150)."""
+        epoch, doy = _day_epochs()
+        ref = solar.sun_position(epoch.astype(np.float64), SITE.latitude,
+                                 SITE.longitude, xp=np)
+        day2000, sec = _split(epoch, np.float32)
+        got = solar.sun_position_split(
+            day2000, sec, np.float32(SITE.latitude),
+            np.float32(SITE.longitude), xp=np,
+        )
+        err_deg = np.abs(got["zenith"] - ref["zenith"]) / solar.DEG
+        assert err_deg.max() < 0.02, err_deg.max()
+
+    def test_device_geometry_matches_block_geometry(self):
+        """Full feature dict: device (split float32) vs host (raw float64)."""
+        epoch, doy = _day_epochs()
+        host = solar.block_geometry(epoch.astype(np.float64), doy, SITE,
+                                    xp=np)
+        day2000, sec = _split(epoch, np.float32)
+        dev = solar.device_geometry(
+            day2000, sec, doy.astype(np.float32),
+            np.float32(SITE.latitude), np.float32(SITE.longitude),
+            np.float32(SITE.altitude), np.float32(SITE.surface_tilt),
+            np.float32(SITE.surface_azimuth), np.float32(0.25),
+            np.asarray(SITE.linke_turbidity_monthly, np.float32), xp=np,
+        )
+        assert np.abs(dev["zenith"] - host["zenith"]).max() < 4e-4  # rad
+        # Clear-sky GHI: ~1300 W/m2 peak; float32 geometry error must stay
+        # in the sub-W/m2 range.
+        assert np.abs(dev["ghi_clear"] - host["ghi_clear"]).max() < 1.0
+        assert np.abs(dev["cos_aoi"] - host["cos_aoi"]).max() < 4e-4
+
+
+def _grid_config(grid, **kw):
+    defaults = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=300,
+        seed=7,
+        block_s=300,
+        dtype="float32",
+    )
+    defaults.update(kw)
+    return SimConfig(site_grid=grid, n_chains=len(grid), **defaults)
+
+
+class TestSiteGridEngine:
+    def test_end_to_end_block(self):
+        grid = SiteGrid.regular((46.0, 50.0), (9.0, 13.0), 2, 2)
+        sim = Simulation(_grid_config(grid))
+        blocks = list(sim.run_blocks())
+        assert len(blocks) == 1
+        blk = blocks[0]
+        assert blk.pv.shape == (4, 300)
+        assert np.isfinite(blk.pv).all()
+        assert (blk.pv >= 0).all()
+        assert np.isfinite(blk.residual).all()
+        # Mid-morning on a September day: at least one southern-tilted site
+        # should actually produce power.
+        assert blk.pv.max() > 0.0
+
+    def test_sites_actually_differ(self):
+        """Two sites far apart in longitude must see different sun and hence
+        different pv for the *same* stochastic chain seed."""
+        n = 2
+        grid = SiteGrid(
+            latitude=(48.12, 48.12),
+            longitude=(-60.0, 40.0),  # ~6.7 h of hour angle apart
+            altitude=(34.0, 34.0),
+            surface_tilt=(48.12, 48.12),
+            surface_azimuth=(180.0, 180.0),
+        )
+        cfg = _grid_config(grid)
+        sim = Simulation(cfg)
+        blk = next(sim.run_blocks())
+        # 10:00 Berlin wall time: the lon=40E site is in daylight; the
+        # lon=60W site is pre-dawn — pv must differ strongly.
+        assert not np.allclose(blk.pv[0], blk.pv[1])
+
+    def test_identical_grid_matches_shared_site(self):
+        """A grid of n copies of the default site must reproduce the
+        shared-site run: same seed -> identical csi streams; pv differs only
+        by the geometry path (host float64 vs device float32 split time)."""
+        n = 4
+        grid = SiteGrid(
+            latitude=(SITE.latitude,) * n,
+            longitude=(SITE.longitude,) * n,
+            altitude=(SITE.altitude,) * n,
+            surface_tilt=(SITE.surface_tilt,) * n,
+            surface_azimuth=(SITE.surface_azimuth,) * n,
+            albedo=(SITE.albedo,) * n,
+        )
+        cfg_grid = _grid_config(grid)
+        cfg_shared = dataclasses.replace(cfg_grid, site_grid=None, n_chains=n)
+        blk_g = next(Simulation(cfg_grid).run_blocks())
+        blk_s = next(Simulation(cfg_shared).run_blocks())
+        np.testing.assert_array_equal(blk_g.meter, blk_s.meter)
+        # Power curves agree to within the float32 geometry error budget:
+        # sub-W absolute on a ~250 W plant.
+        assert np.abs(blk_g.pv - blk_s.pv).max() < 1.0
+
+    def test_sharded_site_grid(self):
+        import jax
+
+        from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
+
+        mesh = make_mesh(jax.devices()[:8])
+        grid = SiteGrid.regular((46.0, 50.0), (9.0, 13.0), 2, 4)
+        sim = ShardedSimulation(_grid_config(grid), mesh=mesh)
+        blk = next(sim.run_blocks())
+        assert blk.pv.shape == (8, 300)
+        assert np.isfinite(blk.pv).all()
+        assert blk.ensemble["pv_mean"].shape == (300,)
+
+    def test_checkpoint_echo_catches_grid_change(self, tmp_path):
+        grid = SiteGrid.regular((46.0, 50.0), (9.0, 13.0), 2, 2)
+        cfg = _grid_config(grid)
+        sim = Simulation(cfg)
+        list(sim.run_blocks())
+        path = str(tmp_path / "ck.npz")
+        ckpt.save(path, sim.state, 1, cfg)
+        other = SiteGrid.regular((40.0, 44.0), (9.0, 13.0), 2, 2)
+        with pytest.raises(ValueError, match="different configuration"):
+            ckpt.load(path, _grid_config(other))
+        # unchanged grid resumes fine
+        state, nb = ckpt.load(path, cfg)
+        assert nb == 1

@@ -131,7 +131,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               sharded: bool = False,
               checkpoint: Optional[str] = None,
               block_s: Optional[int] = None,
-              realtime: bool = False) -> None:
+              realtime: bool = False,
+              site_grid=None,
+              profile_dir: Optional[str] = None,
+              output: str = "trace") -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -141,14 +144,32 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     With ``realtime``, rows are released on the 1 Hz wall-clock grid (the
     reference's default streaming mode) while the device simulates blocks
     ahead — tail the CSV and it ticks once a second.
+
+    With ``output='reduce'``, no per-second trace is materialised at all:
+    per-chain running statistics accumulate on device and FILE gets one
+    summary row per chain plus an ``ensemble`` row — the only output mode
+    that scales to the 100k-1M chain configs (BASELINE #4/#5).
     """
+    import contextlib
     import os
     from zoneinfo import ZoneInfo
 
     from tmhpvsim_tpu.config import SimConfig
     from tmhpvsim_tpu.engine import Simulation, checkpoint as ckpt
-    from tmhpvsim_tpu.engine.profiling import BlockTimer
+    from tmhpvsim_tpu.engine.profiling import BlockTimer, device_trace
     from tmhpvsim_tpu.engine.simulation import write_csv
+    from tmhpvsim_tpu.parallel.distributed import initialize_from_env
+
+    # Join a pod slice when launched under a multi-host runtime; no-op
+    # single-process.  Must run before any jax.devices() query.  Guarded:
+    # stale coordinator env vars in a shell must degrade to a single-host
+    # run, not kill the simulation (the failure class that cost round 1
+    # its benchmark).
+    try:
+        initialize_from_env()
+    except Exception as e:
+        logger.warning("jax.distributed init failed (%s); continuing "
+                       "single-host", e)
 
     if start is None:
         start = _dt.datetime.now().replace(microsecond=0).isoformat(" ")
@@ -160,6 +181,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         n_chains=n_chains,
         seed=seed,
         block_s=block_s,
+        site_grid=site_grid,
+        output=output,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation
@@ -167,6 +190,31 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         sim = ShardedSimulation(cfg)
     else:
         sim = Simulation(cfg)
+    cfg = sim.config  # site_grid may have adjusted n_chains
+
+    if output == "reduce":
+        if realtime:
+            raise ValueError("reduce mode has no per-second rows to pace; "
+                             "drop --realtime")
+        if checkpoint:
+            raise ValueError("reduce mode does not checkpoint yet (the "
+                             "accumulator would need to ride the state "
+                             "pytree); run trace mode or drop --checkpoint")
+        trace = device_trace(profile_dir) if profile_dir else \
+            contextlib.nullcontext()
+        timer = BlockTimer(cfg.n_chains, cfg.block_s)
+        with trace:
+            reduced = sim.run_reduced(on_block=lambda bi: timer.tick())
+        ensemble = sim.ensemble_stats()
+        _write_reduced_csv(file, reduced, ensemble)
+        stats = timer.summary()
+        print(
+            f"pvsim[reduce]: {cfg.n_chains} chains x {cfg.duration_s} s at "
+            f"{stats['site_seconds_per_s']:.3g} site-s/s; fleet pv_max "
+            f"{ensemble['pv_max']:.1f} W"
+            + (f"; profile in {profile_dir}" if profile_dir else "")
+        )
+        return
 
     state, start_block = None, 0
     if checkpoint and os.path.exists(checkpoint):
@@ -204,9 +252,41 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             if checkpoint:
                 ckpt.save(checkpoint, sim.state, bi + 1, cfg)
 
-    write_csv(file, blocks(), chain=chain, tz=ZoneInfo(cfg.site.timezone),
-              append=start_block > 0)
-    timer.summary()
+    tzname = (cfg.site_grid.timezone if cfg.site_grid is not None
+              else cfg.site.timezone)
+    trace = device_trace(profile_dir) if profile_dir else \
+        contextlib.nullcontext()
+    with trace:
+        write_csv(file, blocks(), chain=chain, tz=ZoneInfo(tzname),
+                  append=start_block > 0)
+    stats = timer.summary()
+    print(
+        f"pvsim: {cfg.n_chains} chains x {cfg.duration_s} s simulated at "
+        f"{stats['site_seconds_per_s']:.3g} site-s/s "
+        f"(steady block {stats['steady_block_s']:.3f} s"
+        + (f"; profile in {profile_dir}" if profile_dir else "") + ")"
+    )
+
+
+def _write_reduced_csv(path: str, reduced: dict, ensemble: dict) -> None:
+    """Per-chain summary rows + one fleet 'ensemble' row.
+
+    Columns come from ``REDUCE_STATS`` (engine/simulation.py); *_sum
+    columns are watt-seconds over the simulated duration (divide by 3600
+    for Wh).
+    """
+    import csv
+
+    from tmhpvsim_tpu.engine.simulation import REDUCE_STATS
+
+    keys = list(REDUCE_STATS)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["chain"] + keys)
+        n = len(reduced[keys[0]])
+        for i in range(n):
+            w.writerow([i] + [reduced[k][i] for k in keys])
+        w.writerow(["ensemble"] + [ensemble[k] for k in keys])
 
 
 def _paced(blk, rate: float = 1.0):

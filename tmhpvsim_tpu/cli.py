@@ -63,6 +63,26 @@ def _parse_start(start):
     return dt.datetime.fromisoformat(start) if start else None
 
 
+def _parse_site_grid(spec):
+    """'LAT0:LAT1:NLAT,LON0:LON1:NLON' -> SiteGrid (None passes through)."""
+    if not spec:
+        return None
+    from tmhpvsim_tpu.config import SiteGrid
+
+    try:
+        lat_part, lon_part = spec.split(",")
+        lat0, lat1, n_lat = lat_part.split(":")
+        lon0, lon1, n_lon = lon_part.split(":")
+        return SiteGrid.regular(
+            (float(lat0), float(lat1)), (float(lon0), float(lon1)),
+            int(n_lat), int(n_lon),
+        )
+    except ValueError as e:
+        raise click.UsageError(
+            f"bad --site-grid {spec!r} (want LAT0:LAT1:NLAT,LON0:LON1:NLON)"
+        ) from e
+
+
 @click.command()
 @_common_options
 def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start):
@@ -93,15 +113,34 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start):
 @click.option("--block-s", type=int, default=None,
               help="Seconds per device block, multiple of 60 (jax backend; "
                    "default: min(8640, duration))")
+@click.option("--site-grid", "site_grid_spec", default=None,
+              help="Multi-site lat/lon grid 'LAT0:LAT1:NLAT,LON0:LON1:NLON' "
+                   "— one chain per site, geometry on device (jax backend; "
+                   "overrides --chains)")
+@click.option("--profile", "profile_dir", default=None,
+              help="Write a jax.profiler device trace to this directory "
+                   "(jax backend; view in TensorBoard/Perfetto)")
+@click.option("--output", type=click.Choice(["trace", "reduce"]),
+              default="trace",
+              help="trace: per-second CSV rows; reduce: on-device per-chain "
+                   "statistics only — scales to 100k+ chains (jax backend)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
-          start, backend, n_chains, chain, sharded, checkpoint, block_s):
+          start, backend, n_chains, chain, sharded, checkpoint, block_s,
+          site_grid_spec, profile_dir, output):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
+    if site_grid_spec and backend != "jax":
+        raise click.UsageError("--site-grid requires --backend=jax")
+    if profile_dir and backend != "jax":
+        raise click.UsageError("--profile requires --backend=jax")
+    if output != "trace" and backend != "jax":
+        raise click.UsageError("--output=reduce requires --backend=jax")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
         if duration_s is None:
             raise click.UsageError("--duration is required with --backend=jax")
+        site_grid = _parse_site_grid(site_grid_spec)
         if seed is None:
             import os as _os
 
@@ -119,7 +158,9 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 
                 seed = secrets.randbits(31)
         pvsim_jax(file, duration_s, n_chains, seed, start, chain,
-                  sharded, checkpoint, block_s, realtime=realtime)
+                  sharded, checkpoint, block_s, realtime=realtime,
+                  site_grid=site_grid, profile_dir=profile_dir,
+                  output=output)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

@@ -1,4 +1,11 @@
-"""Vendored numeric data for tmhpvsim-tpu (no runtime file/IO dependencies)."""
+"""Vendored numeric data for tmhpvsim-tpu (no runtime file/IO dependencies).
+
+``SAPM_MODULE`` / ``SANDIA_INVERTER`` default to the vendored nominal
+coefficient sets (parameters.py) and are replaced wholesale at import time
+by exact SAM database rows when the ``TMHPVSIM_SAM_MODULES`` /
+``TMHPVSIM_SAM_INVERTERS`` env vars point at the library CSVs (data/sam.py)
+— the path to absolute-watt parity with the reference's pinned hardware.
+"""
 
 from tmhpvsim_tpu.data.parameters import (  # noqa: F401
     MARKOV_STEP_BINS,
@@ -7,3 +14,15 @@ from tmhpvsim_tpu.data.parameters import (  # noqa: F401
     SANDIA_INVERTER,
     LINKE_TURBIDITY_MONTHLY_MUNICH,
 )
+
+from tmhpvsim_tpu.data.sam import env_overrides as _env_overrides
+
+# A bad override file must fail loudly at import, never half-load: silently
+# continuing on nominal coefficients would defeat the parity the override
+# exists for.
+_sam_module, _sam_inverter = _env_overrides()
+if _sam_module is not None:
+    SAPM_MODULE = _sam_module
+if _sam_inverter is not None:
+    SANDIA_INVERTER = _sam_inverter
+del _sam_module, _sam_inverter

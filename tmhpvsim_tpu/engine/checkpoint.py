@@ -38,6 +38,9 @@ def _config_echo(config) -> dict:
         "block_s": config.block_s,
         "dtype": config.dtype,
         "site": dataclasses.asdict(config.site),
+        "site_grid": (dataclasses.asdict(config.site_grid)
+                      if config.site_grid is not None else None),
+        "output": config.output,
         "options": dataclasses.asdict(config.options),
         "meter_max_w": config.meter_max_w,
     }
@@ -103,10 +106,18 @@ def load(path: str, config=None) -> Tuple[dict, int]:
         flat = {k: data[k] for k in data.files if k != _META}
     if config is not None and "config" in meta:
         saved = meta["config"]
+        # Echoes written before a key existed compare as that key's
+        # then-implicit value, so old checkpoints stay resumable when the
+        # echo schema grows (keys added in round 2 listed here).
+        saved.setdefault("site_grid", None)
+        saved.setdefault("output", "trace")
         current = json.loads(json.dumps(_config_echo(config)))  # tuple->list
         if saved != current:
-            diffs = {k: (saved[k], current[k]) for k in saved
-                     if saved[k] != current.get(k)}
+            keys = set(saved) | set(current)
+            miss = object()
+            diffs = {k: (saved.get(k, miss), current.get(k, miss))
+                     for k in sorted(keys)
+                     if saved.get(k, miss) != current.get(k, miss)}
             raise ValueError(
                 f"checkpoint was written by a different configuration: "
                 f"{diffs}"

@@ -74,6 +74,21 @@ def _round_up(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+#: Reduce-mode statistics: one entry drives the accumulator init, the
+#: per-block merge, both ensemble reductions and the summary-CSV columns —
+#: add a statistic HERE and every consumer picks it up.
+#: name -> (reduction kind, dtype kind); kinds: 'sum' | 'max' | 'min'.
+REDUCE_STATS = {
+    "pv_sum": ("sum", "f"),
+    "pv_max": ("max", "f"),
+    "meter_sum": ("sum", "f"),
+    "residual_sum": ("sum", "f"),
+    "residual_min": ("min", "f"),
+    "residual_max": ("max", "f"),
+    "n_seconds": ("sum", "i"),
+}
+
+
 class Simulation:
     """Blockwise JAX simulation of ``config.n_chains`` independent sites.
 
@@ -109,6 +124,7 @@ class Simulation:
         self._k_chains, _ = jax.random.split(root)
         self._block_jit = jax.jit(self._block_step)
         self._block_reduced_jit = jax.jit(self._block_step_reduced)
+        self._block_acc_jit = jax.jit(self._block_step_acc)
 
     # ------------------------------------------------------------------
     # chain state
@@ -213,15 +229,38 @@ class Simulation:
     # ------------------------------------------------------------------
 
     def _block_step(self, state, inputs):
-        """(state, inputs) -> (state', meter, pv, residual), all on device."""
+        """(state, inputs) -> (state', meter, pv, residual), all on device.
+
+        Two geometry modes (see ``host_inputs``): shared-site runs receive
+        precomputed float64-host geometry in ``inputs["geom"]``; site-grid
+        runs receive the float32-safe split time in ``inputs["time_split"]``
+        and evaluate :func:`solar.device_geometry` per chain from the
+        per-chain site scalars carried in ``state["site"]`` (vmapped, so
+        the grid's geometry is one batched VPU computation on device).
+        """
         cfg = self.config
         block_idx = inputs["block_idx"]
-        geom = inputs["geom"]
         mlo = inputs["mlo"]
         mfeats = inputs["mfeats"]
         dtype = self.dtype
+        shared_geom = inputs.get("geom")
+        if shared_geom is None:
+            ts = inputs["time_split"]
+            turbidity = jnp.asarray(
+                cfg.site_grid.linke_turbidity_monthly, dtype
+            )
 
         def one_chain(chain):
+            if shared_geom is not None:
+                geom = shared_geom
+            else:
+                site = chain["site"]
+                geom = solar.device_geometry(
+                    ts["day2000"], ts["sec_of_day"], ts["doy"],
+                    site["latitude"], site["longitude"], site["altitude"],
+                    site["surface_tilt"], site["surface_azimuth"],
+                    site["albedo"], turbidity, xp=jnp,
+                )
             mvals = ci.minute_noise_values_device(
                 chain["k_min"], chain["arrays"]["cc"], mlo, mfeats, dtype
             )
@@ -261,6 +300,41 @@ class Simulation:
         }
         return state, stats
 
+    def init_reduce_acc(self):
+        """Zero accumulator for the reduce-mode run: one (n_chains,) leaf per
+        statistic, kept ON DEVICE across all blocks so reduce mode never
+        ships more than these few KB to the host, once, at the end.
+
+        Memory math for the headline configs (BASELINE #4/#5): trace mode
+        would gather n_chains x block_s float32 per array per block — at
+        100k chains x 8640 s that is ~3.5 GB/array/block; the accumulator is
+        7 x n_chains x 4 B ~= 2.8 MB at 1M chains, block-count independent.
+        """
+        n = self.config.n_chains
+        dt = self.dtype
+        big = jnp.asarray(jnp.finfo(dt).max, dt)
+        init = {"sum": 0.0, "max": -big, "min": big}
+        return {
+            name: (jnp.zeros((n,), jnp.int32) if dkind == "i"
+                   else jnp.full((n,), init[kind], dt))
+            for name, (kind, dkind) in REDUCE_STATS.items()
+        }
+
+    @staticmethod
+    def _merge_acc(acc, cur):
+        op = {"sum": jnp.add, "max": jnp.maximum, "min": jnp.minimum}
+        return {
+            name: op[kind](acc[name],
+                           cur[name].astype(acc[name].dtype))
+            for name, (kind, _) in REDUCE_STATS.items()
+        }
+
+    def _block_step_acc(self, state, inputs, acc):
+        """Reduced block step folded into the running accumulator — one
+        fused device computation per block, no per-block host traffic."""
+        state, stats = self._block_step_reduced(state, inputs)
+        return state, self._merge_acc(acc, stats)
+
     # ------------------------------------------------------------------
     # run loops
     # ------------------------------------------------------------------
@@ -287,34 +361,39 @@ class Simulation:
                 residual=np.asarray(residual)[:, :n_valid],
             )
 
-    def run_reduced(self, state=None):
+    def run_reduced(self, state=None, on_block=None):
         """Run everything, keeping only per-chain running statistics.
 
-        The trace never reaches the host: each block is reduced on device
-        (``_block_step_reduced``) and only (n_chains,) accumulators are
-        gathered.  Returns dict of (n_chains,) arrays: pv_sum, pv_max,
-        meter_sum, residual_sum, residual_min, residual_max, n_seconds.
-        """
+        The trace never reaches the host: each block folds into an on-device
+        accumulator (``_block_step_acc``) and only the final (n_chains,)
+        arrays are gathered — one transfer for the whole run.  Returns dict
+        of (n_chains,) numpy arrays, one per ``REDUCE_STATS`` entry.
+        ``on_block(block_index)`` is called after each block's dispatch
+        (timing hooks)."""
         if state is None:
             state = self.init_state()
         self.state = state
-        acc = None
+        acc = self.init_reduce_acc()
         for bi in range(self.n_blocks):
             inputs, _ = self.host_inputs(bi)
-            self.state, stats = self._block_reduced_jit(self.state, inputs)
-            # np.array (copy): asarray views of device buffers are read-only
-            cur = {k: np.array(v) for k, v in stats.items()}
-            if acc is None:
-                acc = cur
-            else:
-                for k in ("pv_sum", "meter_sum", "residual_sum", "n_seconds"):
-                    acc[k] += cur[k]
-                acc["pv_max"] = np.maximum(acc["pv_max"], cur["pv_max"])
-                acc["residual_min"] = np.minimum(acc["residual_min"],
-                                                 cur["residual_min"])
-                acc["residual_max"] = np.maximum(acc["residual_max"],
-                                                 cur["residual_max"])
-        return acc
+            self.state, acc = self._block_acc_jit(self.state, inputs, acc)
+            if on_block is not None:
+                on_block(bi)
+        self._last_acc = acc  # device-side, for ensemble_stats()
+        return {k: np.array(v) for k, v in acc.items()}
+
+    def ensemble_stats(self) -> dict:
+        """Fleet-wide scalar aggregates of the last ``run_reduced``: the
+        "grid operator" view the reference approximates by eyeballing N
+        consumer CSVs (SURVEY.md §2.4).  Returns python floats/ints."""
+        a = self._last_acc
+        np_op = {"sum": np.sum, "max": np.max, "min": np.min}
+        out = {}
+        for name, (kind, dkind) in REDUCE_STATS.items():
+            # float64 (or int64) accumulation for the cross-chain fold
+            v = np.asarray(a[name], np.int64 if dkind == "i" else np.float64)
+            out[name] = (int if dkind == "i" else float)(np_op[kind](v))
+        return out
 
 
 def write_csv(path: str, blocks: Iterator[BlockResult], chain: int = 0,

@@ -30,16 +30,28 @@ def initialize_from_env() -> bool:
     environment; GPU/CPU launchers can export ``JAX_COORDINATOR_ADDRESS``,
     ``JAX_NUM_PROCESSES`` and ``JAX_PROCESS_ID`` explicitly.
     """
-    if jax.process_count() > 1:
-        return True  # already initialised by the runtime
+    # NB: the env vars must be inspected BEFORE any jax query that can
+    # initialise a backend — even jax.process_count() does, after which
+    # jax.distributed.initialize() is forbidden.
+    if jax.distributed.is_initialized():
+        return True  # already initialised by the runtime/launcher
     addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES")
-    if not addr or not nproc or int(nproc) <= 1:
+    try:
+        nproc_i = int(nproc) if nproc else 0
+        pid_i = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    except ValueError:
+        logger.warning(
+            "malformed JAX_NUM_PROCESSES/JAX_PROCESS_ID (%r/%r); staying "
+            "single-process", nproc, os.environ.get("JAX_PROCESS_ID"),
+        )
+        return False
+    if not addr or nproc_i <= 1:
         return False
     jax.distributed.initialize(
         coordinator_address=addr,
-        num_processes=int(nproc),
-        process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+        num_processes=nproc_i,
+        process_id=pid_i,
     )
     logger.info(
         "jax.distributed initialised: process %d/%d, %d local / %d global "

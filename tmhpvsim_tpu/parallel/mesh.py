@@ -73,12 +73,14 @@ class ShardedSimulation(Simulation):
         super().__init__(config)
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
-        if config.n_chains % n_dev != 0:
+        if self.config.n_chains % n_dev != 0:
             raise ValueError(
-                f"n_chains={config.n_chains} must be divisible by the mesh "
-                f"size {n_dev}"
+                f"n_chains={self.config.n_chains} must be divisible by the "
+                f"mesh size {n_dev}"
             )
         self._sharded_block = self._build_sharded_block()
+        self._sharded_acc_block = self._build_sharded_acc_block()
+        self._sharded_ensemble = self._build_sharded_ensemble()
 
     def init_state(self):
         state = super().init_state()
@@ -107,6 +109,104 @@ class ShardedSimulation(Simulation):
             check_vma=False,
         )
         return jax.jit(mapped)
+
+    def _build_sharded_acc_block(self):
+        """Reduce-mode block step under shard_map: state and accumulator
+        stay sharded on ``chains``; zero collectives in the loop (the psum
+        happens once at the end, in ``_build_sharded_ensemble``)."""
+        spec_c, spec_r = P(CHAIN_AXIS), P()
+        mapped = shard_map(
+            self._block_step_acc,
+            mesh=self.mesh,
+            in_specs=(spec_c, spec_r, spec_c),
+            out_specs=(spec_c, spec_c),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
+    def _build_sharded_ensemble(self):
+        """Cross-chain aggregates of the accumulator: one ``psum``/``pmax``
+        tree over ICI, result replicated on every chip — the collective
+        that replaces the reference's fan-out + eyeball aggregation.
+        Statistic kinds come from ``REDUCE_STATS`` (engine/simulation.py)."""
+        from tmhpvsim_tpu.engine.simulation import REDUCE_STATS
+
+        def ens(a):
+            local = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}
+            coll = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+                    "min": jax.lax.pmin}
+            return {
+                name: coll[kind](local[kind](a[name]), CHAIN_AXIS)
+                for name, (kind, _) in REDUCE_STATS.items()
+            }
+
+        mapped = shard_map(
+            ens, mesh=self.mesh, in_specs=P(CHAIN_AXIS), out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
+    def init_reduce_acc(self):
+        acc = super().init_reduce_acc()
+        return jax.device_put(acc, chain_sharding(self.mesh))
+
+    def run_reduced(self, state=None, on_block=None):
+        """Sharded reduce mode: the path that makes BASELINE configs #4/#5
+        (100k-1M chains) runnable — per-chain traces never exist globally,
+        per-chain accumulators never leave their shard until the final
+        gather.  See ``Simulation.init_reduce_acc`` for the memory math.
+
+        Single-host: returns global (n_chains,) arrays.  Multi-host (pod
+        slice): a global gather is impossible (the accumulator spans
+        non-addressable devices) and unwanted (it would ride DCN); each
+        host gets the contiguous chain slice its own devices hold — the
+        same slice ``local_reduced_view``/``local_chain_slice`` report."""
+        if state is None:
+            state = self.init_state()
+        self.state = state
+        acc = self.init_reduce_acc()
+        for bi in range(self.n_blocks):
+            inputs, _ = self.host_inputs(bi)
+            self.state, acc = self._sharded_acc_block(
+                self.state, inputs, acc
+            )
+            if on_block is not None:
+                on_block(bi)
+        self._last_acc = acc
+        return {k: self._host_view(v) for k, v in acc.items()}
+
+    @staticmethod
+    def _host_view(arr) -> np.ndarray:
+        """Device->host copy of a chain-sharded array: the whole array when
+        fully addressable, else this host's shards in chain order."""
+        if arr.is_fully_addressable:
+            return np.array(arr)
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    def ensemble_stats(self) -> dict:
+        """Fleet-wide aggregates via the on-device psum tree (replicated
+        output — a host copy, never a DCN gather on multi-host)."""
+        from tmhpvsim_tpu.engine.simulation import REDUCE_STATS
+
+        out = self._sharded_ensemble(self._last_acc)
+        return {k: (int(v) if REDUCE_STATS[k][1] == "i" else float(v))
+                for k, v in out.items()}
+
+    def local_reduced_view(self, reduced: dict) -> tuple:
+        """(slice, dict) restriction of ``run_reduced`` output to the chains
+        this host's devices own — what a per-host CSV writer/checkpointer
+        consumes on a pod slice (parallel/distributed.py).  On multi-host,
+        ``run_reduced`` already returns exactly this slice, so the arrays
+        pass through unchanged."""
+        from tmhpvsim_tpu.parallel.distributed import local_chain_slice
+
+        sl = local_chain_slice(self.config.n_chains, self.mesh)
+        first = next(iter(reduced.values()))
+        if len(first) != self.config.n_chains:  # already host-local
+            return sl, reduced
+        return sl, {k: v[sl] for k, v in reduced.items()}
 
     def run_blocks(self, state=None, start_block: int = 0
                    ) -> Iterator[BlockResult]:
