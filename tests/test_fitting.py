@@ -96,3 +96,54 @@ def test_load_csv(tmp_path):
     np.savetxt(p, np.asarray([10.0, 50.0, 90.0]), delimiter=",")
     v = fitting.load_total_cloud_cover(str(p))
     np.testing.assert_allclose(v, [0.1, 0.5, 0.9])
+
+
+class TestEra5Retrieval:
+    """retrieve_total_cloud_cover against a fake cdsapi (the real one and
+    CDS credentials don't exist here): request contract + cache behaviour,
+    mirroring the reference's download step (cloud_cover_hourly.py:41-91)."""
+
+    def _install_fake(self, monkeypatch, calls):
+        import sys
+        import types
+
+        mod = types.ModuleType("cdsapi")
+
+        class Client:
+            def retrieve(self, dataset, request, target):
+                calls.append((dataset, request, target))
+                with open(target, "w") as f:
+                    f.write("netcdf-bytes")
+
+        mod.Client = Client
+        monkeypatch.setitem(sys.modules, "cdsapi", mod)
+
+    def test_request_contract(self, tmp_path, monkeypatch):
+        calls = []
+        self._install_fake(monkeypatch, calls)
+        target = str(tmp_path / "tcc.nc")
+        out = fitting.retrieve_total_cloud_cover(target, years=(2018, 2019))
+        assert out == target
+        [(dataset, request, tgt)] = calls
+        assert dataset == fitting.ERA5_DATASET
+        assert request["variable"] == fitting.ERA5_VARIABLE
+        assert request["year"] == ["2018", "2019"]
+        assert len(request["month"]) == 12 and len(request["time"]) == 24
+        assert request["area"] == list(fitting.ERA5_AREA_MUNICH)
+        assert tgt == target
+
+    def test_cache_short_circuits(self, tmp_path, monkeypatch):
+        calls = []
+        self._install_fake(monkeypatch, calls)
+        target = tmp_path / "tcc.nc"
+        target.write_text("already here")
+        fitting.retrieve_total_cloud_cover(str(target))
+        assert calls == []  # no download when the file exists
+        assert target.read_text() == "already here"
+
+    def test_clear_error_without_cdsapi(self, tmp_path):
+        import sys
+
+        assert "cdsapi" not in sys.modules  # image really lacks it
+        with pytest.raises(RuntimeError, match="cdsapi"):
+            fitting.retrieve_total_cloud_cover(str(tmp_path / "x.nc"))

@@ -123,8 +123,8 @@ class Simulation:
         root = jax.random.key(config.seed)
         self._k_chains, _ = jax.random.split(root)
         self._block_jit = jax.jit(self._block_step)
-        self._block_reduced_jit = jax.jit(self._block_step_reduced)
-        self._block_acc_jit = jax.jit(self._block_step_acc)
+        self._stats_jit = jax.jit(self._block_stats)
+        self._stats_acc_jit = jax.jit(self._block_stats_acc)
 
     # ------------------------------------------------------------------
     # chain state
@@ -229,7 +229,7 @@ class Simulation:
     # ------------------------------------------------------------------
 
     def _block_step(self, state, inputs):
-        """(state, inputs) -> (state', meter, pv, residual), all on device.
+        """(state, inputs) -> (state', meter, pv), all on device.
 
         Two geometry modes (see ``host_inputs``): shared-site runs receive
         precomputed float64-host geometry in ``inputs["geom"]``; site-grid
@@ -237,6 +237,15 @@ class Simulation:
         and evaluate :func:`solar.device_geometry` per chain from the
         per-chain site scalars carried in ``state["site"]`` (vmapped, so
         the grid's geometry is one batched VPU computation on device).
+
+        Residual load is deliberately NOT computed here: adding
+        ``meter - pv`` as one more consumer of both streams makes XLA:CPU
+        duplicate the whole RNG/csi/physics producer chain into a second
+        fusion (measured: 2.56 vs 1.13 GFLOP compiled, ~3.5x wall time).
+        Consumers derive it outside this jit — on the host in trace mode
+        (``run_blocks``), in the separate ``_block_stats`` jit in reduce
+        mode, where the inputs are materialised arrays and nothing can be
+        re-fused backwards.
         """
         cfg = self.config
         block_idx = inputs["block_idx"]
@@ -277,19 +286,21 @@ class Simulation:
             meter = cfg.meter_max_w * jax.vmap(
                 lambda k: jax.random.uniform(k, (), dtype)
             )(meter_keys)
-            return dict(chain, carry=carry), meter, ac, meter - ac
+            return dict(chain, carry=carry), meter, ac
 
         return jax.vmap(one_chain)(state)
 
-    def _block_step_reduced(self, state, inputs):
-        """Block step + on-device per-chain reduction: ships only O(n_chains)
-        bytes to the host.  Grid-padding seconds are masked out."""
-        state, meter, pv, residual = self._block_step(state, inputs)
-        valid = (inputs["block_idx"]["t"] < self.config.duration_s)
+    def _block_stats(self, meter, pv, t):
+        """Per-chain statistics of one block from the *materialised* meter
+        and pv arrays (its own jit — see ``_block_step`` on why residual
+        must not share the producer jit).  Grid-padding seconds (global
+        index >= duration) are masked out."""
+        residual = meter - pv
+        valid = (t < self.config.duration_s)
         nv = valid.sum()
         big = jnp.asarray(jnp.finfo(self.dtype).max, self.dtype)
         vz = jnp.where(valid, 1.0, 0.0).astype(self.dtype)
-        stats = {
+        return {
             "pv_sum": (pv * vz).sum(axis=1),
             "pv_max": jnp.where(valid, pv, -big).max(axis=1),
             "meter_sum": (meter * vz).sum(axis=1),
@@ -298,7 +309,11 @@ class Simulation:
             "residual_max": jnp.where(valid, residual, -big).max(axis=1),
             "n_seconds": jnp.broadcast_to(nv, (pv.shape[0],)),
         }
-        return state, stats
+
+    def step_reduced(self, state, inputs):
+        """One reduce-mode block: fused block step, then the stats jit."""
+        state, meter, pv = self._block_jit(state, inputs)
+        return state, self._stats_jit(meter, pv, inputs["block_idx"]["t"])
 
     def init_reduce_acc(self):
         """Zero accumulator for the reduce-mode run: one (n_chains,) leaf per
@@ -329,11 +344,15 @@ class Simulation:
             for name, (kind, _) in REDUCE_STATS.items()
         }
 
-    def _block_step_acc(self, state, inputs, acc):
-        """Reduced block step folded into the running accumulator — one
-        fused device computation per block, no per-block host traffic."""
-        state, stats = self._block_step_reduced(state, inputs)
-        return state, self._merge_acc(acc, stats)
+    def _block_stats_acc(self, meter, pv, t, acc):
+        """Stats of one block folded into the running accumulator."""
+        return self._merge_acc(acc, self._block_stats(meter, pv, t))
+
+    def step_acc(self, state, inputs, acc):
+        """One reduce-mode block folded into the on-device accumulator."""
+        state, meter, pv = self._block_jit(state, inputs)
+        acc = self._stats_acc_jit(meter, pv, inputs["block_idx"]["t"], acc)
+        return state, acc
 
     # ------------------------------------------------------------------
     # run loops
@@ -348,17 +367,17 @@ class Simulation:
         self.state = state
         for bi in range(start_block, self.n_blocks):
             inputs, epoch = self.host_inputs(bi)
-            self.state, meter, pv, residual = self._block_jit(
-                self.state, inputs
-            )
+            self.state, meter, pv = self._block_jit(self.state, inputs)
             off = bi * cfg.block_s
             n_valid = min(cfg.block_s, cfg.duration_s - off)
+            m = np.asarray(meter)[:, :n_valid]
+            p = np.asarray(pv)[:, :n_valid]
             yield BlockResult(
                 offset=off,
                 epoch=np.asarray(epoch[:n_valid]),
-                meter=np.asarray(meter)[:, :n_valid],
-                pv=np.asarray(pv)[:, :n_valid],
-                residual=np.asarray(residual)[:, :n_valid],
+                meter=m,
+                pv=p,
+                residual=m - p,  # host numpy: see _block_step docstring
             )
 
     def run_reduced(self, state=None, on_block=None):

@@ -42,6 +42,55 @@ _LOG2PI = math.log(2.0 * math.pi)
 # ---------------------------------------------------------------------------
 
 
+#: The reference's ERA-5 request footprint (cloud_cover_hourly.py:41-91):
+#: hourly total cloud cover for the grid cell around the Munich site.
+ERA5_DATASET = "reanalysis-era5-single-levels"
+ERA5_VARIABLE = "total_cloud_cover"
+ERA5_AREA_MUNICH = (48.25, 11.5, 48.0, 11.75)  # N, W, S, E
+
+
+def retrieve_total_cloud_cover(target: str,
+                               years: Sequence[int] = (2019,),
+                               area: Tuple[float, float, float, float]
+                               = ERA5_AREA_MUNICH) -> str:
+    """Download hourly ERA-5 total cloud cover to ``target`` (netcdf).
+
+    The working replacement for the reference's ``get_total_cloud_cover``
+    download step (cloud_cover_hourly.py:41-91): same dataset, variable and
+    caching contract (an existing ``target`` short-circuits the download).
+    Gated on ``cdsapi`` — offline-only, the runtime never needs it; needs
+    Copernicus CDS credentials in ``~/.cdsapirc`` exactly like the
+    reference.  Returns ``target``.
+    """
+    import os
+
+    if os.path.exists(target):
+        return target  # cache hit (cloud_cover_hourly.py:59-64)
+    try:
+        import cdsapi
+    except ImportError as err:
+        raise RuntimeError(
+            "ERA-5 retrieval requires cdsapi (offline tooling only); "
+            "install it or supply an already-downloaded file"
+        ) from err
+    client = cdsapi.Client()
+    client.retrieve(
+        ERA5_DATASET,
+        {
+            "product_type": "reanalysis",
+            "format": "netcdf",
+            "variable": ERA5_VARIABLE,
+            "year": [str(y) for y in years],
+            "month": [f"{m:02d}" for m in range(1, 13)],
+            "day": [f"{d:02d}" for d in range(1, 32)],
+            "time": [f"{h:02d}:00" for h in range(24)],
+            "area": list(area),
+        },
+        target,
+    )
+    return target
+
+
 def load_total_cloud_cover(path: str) -> np.ndarray:
     """Hourly total cloud cover in [0, 1] from a .nc (ERA-5 'tcc') or a
     single-column CSV file."""
