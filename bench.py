@@ -97,6 +97,7 @@ def main() -> None:
 
     from tmhpvsim_tpu.config import SimConfig
     from tmhpvsim_tpu.engine import Simulation
+    from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
     from tmhpvsim_tpu.parallel.distributed import initialize_from_env
 
     try:
@@ -104,34 +105,61 @@ def main() -> None:
     except Exception as e:  # single-process bench must not die on this
         print(f"# jax.distributed init skipped: {e}", file=sys.stderr)
 
-    cfg = SimConfig(
-        start="2019-09-05 00:00:00",
-        duration_s=BLOCK_S * (n_blocks + 1),
-        n_chains=n_chains,
-        seed=0,
-        block_s=BLOCK_S,
-        dtype="float32",
-    )
-    sim = Simulation(cfg)
-    sim.state = sim.init_state()
+    def make_cfg(n):
+        return SimConfig(
+            start="2019-09-05 00:00:00",
+            duration_s=BLOCK_S * (n_blocks + 1),
+            n_chains=n,
+            seed=0,
+            block_s=BLOCK_S,
+            dtype="float32",
+        )
 
-    # Warm-up block: triggers compilation of init + block step.
-    t_c = time.perf_counter()
-    inputs, _ = sim.host_inputs(0)
-    sim.state, stats = sim._block_reduced_jit(sim.state, inputs)
-    jax.block_until_ready(stats)
-    print(f"# warm-up (compile) {time.perf_counter() - t_c:.1f}s on "
+    def timed_reduce_run(sim):
+        """(compile_s, steady_s, rate) for one warm-up + n_blocks timed
+        reduce-mode blocks through the public step_acc path."""
+        sim.state = sim.init_state()
+        acc = sim.init_reduce_acc()
+        t_c = time.perf_counter()
+        inputs, _ = sim.host_inputs(0)
+        sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+        jax.block_until_ready(acc)
+        compile_s = time.perf_counter() - t_c
+
+        t0 = time.perf_counter()
+        for bi in range(1, n_blocks + 1):
+            inputs, _ = sim.host_inputs(bi)
+            sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+        jax.block_until_ready(acc)
+        dt = time.perf_counter() - t0
+        n = sim.config.n_chains
+        return compile_s, dt, n * BLOCK_S * n_blocks / dt
+
+    sim = Simulation(make_cfg(n_chains))
+    compile_s, dt, rate = timed_reduce_run(sim)
+    print(f"# warm-up (compile) {compile_s:.1f}s on "
           f"{jax.devices()[0].platform}", file=sys.stderr)
 
-    t0 = time.perf_counter()
-    for bi in range(1, n_blocks + 1):
-        inputs, _ = sim.host_inputs(bi)
-        sim.state, stats = sim._block_reduced_jit(sim.state, inputs)
-    jax.block_until_ready(stats)
-    dt = time.perf_counter() - t0
+    # Sharded path over all local devices: on the single real TPU chip this
+    # is a 1-device mesh (validates the shard_map machinery at full size);
+    # scaling efficiency needs a real multi-chip slice (BASELINE.md).
+    devices = jax.local_devices()
+    n_dev = len(devices)
+    sh_chains = max(n_dev, (n_chains // n_dev) * n_dev)
+    try:
+        ssim = ShardedSimulation(make_cfg(sh_chains), mesh=make_mesh(devices))
+        sh_compile_s, sh_dt, sh_rate = timed_reduce_run(ssim)
+        sharded = {
+            "n_devices": n_dev,
+            "n_chains": sh_chains,
+            "rate_per_chip": round(sh_rate / n_dev, 1),
+            "compile_s": round(sh_compile_s, 1),
+            "wall_s": round(sh_dt, 2),
+        }
+    except Exception as e:  # sharded failure must not lose the main number
+        print(f"# sharded bench failed: {e}", file=sys.stderr)
+        sharded = {"error": str(e)[:200]}
 
-    site_seconds = n_chains * BLOCK_S * n_blocks
-    rate = site_seconds / dt
     ref_ceiling = 100.0  # simulated s/s/process, reference --no-realtime
     print(json.dumps({
         "metric": "simulated site-seconds/sec/chip",
@@ -139,10 +167,13 @@ def main() -> None:
         "unit": "site-s/s/chip",
         "vs_baseline": round(rate / ref_ceiling, 1),
         "platform": platform,
+        "tpu": platform == "tpu",
         "n_chains": n_chains,
         "block_s": BLOCK_S,
         "timed_blocks": n_blocks,
+        "compile_s": round(compile_s, 1),
         "wall_s": round(dt, 2),
+        "sharded": sharded,
     }))
 
 

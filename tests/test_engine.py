@@ -129,6 +129,24 @@ def test_reduce_mode_consistent(run):
     assert (stats["n_seconds"] == 7200).all()
 
 
+def test_step_reduced_is_one_block_of_stats(run):
+    """step_reduced (the public per-block stats API) must agree with the
+    trace-mode block: stats of block 0 == reductions of block 0's arrays."""
+    _, blocks = run
+    sim = Simulation(small_config())
+    state = sim.init_state()
+    inputs, _ = sim.host_inputs(0)
+    _, stats = sim.step_reduced(state, inputs)
+    b0 = blocks[0]
+    np.testing.assert_allclose(
+        np.asarray(stats["pv_sum"]), b0.pv.sum(1), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats["residual_max"]), b0.residual.max(1), rtol=1e-5
+    )
+    assert (np.asarray(stats["n_seconds"]) == 3600).all()
+
+
 def test_csv_format(tmp_path, run):
     """Reference row format (pvsim.py:78-83): header then
     time,meter,pv,residual rows, residual == meter - pv."""

@@ -384,22 +384,30 @@ class Simulation:
         """Run everything, keeping only per-chain running statistics.
 
         The trace never reaches the host: each block folds into an on-device
-        accumulator (``_block_step_acc``) and only the final (n_chains,)
-        arrays are gathered — one transfer for the whole run.  Returns dict
-        of (n_chains,) numpy arrays, one per ``REDUCE_STATS`` entry.
-        ``on_block(block_index)`` is called after each block's dispatch
-        (timing hooks)."""
+        accumulator (``step_acc`` -> ``_stats_acc_jit``) and only the final
+        (n_chains,) arrays are gathered — one transfer for the whole run.
+        Returns dict of (n_chains,) numpy arrays, one per ``REDUCE_STATS``
+        entry.  ``on_block(block_index)`` is called after each block's
+        dispatch (timing hooks).  Subclasses redirect the per-block work by
+        overriding ``step_acc`` and the final gather via ``_host_view``
+        (ShardedSimulation runs this exact loop under shard_map)."""
         if state is None:
             state = self.init_state()
         self.state = state
         acc = self.init_reduce_acc()
         for bi in range(self.n_blocks):
             inputs, _ = self.host_inputs(bi)
-            self.state, acc = self._block_acc_jit(self.state, inputs, acc)
+            self.state, acc = self.step_acc(self.state, inputs, acc)
             if on_block is not None:
                 on_block(bi)
         self._last_acc = acc  # device-side, for ensemble_stats()
-        return {k: np.array(v) for k, v in acc.items()}
+        return {k: self._host_view(v) for k, v in acc.items()}
+
+    @staticmethod
+    def _host_view(arr) -> np.ndarray:
+        """Device->host copy of one result leaf (sharded subclasses return
+        only the addressable slice here — see ShardedSimulation)."""
+        return np.array(arr)
 
     def ensemble_stats(self) -> dict:
         """Fleet-wide scalar aggregates of the last ``run_reduced``: the

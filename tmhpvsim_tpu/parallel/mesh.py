@@ -79,7 +79,8 @@ class ShardedSimulation(Simulation):
                 f"mesh size {n_dev}"
             )
         self._sharded_block = self._build_sharded_block()
-        self._sharded_acc_block = self._build_sharded_acc_block()
+        self._sharded_stats_acc = self._build_sharded_stats_acc()
+        self._trace_ensemble = self._build_trace_ensemble()
         self._sharded_ensemble = self._build_sharded_ensemble()
 
     def init_state(self):
@@ -88,41 +89,71 @@ class ShardedSimulation(Simulation):
         return jax.device_put(state, sharding)
 
     def _build_sharded_block(self):
-        spec_state = P(CHAIN_AXIS)
-        spec_repl = P()
-
-        def block(state, inputs):
-            # Inside shard_map: `state` is this chip's chain shard, inputs
-            # are replicated.  The parent's vmapped step runs unchanged on
-            # the shard; the ensemble reduction is the one collective.
-            new_state, meter, pv, residual = self._block_step(state, inputs)
-            pv_sum = jax.lax.psum(pv.sum(axis=0), CHAIN_AXIS)
-            res_sum = jax.lax.psum(residual.sum(axis=0), CHAIN_AXIS)
-            return new_state, meter, pv, residual, pv_sum, res_sum
-
+        """The producer jit under shard_map: this chip's chain shard through
+        the parent's vmapped ``_block_step``, inputs replicated.  Pure data
+        parallelism — zero collectives; everything downstream of the meter
+        and pv arrays (residual, ensemble sums, statistics) lives in
+        separate consumer jits so XLA cannot re-fuse it backwards into a
+        duplicated producer chain (see ``Simulation._block_step``)."""
         mapped = shard_map(
-            block,
+            self._block_step,
             mesh=self.mesh,
-            in_specs=(spec_state, spec_repl),
-            out_specs=(spec_state, spec_state, spec_state, spec_state,
-                       spec_repl, spec_repl),
+            in_specs=(P(CHAIN_AXIS), P()),
+            out_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS), P(CHAIN_AXIS)),
             check_vma=False,
         )
         return jax.jit(mapped)
 
-    def _build_sharded_acc_block(self):
-        """Reduce-mode block step under shard_map: state and accumulator
-        stay sharded on ``chains``; zero collectives in the loop (the psum
-        happens once at the end, in ``_build_sharded_ensemble``)."""
+    def _build_sharded_stats_acc(self):
+        """Reduce-mode consumer under shard_map: fold this shard's
+        materialised meter/pv arrays into the chain-sharded accumulator.
+        Zero collectives in the loop (the psum happens once at the end, in
+        ``_build_sharded_ensemble``)."""
         spec_c, spec_r = P(CHAIN_AXIS), P()
         mapped = shard_map(
-            self._block_step_acc,
+            self._block_stats_acc,
             mesh=self.mesh,
-            in_specs=(spec_c, spec_r, spec_c),
-            out_specs=(spec_c, spec_c),
+            in_specs=(spec_c, spec_c, spec_r, spec_c),
+            out_specs=spec_c,
             check_vma=False,
         )
         return jax.jit(mapped)
+
+    def _build_trace_ensemble(self):
+        """Trace-mode consumer: per-second ensemble sums of pv and residual
+        over *all* chains — one ``psum`` over ICI, replicated on every chip.
+        This collective is exactly where the reference's AMQP fan-out +
+        funnel join used to sit (SURVEY.md §2.4)."""
+
+        def ens(meter, pv):
+            pv_sum = jax.lax.psum(pv.sum(axis=0), CHAIN_AXIS)
+            res_sum = jax.lax.psum((meter - pv).sum(axis=0), CHAIN_AXIS)
+            return pv_sum, res_sum
+
+        mapped = shard_map(
+            ens, mesh=self.mesh,
+            in_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS)), out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
+    def step_reduced(self, state, inputs):
+        """One sharded reduce-mode block: ``step_acc`` into a fresh sharded
+        accumulator (a one-block fold of sum/max/min over the zero/identity
+        init IS that block's statistics)."""
+        return self.step_acc(state, inputs, self.init_reduce_acc())
+
+    def step_acc(self, state, inputs, acc):
+        """One sharded reduce-mode block folded into the sharded on-device
+        accumulator.  ``Simulation.run_reduced`` drives this in its loop —
+        the path that makes BASELINE configs #4/#5 (100k-1M chains)
+        runnable: per-chain traces never exist globally, per-chain
+        accumulators never leave their shard until the final gather."""
+        state, meter, pv = self._sharded_block(state, inputs)
+        acc = self._sharded_stats_acc(
+            meter, pv, inputs["block_idx"]["t"], acc
+        )
+        return state, acc
 
     def _build_sharded_ensemble(self):
         """Cross-chain aggregates of the accumulator: one ``psum``/``pmax``
@@ -150,40 +181,30 @@ class ShardedSimulation(Simulation):
         acc = super().init_reduce_acc()
         return jax.device_put(acc, chain_sharding(self.mesh))
 
-    def run_reduced(self, state=None, on_block=None):
-        """Sharded reduce mode: the path that makes BASELINE configs #4/#5
-        (100k-1M chains) runnable — per-chain traces never exist globally,
-        per-chain accumulators never leave their shard until the final
-        gather.  See ``Simulation.init_reduce_acc`` for the memory math.
-
-        Single-host: returns global (n_chains,) arrays.  Multi-host (pod
-        slice): a global gather is impossible (the accumulator spans
-        non-addressable devices) and unwanted (it would ride DCN); each
-        host gets the contiguous chain slice its own devices hold — the
-        same slice ``local_reduced_view``/``local_chain_slice`` report."""
-        if state is None:
-            state = self.init_state()
-        self.state = state
-        acc = self.init_reduce_acc()
-        for bi in range(self.n_blocks):
-            inputs, _ = self.host_inputs(bi)
-            self.state, acc = self._sharded_acc_block(
-                self.state, inputs, acc
-            )
-            if on_block is not None:
-                on_block(bi)
-        self._last_acc = acc
-        return {k: self._host_view(v) for k, v in acc.items()}
-
     @staticmethod
     def _host_view(arr) -> np.ndarray:
         """Device->host copy of a chain-sharded array: the whole array when
-        fully addressable, else this host's shards in chain order."""
+        fully addressable, else this host's shards in chain order.
+
+        This is the multi-host (pod slice) output contract for both run
+        modes: a global gather is impossible there (the array spans
+        non-addressable devices) and unwanted (it would ride DCN); each
+        host gets the contiguous chain slice its own devices hold — the
+        same slice ``local_reduced_view``/``local_chain_slice`` report."""
         if arr.is_fully_addressable:
             return np.array(arr)
         shards = sorted(arr.addressable_shards,
                         key=lambda s: s.index[0].start or 0)
         return np.concatenate([np.asarray(s.data) for s in shards])
+
+    @staticmethod
+    def _repl_view(arr) -> np.ndarray:
+        """Host copy of a replicated (out_specs=P()) result: any one
+        addressable shard carries the full value, so this never gathers
+        over DCN on a pod slice."""
+        if arr.is_fully_addressable:
+            return np.asarray(arr)
+        return np.asarray(arr.addressable_shards[0].data)
 
     def ensemble_stats(self) -> dict:
         """Fleet-wide aggregates via the on-device psum tree (replicated
@@ -210,6 +231,12 @@ class ShardedSimulation(Simulation):
 
     def run_blocks(self, state=None, start_block: int = 0
                    ) -> Iterator[BlockResult]:
+        """Sharded trace mode.  Single-host: BlockResults carry all chains.
+        Multi-host: the chain axis of ``meter``/``pv``/``residual`` is this
+        host's contiguous slice only (``_host_view``), while ``.ensemble``
+        is always the global fleet view (replicated psum output) — so a
+        per-host CSV writer and a global grid-operator stream both work on
+        a pod slice without any DCN gather."""
         cfg = self.config
         if state is None:
             state = self.init_state()
@@ -217,19 +244,21 @@ class ShardedSimulation(Simulation):
         inv_n = 1.0 / cfg.n_chains
         for bi in range(start_block, self.n_blocks):
             inputs, epoch = self.host_inputs(bi)
-            (self.state, meter, pv, residual, pv_sum, res_sum
-             ) = self._sharded_block(self.state, inputs)
+            self.state, meter, pv = self._sharded_block(self.state, inputs)
+            pv_sum, res_sum = self._trace_ensemble(meter, pv)
             off = bi * cfg.block_s
             n_valid = min(cfg.block_s, cfg.duration_s - off)
+            m = self._host_view(meter)[:, :n_valid]
+            p = self._host_view(pv)[:, :n_valid]
             blk = BlockResult(
                 offset=off,
                 epoch=np.asarray(epoch[:n_valid]),
-                meter=np.asarray(meter)[:, :n_valid],
-                pv=np.asarray(pv)[:, :n_valid],
-                residual=np.asarray(residual)[:, :n_valid],
+                meter=m,
+                pv=p,
+                residual=m - p,  # host numpy: see Simulation._block_step
             )
             blk.ensemble = {
-                "pv_mean": np.asarray(pv_sum)[:n_valid] * inv_n,
-                "residual_mean": np.asarray(res_sum)[:n_valid] * inv_n,
+                "pv_mean": self._repl_view(pv_sum)[:n_valid] * inv_n,
+                "residual_mean": self._repl_view(res_sum)[:n_valid] * inv_n,
             }
             yield blk
