@@ -37,18 +37,24 @@ def test_state_is_sharded():
 
 
 def test_sharded_matches_single_chip():
-    """Sharding is a layout decision, not a semantic one: the sharded run
-    must reproduce the single-device run bit-for-bit (same keys, same
-    global indices; SURVEY.md §2.3 DP row)."""
+    """Sharding is a layout decision, not a semantic one: same keys, same
+    global indices (SURVEY.md §2.3 DP row).  The integer RNG streams are
+    bit-identical under any layout; the float32 physics chain is identical
+    only to a few ULPs, because XLA's instruction selection (fusion / FMA
+    contraction) depends on the per-shard batch shape — measured: 8 chains
+    on a 4- or 8-device mesh differ from the single-device run by <= 4e-4
+    absolute on ~250 W values (~1.5e-6 relative), deterministically.  See
+    ShardedSimulation's docstring."""
     single = Simulation(cfg())
     sharded = ShardedSimulation(cfg())
     b_single = list(single.run_blocks())
     b_sharded = list(sharded.run_blocks())
     assert len(b_single) == len(b_sharded)
     for a, b in zip(b_single, b_sharded):
-        np.testing.assert_array_equal(a.meter, b.meter)
-        np.testing.assert_allclose(a.pv, b.pv, atol=2e-4)
-        np.testing.assert_allclose(a.residual, b.residual, atol=2e-3)
+        np.testing.assert_array_equal(a.meter, b.meter)  # threefry: exact
+        np.testing.assert_allclose(a.pv, b.pv, rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(a.residual, b.residual,
+                                   rtol=1e-5, atol=2e-3)
 
 
 def test_ensemble_psum_is_global_mean():
@@ -88,12 +94,34 @@ class TestShardedReduce:
     the accumulator stays sharded, the ensemble is one psum tree."""
 
     def test_matches_single_chip(self):
+        # tolerance: ULP-scale shape-dependent codegen differences in the
+        # f32 physics (see test_sharded_matches_single_chip), summed over
+        # block_s seconds in the *_sum statistics
         r_single = Simulation(cfg()).run_reduced()
         r_sharded = ShardedSimulation(cfg()).run_reduced()
         assert set(r_single) == set(r_sharded)
+        np.testing.assert_array_equal(
+            r_sharded["n_seconds"], r_single["n_seconds"]  # ints: exact
+        )
         for k in r_single:
             np.testing.assert_allclose(
-                r_sharded[k], r_single[k], rtol=2e-5, atol=2e-2,
+                r_sharded[k], r_single[k], rtol=1e-5, atol=1e-2,
+            )
+
+    def test_step_reduced_matches_base(self):
+        """Sharded step_reduced (one-block fold into the identity init)
+        must agree with the base class's per-block statistics."""
+        base = Simulation(cfg())
+        sharded = ShardedSimulation(cfg())
+        b_state, s_state = base.init_state(), sharded.init_state()
+        inputs, _ = base.host_inputs(0)
+        _, b_stats = base.step_reduced(b_state, inputs)
+        _, s_stats = sharded.step_reduced(s_state, inputs)
+        assert set(np.asarray(s_stats["n_seconds"])) == {1800}
+        for k in b_stats:
+            np.testing.assert_allclose(
+                np.asarray(s_stats[k], np.float64),
+                np.asarray(b_stats[k], np.float64), rtol=1e-5, atol=1e-2,
             )
 
     def test_accumulator_stays_sharded(self):

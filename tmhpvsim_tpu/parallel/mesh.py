@@ -63,10 +63,20 @@ class ShardedSimulation(Simulation):
     * ``init_state()`` lays out every chain-indexed leaf with a
       ``NamedSharding`` over the ``chains`` axis (n_chains must divide by
       the mesh size);
-    * the block step runs under ``shard_map`` and additionally returns the
-      per-second ensemble sums of pv and residual over *all* chains,
-      reduced with ``psum`` over ICI and replicated on every chip;
+    * the block step runs under ``shard_map``; a separate consumer jit
+      reduces the per-second ensemble sums of pv and residual over *all*
+      chains with ``psum`` over ICI, replicated on every chip;
     * BlockResults carry the global ensemble means in ``.ensemble``.
+
+    Numerical contract vs the single-device run: all keys and global
+    indices are identical, so the integer RNG streams (meter draws,
+    renewal decisions) are bit-identical under any mesh layout.  The
+    float32 physics chain is identical only to a few ULPs: XLA compiles
+    the block step for the per-shard batch shape, and its instruction
+    selection (fusion order, FMA contraction) is shape-dependent, so
+    e.g. a 1-chain shard and an 8-chain batch round differently in the
+    transcendental-heavy solar/PV math.  Deterministic for a fixed mesh
+    shape; there is no cross-chain reduction in the per-chain outputs.
     """
 
     def __init__(self, config: SimConfig, mesh: Optional[Mesh] = None):
@@ -82,6 +92,11 @@ class ShardedSimulation(Simulation):
         self._sharded_stats_acc = self._build_sharded_stats_acc()
         self._trace_ensemble = self._build_trace_ensemble()
         self._sharded_ensemble = self._build_sharded_ensemble()
+        # Rebind the reduce-path jits to their shard_map versions (same
+        # signatures) so the parent's step_acc/run_reduced drive the
+        # sharded path unchanged — one copy of the per-block sequence.
+        self._block_jit = self._sharded_block
+        self._stats_acc_jit = self._sharded_stats_acc
 
     def init_state(self):
         state = super().init_state()
@@ -140,20 +155,9 @@ class ShardedSimulation(Simulation):
     def step_reduced(self, state, inputs):
         """One sharded reduce-mode block: ``step_acc`` into a fresh sharded
         accumulator (a one-block fold of sum/max/min over the zero/identity
-        init IS that block's statistics)."""
+        init IS that block's statistics — tested against the base class in
+        tests/test_parallel.py)."""
         return self.step_acc(state, inputs, self.init_reduce_acc())
-
-    def step_acc(self, state, inputs, acc):
-        """One sharded reduce-mode block folded into the sharded on-device
-        accumulator.  ``Simulation.run_reduced`` drives this in its loop —
-        the path that makes BASELINE configs #4/#5 (100k-1M chains)
-        runnable: per-chain traces never exist globally, per-chain
-        accumulators never leave their shard until the final gather."""
-        state, meter, pv = self._sharded_block(state, inputs)
-        acc = self._sharded_stats_acc(
-            meter, pv, inputs["block_idx"]["t"], acc
-        )
-        return state, acc
 
     def _build_sharded_ensemble(self):
         """Cross-chain aggregates of the accumulator: one ``psum``/``pmax``
