@@ -109,6 +109,72 @@ class TestFunnel:
         assert size == 100
         assert evicted == 400
 
+    def test_backpressure_bounds_lookahead(self):
+        """A producer must block once it is max_lookahead past the slowest
+        other stream, and resume when that stream advances — the guard
+        against no-realtime join starvation."""
+
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out, max_lookahead=2,
+                                         stall_timeout_s=30.0)
+            await funnel.put(0, meter=1.0)
+
+            async def pv_producer():
+                for t in range(6):
+                    await funnel.put(t, pv=float(t))
+
+            task = asyncio.ensure_future(pv_producer())
+            await asyncio.sleep(0.05)
+            assert not task.done()  # pv blocked at t=3 > meter(0) + 2
+            assert len(funnel) >= 3  # but its values WERE delivered
+            await funnel.put(1, meter=2.0)  # meter advances -> t=3 admitted
+            await asyncio.sleep(0.05)
+            await funnel.put(4, meter=3.0)  # admits everything (6 <= 4+2)
+            await asyncio.wait_for(task, timeout=5)
+            return out.qsize()
+
+        joined = run(go())
+        assert joined == 3  # t = 0, 1, 4 had both fields
+
+    def test_backpressure_ignores_stream_that_never_delivered(self):
+        """A stream with no values yet has no clock to be ahead of: pv puts
+        must not block at all before the first meter message."""
+        import time
+
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out, max_lookahead=2,
+                                         stall_timeout_s=30.0)
+            for t in range(50):
+                await funnel.put(t, pv=float(t))
+            return len(funnel)
+
+        t0 = time.perf_counter()
+        assert run(go()) == 50
+        assert time.perf_counter() - t0 < 1.0  # no stall waits
+
+    def test_backpressure_stall_degrades_to_free_run(self):
+        """If the other stream goes silent after delivering, backpressure
+        must give up after stall_timeout_s (one wait, then suspended)
+        instead of hanging the app — a dead meter feed keeps the old
+        free-run-and-evict behaviour."""
+        import time
+
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out, max_lookahead=2,
+                                         stall_timeout_s=0.05)
+            await funnel.put(0, meter=1.0)  # meter delivers once, then dies
+            for t in range(50):
+                await funnel.put(t, pv=float(t))
+            return out.qsize()
+
+        t0 = time.perf_counter()
+        assert run(go()) == 1  # only t=0 joined
+        # one stall wait at t=3, then suspended free-run — NOT ~47 waits
+        assert time.perf_counter() - t0 < 1.0
+
 
 class TestAsyncretry:
     def test_retries_then_succeeds(self):

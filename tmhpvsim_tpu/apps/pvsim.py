@@ -100,7 +100,13 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
                      duration_s=None, start=None) -> None:
     """App orchestrator (pvsim.py:86-101)."""
     queue: asyncio.Queue = asyncio.Queue()
-    funnel = SynchronizingFunnel(Data, queue)
+    # 60 s lookahead: under --no-realtime the local pv loop free-runs; the
+    # funnel blocks it from racing ahead of the broker-paced meter stream,
+    # which would otherwise evict every pv-only record before its meter
+    # value arrives (join starvation; see runtime/funnel.py)
+    funnel = SynchronizingFunnel(
+        Data, queue, max_lookahead=_dt.timedelta(seconds=60)
+    )
     counter: dict = {}
     watchdog = asyncio.create_task(_no_meter_watchdog(counter, amqp_url))
     tasks = [

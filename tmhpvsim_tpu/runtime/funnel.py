@@ -6,10 +6,27 @@ field is present the completed record is moved to the output queue.  It is
 the entire stream-join machinery between the AMQP meter feed and the local
 PV feed (pvsim.py:86-101).
 
-Deviation (leak fix): the reference's cache grows without bound if one
-stream stalls (SURVEY.md §5).  ``max_pending`` (default 10 000) evicts the
-oldest incomplete records with a warning instead of exhausting memory;
-``None`` restores the unbounded behaviour.
+Deviations from the reference (both documented in SURVEY.md §5):
+
+* leak fix — the reference's cache grows without bound if one stream
+  stalls.  ``max_pending`` (default 10 000) evicts the oldest incomplete
+  records with a warning instead of exhausting memory; ``None`` restores
+  the unbounded behaviour.
+* backpressure — under ``--no-realtime`` the local PV stream can free-run
+  thousands of simulated seconds ahead of the broker-paced meter stream,
+  so every pv-only record ages past ``max_pending`` and is evicted before
+  its meter value arrives: the leak fix alone would turn the leak into
+  join *starvation*.  ``max_lookahead`` bounds how far any producer may
+  run ahead of the slowest *other* stream: ``put`` first delivers its
+  value (so the join can always progress — this ordering makes the wait
+  deadlock-free), then blocks until the other streams are within the
+  window.  A stream that has never delivered imposes no constraint (there
+  is no clock to be ahead of).  If the other streams stay *silent* for
+  ``stall_timeout_s`` the funnel logs and suspends that producer's
+  backpressure until they advance again — so a meter feed that dies
+  degrades to the old free-run-and-evict behaviour instead of hanging the
+  app, while a merely slow one keeps blocking the producer (any progress
+  resets the stall clock).
 """
 
 from __future__ import annotations
@@ -32,13 +49,25 @@ class SynchronizingFunnel:
 
     def __init__(self, record_type: Type[NamedTuple],
                  queue: "asyncio.Queue",
-                 max_pending: Optional[int] = 10_000):
+                 max_pending: Optional[int] = 10_000,
+                 max_lookahead=None,
+                 stall_timeout_s: float = 10.0):
         self._type = record_type
         self._blank = record_type(*([math.nan] * len(record_type._fields)))
         self._queue = queue
         self._cache: dict = {}
         self.max_pending = max_pending
+        #: max `time` distance a producer may run ahead of the slowest other
+        #: stream (same type as `time - time`: timedelta for datetimes,
+        #: number for numeric grids); None disables backpressure
+        self.max_lookahead = max_lookahead
+        self.stall_timeout_s = stall_timeout_s
         self.n_evicted = 0
+        self._newest: dict = {}       # field -> newest time delivered
+        self._advanced = asyncio.Event()
+        #: per-producer suspension: {other-streams key -> floors tuple at
+        #: the moment that producer's backpressure gave up}
+        self._suspended: dict = {}
 
     def __len__(self):
         return len(self._cache)
@@ -51,6 +80,60 @@ class SynchronizingFunnel:
         else:
             self._cache.pop(time, None)
             await self._queue.put((time, rec))
+        for f in fields:
+            cur = self._newest.get(f)
+            if cur is None or time > cur:
+                self._newest[f] = time
+        self._advanced.set()  # wake producers waiting on this stream
+        await self._backpressure(time, fields)
+
+    def _floors(self, others) -> Optional[tuple]:
+        """Newest times of the ``others`` streams, or None while any of
+        them has not delivered yet."""
+        vals = tuple(self._newest.get(f) for f in others)
+        return None if None in vals else vals
+
+    async def _backpressure(self, time, fields) -> None:
+        if self.max_lookahead is None:
+            return
+        others = tuple(f for f in self._type._fields if f not in fields)
+        if not others:
+            return  # complete record: nothing to wait for
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self.stall_timeout_s
+        last_floors = self._floors(others)
+        while True:
+            floors = self._floors(others)
+            if floors is None:
+                # a stream that never delivered has no clock to be ahead
+                # of; backpressure starts at its first value
+                return
+            if others in self._suspended:
+                if floors == self._suspended[others]:
+                    return  # still stalled: stay in free-run mode
+                del self._suspended[others]  # others advanced: re-arm
+            if time <= min(floors) + self.max_lookahead:
+                return
+            if floors != last_floors:
+                # progress resets the stall clock: only genuinely *silent*
+                # streams trip the timeout, a slow-but-live stream keeps
+                # this producer blocked (that is the backpressure)
+                last_floors = floors
+                deadline = loop.time() + self.stall_timeout_s
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                self._suspended[others] = floors
+                logger.warning(
+                    "funnel backpressure: stream(s) %s made no progress "
+                    "for %.0f s (newest: %s); resuming free-run until they "
+                    "advance", others, self.stall_timeout_s, self._newest,
+                )
+                return
+            self._advanced.clear()
+            try:
+                await asyncio.wait_for(self._advanced.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass  # loop once more; the deadline branch handles it
 
     async def _evict_if_needed(self):
         if self.max_pending is None or len(self._cache) <= self.max_pending:
