@@ -60,6 +60,112 @@ def test_resume_bit_exact(tmp_path):
     np.testing.assert_array_equal(resumed[1], straight[2])
 
 
+def test_reduce_resume_bit_exact(tmp_path):
+    """Reduce-mode resume: the accumulator rides the checkpoint pytree, so
+    stop-after-block-0 -> reload -> finish matches an uninterrupted
+    reduce run on every statistic, bit for bit."""
+    straight = Simulation(cfg()).run_reduced()
+
+    path = str(tmp_path / "r.npz")
+    a = Simulation(cfg())
+
+    class Stop(Exception):
+        pass
+
+    def save_then_crash(bi, state, acc):
+        ckpt.save(path, {"state": state, "acc": acc}, bi + 1, a.config)
+        if bi == 0:
+            raise Stop
+
+    with pytest.raises(Stop):
+        a.run_reduced(on_block=save_then_crash)
+
+    b = Simulation(cfg())  # fresh instance, as after a restart
+    tree, nb = ckpt.load(path, b.config)
+    assert nb == 1
+    resumed = b.run_reduced(state=tree["state"], acc=tree["acc"],
+                            start_block=nb)
+    assert set(resumed) == set(straight)
+    for k in straight:
+        np.testing.assert_array_equal(resumed[k], straight[k])
+
+
+def test_sharded_reduce_resume_with_zero_blocks_left(tmp_path):
+    """Re-invoking a finished sharded reduce run with its stale checkpoint
+    must re-emit the same summary, not crash: the loop body never runs, so
+    the loaded host-numpy accumulator must be re-placed with the chain
+    sharding before the final gather and the ensemble psum tree."""
+    from tmhpvsim_tpu.parallel import ShardedSimulation
+
+    c = cfg(n_chains=8)
+    sim = ShardedSimulation(c)
+    saved = {}
+
+    def hook(bi, state, acc):
+        saved.update(state=state, acc=acc, nb=bi + 1)
+
+    straight = sim.run_reduced(on_block=hook)
+    ens_straight = sim.ensemble_stats()
+    path = str(tmp_path / "s.npz")
+    ckpt.save(path, {"state": saved["state"], "acc": saved["acc"]},
+              saved["nb"], c)
+
+    sim2 = ShardedSimulation(cfg(n_chains=8))
+    tree, nb = ckpt.load(path, sim2.config)
+    assert nb == sim2.n_blocks
+    resumed = sim2.run_reduced(state=tree["state"], acc=tree["acc"],
+                               start_block=nb)
+    for k in straight:
+        np.testing.assert_array_equal(resumed[k], straight[k])
+    assert sim2.ensemble_stats() == ens_straight
+
+
+def test_cli_reduce_checkpoint_crash_resume(tmp_path, monkeypatch):
+    """Reduce-mode restart safety through the real CLI: crash mid-run,
+    re-invoke with the same --checkpoint, summary CSV identical to an
+    uninterrupted run."""
+    def run_reduce(*extra):
+        return CliRunner().invoke(cli_main, [
+            "pvsim", *extra, "--backend=jax", "--no-realtime",
+            "--duration", "360", "--seed", "9", "--output", "reduce",
+            "--start", "2019-09-05 10:00:00", "--block-s", "120",
+        ])
+
+    whole = tmp_path / "whole.csv"
+    r = run_reduce(str(whole))
+    assert r.exit_code == 0, r.output
+
+    part = tmp_path / "part.csv"
+    ck = tmp_path / "ck.npz"
+
+    import tmhpvsim_tpu.engine.checkpoint as ckmod
+
+    real_save = ckmod.save
+    calls = {"n": 0}
+
+    def dying_save(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated crash")
+        return real_save(*a, **kw)
+
+    monkeypatch.setattr(ckmod, "save", dying_save)
+    r = run_reduce(str(part), "--checkpoint", str(ck))
+    assert r.exit_code != 0  # crashed after block 0's checkpoint
+    monkeypatch.setattr(ckmod, "save", real_save)
+    assert not part.exists()  # reduce CSV only written at the end
+
+    r = run_reduce(str(part), "--checkpoint", str(ck))
+    assert r.exit_code == 0, r.output
+
+    with open(part) as f:
+        part_rows = list(csv.reader(f))
+    with open(whole) as f:
+        whole_rows = list(csv.reader(f))
+    assert part_rows == whole_rows
+    assert part_rows[-1][0] == "ensemble"
+
+
 def test_config_mismatch_rejected(tmp_path):
     sim = Simulation(cfg())
     next(sim.run_blocks())

@@ -202,15 +202,31 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         if realtime:
             raise ValueError("reduce mode has no per-second rows to pace; "
                              "drop --realtime")
-        if checkpoint:
-            raise ValueError("reduce mode does not checkpoint yet (the "
-                             "accumulator would need to ride the state "
-                             "pytree); run trace mode or drop --checkpoint")
+        # Reduce-mode checkpointing: the on-device accumulator rides the
+        # saved pytree next to the chain state, so the long configs
+        # (BASELINE #4/#5: 10-year, 1M-chain) are restart-safe.  The CSV
+        # is written once at the end, so unlike trace mode there is no
+        # partial-rows window to truncate on resume.
+        state, acc, start_block = None, None, 0
+        if checkpoint and os.path.exists(checkpoint):
+            tree, start_block = ckpt.load(checkpoint, cfg)
+            state, acc = tree["state"], tree["acc"]
+            logger.info("resuming reduce run from %s at block %d",
+                        checkpoint, start_block)
         trace = device_trace(profile_dir) if profile_dir else \
             contextlib.nullcontext()
         timer = BlockTimer(cfg.n_chains, cfg.block_s)
+
+        def on_block(bi, state, acc):
+            timer.tick()
+            if checkpoint:
+                ckpt.save(checkpoint, {"state": state, "acc": acc},
+                          bi + 1, cfg)
+
         with trace:
-            reduced = sim.run_reduced(on_block=lambda bi: timer.tick())
+            reduced = sim.run_reduced(state=state, acc=acc,
+                                      start_block=start_block,
+                                      on_block=on_block)
         ensemble = sim.ensemble_stats()
         _write_reduced_csv(file, reduced, ensemble)
         stats = timer.summary()

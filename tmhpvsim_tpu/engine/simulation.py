@@ -380,28 +380,42 @@ class Simulation:
                 residual=m - p,  # host numpy: see _block_step docstring
             )
 
-    def run_reduced(self, state=None, on_block=None):
+    def run_reduced(self, state=None, on_block=None, acc=None,
+                    start_block: int = 0):
         """Run everything, keeping only per-chain running statistics.
 
         The trace never reaches the host: each block folds into an on-device
         accumulator (``step_acc`` -> ``_stats_acc_jit``) and only the final
         (n_chains,) arrays are gathered — one transfer for the whole run.
         Returns dict of (n_chains,) numpy arrays, one per ``REDUCE_STATS``
-        entry.  ``on_block(block_index)`` is called after each block's
-        dispatch (timing hooks).  Subclasses redirect the per-block work by
-        overriding ``step_acc`` and the final gather via ``_host_view``
+        entry.  ``on_block(block_index, state, acc)`` is called after each
+        block's dispatch with that block's pytrees (timing/checkpoint
+        hooks).  ``acc``/``start_block`` resume a checkpointed run: the
+        accumulator is part of the saved state, so a resumed reduce run
+        folds on where it left off (apps/pvsim.py).  Subclasses redirect
+        the per-block work by overriding ``step_acc``, resume placement
+        via ``_place_resume`` and the final gather via ``_host_view``
         (ShardedSimulation runs this exact loop under shard_map)."""
-        if state is None:
-            state = self.init_state()
+        state = self.init_state() if state is None \
+            else self._place_resume(state)
         self.state = state
-        acc = self.init_reduce_acc()
-        for bi in range(self.n_blocks):
+        acc = self.init_reduce_acc() if acc is None \
+            else self._place_resume(acc)
+        self._last_acc = acc  # device-side, for ensemble_stats()
+        for bi in range(start_block, self.n_blocks):
             inputs, _ = self.host_inputs(bi)
             self.state, acc = self.step_acc(self.state, inputs, acc)
+            self._last_acc = acc
             if on_block is not None:
-                on_block(bi)
-        self._last_acc = acc  # device-side, for ensemble_stats()
+                on_block(bi, self.state, acc)
         return {k: self._host_view(v) for k, v in acc.items()}
+
+    def _place_resume(self, tree):
+        """Loaded checkpoint pytrees (host numpy from ``checkpoint.load``)
+        onto device.  The base class lets jit place them; the sharded
+        subclass applies the chain sharding so a resumed run (including one
+        with zero remaining blocks) has real device arrays."""
+        return tree
 
     @staticmethod
     def _host_view(arr) -> np.ndarray:

@@ -185,6 +185,12 @@ class ShardedSimulation(Simulation):
         acc = super().init_reduce_acc()
         return jax.device_put(acc, chain_sharding(self.mesh))
 
+    def _place_resume(self, tree):
+        """Checkpointed pytrees re-enter with the chain sharding they were
+        saved from (host numpy otherwise reaches ``_host_view`` unplaced
+        when a resume has no blocks left to run)."""
+        return jax.device_put(tree, chain_sharding(self.mesh))
+
     @staticmethod
     def _host_view(arr) -> np.ndarray:
         """Device->host copy of a chain-sharded array: the whole array when
