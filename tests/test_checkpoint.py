@@ -90,6 +90,16 @@ def test_reduce_resume_bit_exact(tmp_path):
         np.testing.assert_array_equal(resumed[k], straight[k])
 
 
+def test_reduce_resume_without_acc_rejected():
+    """Resuming reduce mode trace-style (state + start_block, no acc) must
+    fail loudly — a zero accumulator would silently report partial-run
+    statistics as the full run's."""
+    sim = Simulation(cfg())
+    state = sim.init_state()
+    with pytest.raises(ValueError, match="accumulator"):
+        sim.run_reduced(state=state, start_block=1)
+
+
 def test_sharded_reduce_resume_with_zero_blocks_left(tmp_path):
     """Re-invoking a finished sharded reduce run with its stale checkpoint
     must re-emit the same summary, not crash: the loop body never runs, so

@@ -76,7 +76,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_smoke():
+def _run_workers(src: str, timeout: float = 360.0):
+    """Launch two coordinated worker processes running ``src``; return
+    [(rc, stdout, stderr), ...]."""
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -97,13 +99,13 @@ def test_two_process_distributed_smoke():
             if k.startswith(("AXON_", "PALLAS_AXON_")):
                 env.pop(k)
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER], env=env,
+            [sys.executable, "-c", src], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=360)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -111,8 +113,73 @@ def test_two_process_distributed_smoke():
                 p.kill()
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    return outs
+
+
+def test_two_process_distributed_smoke():
+    outs = _run_workers(_WORKER)
     assert "DISTOK 0" in outs[0][1]
     assert "DISTOK 1" in outs[1][1]
+
+
+_SIM_WORKER = r"""
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+from tmhpvsim_tpu.parallel.distributed import (
+    initialize_from_env, local_chain_slice,
+)
+
+assert initialize_from_env()
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine import Simulation
+from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
+
+cfg = dict(start="2019-09-05 10:00:00", duration_s=120, n_chains=16,
+           seed=5, block_s=60, dtype="float32")
+mesh = make_mesh()  # 8 devices across 2 processes
+assert not mesh.devices[0].process_index == mesh.devices[-1].process_index
+
+sim = ShardedSimulation(SimConfig(**cfg), mesh=mesh)
+sl = local_chain_slice(16, mesh)
+ref = list(Simulation(SimConfig(**cfg)).run_blocks())  # local full run
+
+# Trace mode on a pod-slice-shaped mesh: each host gets ONLY its own
+# contiguous chain slice (no DCN gather), ensemble is the global view.
+for b, r in zip(sim.run_blocks(), ref):
+    assert b.meter.shape == (8, 60), b.meter.shape
+    np.testing.assert_array_equal(b.meter, r.meter[sl])
+    np.testing.assert_allclose(b.pv, r.pv[sl], rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(b.ensemble["pv_mean"], r.pv.mean(axis=0),
+                               rtol=1e-4, atol=1e-3)
+
+# Reduce mode: host-local accumulator slices; global psum ensemble.
+rsim = ShardedSimulation(SimConfig(**cfg), mesh=mesh)
+red = rsim.run_reduced()
+assert len(red["pv_sum"]) == 8
+ref_red = Simulation(SimConfig(**cfg)).run_reduced()
+np.testing.assert_allclose(red["pv_sum"], ref_red["pv_sum"][sl],
+                           rtol=1e-5, atol=1e-2)
+ens = rsim.ensemble_stats()
+np.testing.assert_allclose(ens["pv_sum"], ref_red["pv_sum"].sum(),
+                           rtol=1e-5)
+assert ens["n_seconds"] == 16 * 120
+
+print(f"SIMOK {jax.process_index()}", flush=True)
+"""
+
+
+def test_two_process_sharded_simulation():
+    """The full simulation over a 2-host mesh: state creation, trace mode
+    with host-local gathers, reduce mode, and the DCN ensemble psum — the
+    multi-host output contract of ShardedSimulation (parallel/mesh.py)."""
+    outs = _run_workers(_SIM_WORKER)
+    assert "SIMOK 0" in outs[0][1]
+    assert "SIMOK 1" in outs[1][1]
 
 
 def test_initialize_from_env_noop_single_process():

@@ -208,6 +208,16 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         # is written once at the end, so unlike trace mode there is no
         # partial-rows window to truncate on resume.
         state, acc, start_block = None, None, 0
+        if checkpoint:
+            import jax
+
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "reduce-mode --checkpoint is single-host only: on a "
+                    "pod slice the state spans non-addressable devices "
+                    "and needs per-host checkpoint files (see "
+                    "ShardedSimulation._place_resume); drop --checkpoint"
+                )
         if checkpoint and os.path.exists(checkpoint):
             tree, start_block = ckpt.load(checkpoint, cfg)
             state, acc = tree["state"], tree["acc"]

@@ -130,13 +130,20 @@ class Simulation:
     # chain state
     # ------------------------------------------------------------------
 
-    def init_state(self):
+    def init_state(self, sharding=None):
         """Initial carried pytree for all chains: sampler arrays, renewal
         carry, and per-chain keys.  With the block offset this is a complete
-        checkpoint of the simulation."""
+        checkpoint of the simulation.
+
+        ``sharding`` (a NamedSharding over the chain axis) is applied as
+        the jit's ``out_shardings`` so every leaf — including the site
+        scalars — is born with the right layout.  That is the only
+        construction that also works on a multi-host mesh, where
+        ``jax.device_put`` cannot target the other hosts' devices."""
         opts = self.config.options
         feats = self.feats
         dtype = self.dtype
+        grid = self.config.site_grid
 
         def one(key):
             k_arr, k_min, k_renew, k_scan, k_meter = jax.random.split(key, 5)
@@ -150,22 +157,25 @@ class Simulation:
                 "k_meter": k_meter,
             }
 
-        keys = jax.random.split(self._k_chains, self.config.n_chains)
-        state = jax.jit(jax.vmap(one))(keys)
-        grid = self.config.site_grid
-        if grid is not None:
-            # per-chain site parameters live in the state pytree: they get
-            # the chain sharding, ride through shard_map specs, and land in
-            # checkpoints without any special-casing
-            state["site"] = {
-                "latitude": jnp.asarray(grid.latitude, dtype),
-                "longitude": jnp.asarray(grid.longitude, dtype),
-                "altitude": jnp.asarray(grid.altitude, dtype),
-                "surface_tilt": jnp.asarray(grid.surface_tilt, dtype),
-                "surface_azimuth": jnp.asarray(grid.surface_azimuth, dtype),
-                "albedo": jnp.asarray(grid.albedo, dtype),
-            }
-        return state
+        def build():
+            keys = jax.random.split(self._k_chains, self.config.n_chains)
+            state = jax.vmap(one)(keys)
+            if grid is not None:
+                # per-chain site parameters live in the state pytree: they
+                # get the chain sharding, ride through shard_map specs, and
+                # land in checkpoints without any special-casing
+                state["site"] = {
+                    "latitude": jnp.asarray(grid.latitude, dtype),
+                    "longitude": jnp.asarray(grid.longitude, dtype),
+                    "altitude": jnp.asarray(grid.altitude, dtype),
+                    "surface_tilt": jnp.asarray(grid.surface_tilt, dtype),
+                    "surface_azimuth": jnp.asarray(grid.surface_azimuth,
+                                                   dtype),
+                    "albedo": jnp.asarray(grid.albedo, dtype),
+                }
+            return state
+
+        return jax.jit(build, out_shardings=sharding)()
 
     # ------------------------------------------------------------------
     # host-side per-block inputs (chain-independent, float64 precompute)
@@ -315,10 +325,11 @@ class Simulation:
         state, meter, pv = self._block_jit(state, inputs)
         return state, self._stats_jit(meter, pv, inputs["block_idx"]["t"])
 
-    def init_reduce_acc(self):
+    def init_reduce_acc(self, sharding=None):
         """Zero accumulator for the reduce-mode run: one (n_chains,) leaf per
         statistic, kept ON DEVICE across all blocks so reduce mode never
         ships more than these few KB to the host, once, at the end.
+        ``sharding``: as in :meth:`init_state`.
 
         Memory math for the headline configs (BASELINE #4/#5): trace mode
         would gather n_chains x block_s float32 per array per block — at
@@ -327,13 +338,17 @@ class Simulation:
         """
         n = self.config.n_chains
         dt = self.dtype
-        big = jnp.asarray(jnp.finfo(dt).max, dt)
-        init = {"sum": 0.0, "max": -big, "min": big}
-        return {
-            name: (jnp.zeros((n,), jnp.int32) if dkind == "i"
-                   else jnp.full((n,), init[kind], dt))
-            for name, (kind, dkind) in REDUCE_STATS.items()
-        }
+
+        def build():
+            big = jnp.asarray(jnp.finfo(dt).max, dt)
+            init = {"sum": 0.0, "max": -big, "min": big}
+            return {
+                name: (jnp.zeros((n,), jnp.int32) if dkind == "i"
+                       else jnp.full((n,), init[kind], dt))
+                for name, (kind, dkind) in REDUCE_STATS.items()
+            }
+
+        return jax.jit(build, out_shardings=sharding)()
 
     @staticmethod
     def _merge_acc(acc, cur):
@@ -396,6 +411,15 @@ class Simulation:
         the per-block work by overriding ``step_acc``, resume placement
         via ``_place_resume`` and the final gather via ``_host_view``
         (ShardedSimulation runs this exact loop under shard_map)."""
+        if start_block > 0 and acc is None:
+            # trace-mode resume is (state, start_block), but reduce-mode
+            # statistics live in the accumulator: restarting it from the
+            # identity would silently present the remaining blocks' stats
+            # as the full run's
+            raise ValueError(
+                "resuming run_reduced needs the checkpointed accumulator: "
+                "pass acc= alongside state=/start_block="
+            )
         state = self.init_state() if state is None \
             else self._place_resume(state)
         self.state = state

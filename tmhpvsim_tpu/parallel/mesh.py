@@ -99,9 +99,7 @@ class ShardedSimulation(Simulation):
         self._stats_acc_jit = self._sharded_stats_acc
 
     def init_state(self):
-        state = super().init_state()
-        sharding = chain_sharding(self.mesh)
-        return jax.device_put(state, sharding)
+        return super().init_state(sharding=chain_sharding(self.mesh))
 
     def _build_sharded_block(self):
         """The producer jit under shard_map: this chip's chain shard through
@@ -182,13 +180,15 @@ class ShardedSimulation(Simulation):
         return jax.jit(mapped)
 
     def init_reduce_acc(self):
-        acc = super().init_reduce_acc()
-        return jax.device_put(acc, chain_sharding(self.mesh))
+        return super().init_reduce_acc(sharding=chain_sharding(self.mesh))
 
     def _place_resume(self, tree):
         """Checkpointed pytrees re-enter with the chain sharding they were
         saved from (host numpy otherwise reaches ``_host_view`` unplaced
-        when a resume has no blocks left to run)."""
+        when a resume has no blocks left to run).  Single-host only for
+        now: on a pod slice each host holds only its chain slice, so resume
+        needs per-host checkpoint files (device_put below raises loudly on
+        non-addressable meshes rather than fabricating state)."""
         return jax.device_put(tree, chain_sharding(self.mesh))
 
     @staticmethod
