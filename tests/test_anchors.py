@@ -1,0 +1,136 @@
+"""External anchors for the physics chain.
+
+Every other parity test in this suite compares models/solar.py + models/pv.py
+against engine/golden.py — which calls the SAME formulas with ``xp=numpy``,
+so a shared formula error is invisible to all of them.  This file pins the
+chain to values that do NOT come from this repo's code:
+
+* the worked example of the NREL Solar Position Algorithm report
+  (Reda & Andreas 2004, NREL/TP-560-34302, §6 "Example"), the standard
+  external test point for solar-position implementations;
+* the Kasten & Young (1989) relative-airmass formula evaluated by hand at
+  table zenith angles;
+* Spencer (1971) extraterrestrial-radiation factors (as tabulated in
+  Duffie & Beckman, "Solar Engineering of Thermal Processes", eq. 1.4.1b)
+  with pvlib 0.6.3's solar constant 1366.1 W/m^2 — the reference's
+  ``get_extra_radiation`` default (pvmodel.py:60-66 via pvlib);
+* structural identities of the SAPM thermal model (King et al. 2004,
+  eq. 11-12) and the Sandia inverter model (King et al. 2007): at the
+  rated operating point (Vdco, Pdco) the model yields exactly Paco.
+
+All literal expectations below were computed from the cited publications'
+formulas in a fresh numpy session, not from this package.  Tolerances cover
+the PSA algorithm's documented ~0.01 deg accuracy vs SPA plus refraction-
+model differences — tight enough that any formula drift (wrong constant,
+flipped sign, degree/radian slip) fails loudly.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.models import solar
+
+
+class TestSolarPositionSPA:
+    """Reda & Andreas (2004) §6: 2003-10-17 12:30:30 local (UTC-7), Denver
+    (39.742476 N, 105.1786 W, 1830.14 m, P=820 mbar, T=11 C); published
+    topocentric results: zenith 50.11162 deg, azimuth 194.34024 deg
+    (eastward from north)."""
+
+    LAT, LON = 39.742476, -105.1786
+    EPOCH = dt.datetime(2003, 10, 17, 19, 30, 30,
+                        tzinfo=dt.timezone.utc).timestamp()  # 1066419030
+
+    def pos(self):
+        e = np.asarray([self.EPOCH], dtype=np.float64)
+        return solar.sun_position(e, self.LAT, self.LON, xp=np)
+
+    def test_topocentric_zenith(self):
+        # sun_position works in radians throughout (models/solar.py)
+        pos = self.pos()
+        app_elev = solar.apparent_elevation(
+            pos["zenith"], pressure=82000.0, temperature_c=11.0, xp=np,
+        )
+        app_zenith = 90.0 - np.degrees(float(app_elev[0]))
+        assert app_zenith == pytest.approx(50.11162, abs=0.06)
+
+    def test_topocentric_azimuth(self):
+        pos = self.pos()
+        az_deg = np.degrees(float(pos["azimuth"][0]))
+        assert az_deg == pytest.approx(194.34024, abs=0.06)
+
+
+class TestAirmassKastenYoung:
+    """Kasten & Young (1989): AM = 1/(cos z + 0.50572*(96.07995-z)^-1.6364),
+    z the apparent zenith in degrees.  Hand-evaluated literals."""
+
+    @pytest.mark.parametrize("zenith, expected, tol", [
+        (0.0, 0.9997, 1e-3),
+        (30.0, 1.1540, 1e-3),
+        (60.0, 1.9943, 1e-3),
+        (85.0, 10.3058, 0.01),
+    ])
+    def test_values(self, zenith, expected, tol):
+        am = solar.relative_airmass_kasten_young(
+            np.radians(np.asarray([zenith])), xp=np
+        )
+        assert float(am[0]) == pytest.approx(expected, abs=tol)
+
+
+class TestExtraRadiationSpencer:
+    """Spencer (1971) E0 factor x 1366.1 W/m^2 (pvlib 0.6.3 default
+    method='spencer', solar_constant=1366.1).  Hand-evaluated literals."""
+
+    @pytest.mark.parametrize("doy, expected", [
+        (1, 1413.98),     # perihelion side: ~+3.5 %
+        (100, 1360.79),
+        (182, 1320.54),   # aphelion side: ~-3.3 %
+        (355, 1412.71),
+    ])
+    def test_values(self, doy, expected):
+        got = solar.extra_radiation_spencer(np.asarray([float(doy)]), xp=np)
+        assert float(got[0]) == pytest.approx(expected, abs=0.5)
+
+
+class TestSAPMThermalAnchor:
+    """King et al. (2004) eq. 11-12, open-rack glass/cell/glass mount
+    (a=-3.47, b=-0.0594, deltaT=3): at POA=800 W/m^2, wind=0, T_amb=20 C
+    the cell temperature is 800*exp(-3.47) + 20 + 0.8*3 = 47.294 C."""
+
+    def test_cell_temp(self):
+        from tmhpvsim_tpu.data import SAPM_MODULE
+        from tmhpvsim_tpu.models import pv
+
+        t = pv.sapm_cell_temp(np.asarray([800.0]), SAPM_MODULE,
+                              wind_speed=0.0, temp_air_c=20.0, xp=np)
+        assert float(t[0]) == pytest.approx(47.294, abs=0.01)
+
+
+class TestSandiaInverterAnchor:
+    """King et al. (2007): by construction of the model, AC power at the
+    rated operating point (v_dc=Vdco, p_dc=Pdco) is exactly Paco — the C0
+    curvature terms cancel.  Any sign/parenthesis drift in the implemented
+    polynomial breaks this identity."""
+
+    def test_rated_point_yields_paco(self):
+        from tmhpvsim_tpu.data import SANDIA_INVERTER as inv
+        from tmhpvsim_tpu.models import pv
+
+        ac = pv.sandia_inverter_ac(
+            np.asarray([inv["Vdco"]]), np.asarray([inv["Pdco"]]), inv, xp=np,
+        )
+        assert float(ac[0]) == pytest.approx(inv["Paco"], rel=1e-9)
+
+    def test_below_startup_power_clips_to_zero(self):
+        """Below Pso the inverter draws tare power; the chain clips to 0 W
+        exactly like the reference cache fill (pvmodel.py:80)."""
+        from tmhpvsim_tpu.data import SANDIA_INVERTER as inv
+        from tmhpvsim_tpu.models import pv
+
+        ac = pv.sandia_inverter_ac(
+            np.asarray([inv["Vdco"]]), np.asarray([0.5 * inv["Pso"]]),
+            inv, xp=np,
+        )
+        assert float(ac[0]) <= 0.0

@@ -154,6 +154,38 @@ class TestFunnel:
         assert run(go()) == 50
         assert time.perf_counter() - t0 < 1.0  # no stall waits
 
+    def test_backpressure_three_streams_dead_plus_live(self):
+        """3-stream join, one constraint stream dead and one live: the live
+        stream's steady progress must NOT keep resetting the stall clock for
+        the dead one pinning min(floors) — the producer must degrade to
+        free-run after one timeout instead of blocking forever."""
+        import time
+        from collections import namedtuple
+
+        Tri = namedtuple("Tri", ["a", "b", "c"])
+
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Tri, out, max_lookahead=2,
+                                         stall_timeout_s=0.1)
+            await funnel.put(0, b=1.0)  # b delivers once, then dies
+
+            async def live_a():
+                for t in range(200):
+                    await funnel.put(t, a=float(t))
+                    await asyncio.sleep(0.005)  # steady 200 Hz progress
+
+            live = asyncio.ensure_future(live_a())
+            # c runs far past b(0)+2: must suspend after ~0.1 s, not hang
+            for t in range(10):
+                await funnel.put(t, c=float(t))
+            live.cancel()
+            return True
+
+        t0 = time.perf_counter()
+        assert run(asyncio.wait_for(go(), timeout=5))
+        assert time.perf_counter() - t0 < 2.0
+
     def test_backpressure_stall_degrades_to_free_run(self):
         """If the other stream goes silent after delivering, backpressure
         must give up after stall_timeout_s (one wait, then suspended)

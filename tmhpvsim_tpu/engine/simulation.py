@@ -125,6 +125,10 @@ class Simulation:
         self._block_jit = jax.jit(self._block_step)
         self._stats_jit = jax.jit(self._block_stats)
         self._stats_acc_jit = jax.jit(self._block_stats_acc)
+        #: memoized jitted initializers keyed by (kind, sharding) — a fresh
+        #: jax.jit(closure) per call would never hit the trace cache, which
+        #: matters for per-block users of step_reduced/init_reduce_acc
+        self._init_jits = {}
 
     # ------------------------------------------------------------------
     # chain state
@@ -175,7 +179,17 @@ class Simulation:
                 }
             return state
 
-        return jax.jit(build, out_shardings=sharding)()
+        return self._memo_jit("state", sharding, build)()
+
+    def _memo_jit(self, kind, sharding, build):
+        """One jitted zero-arg initializer per (kind, sharding)."""
+        key = (kind, sharding)
+        fn = self._init_jits.get(key)
+        if fn is None:
+            fn = self._init_jits[key] = jax.jit(
+                build, out_shardings=sharding
+            )
+        return fn
 
     # ------------------------------------------------------------------
     # host-side per-block inputs (chain-independent, float64 precompute)
@@ -348,7 +362,7 @@ class Simulation:
                 for name, (kind, dkind) in REDUCE_STATS.items()
             }
 
-        return jax.jit(build, out_shardings=sharding)()
+        return self._memo_jit("acc", sharding, build)()
 
     @staticmethod
     def _merge_acc(acc, cur):

@@ -101,28 +101,35 @@ class SynchronizingFunnel:
             return  # complete record: nothing to wait for
         loop = asyncio.get_event_loop()
         deadline = loop.time() + self.stall_timeout_s
-        last_floors = self._floors(others)
+        first = self._floors(others)
+        last_binding = None if first is None else min(first)
         while True:
             floors = self._floors(others)
             if floors is None:
                 # a stream that never delivered has no clock to be ahead
                 # of; backpressure starts at its first value
                 return
+            # All decisions key on the BINDING floor (the slowest other
+            # stream): with 3+ streams, a live stream's progress must
+            # neither reset the stall clock for a dead one pinning the
+            # minimum, nor re-arm a suspension taken against it.
+            binding = min(floors)
             if others in self._suspended:
-                if floors == self._suspended[others]:
+                if binding <= self._suspended[others]:
                     return  # still stalled: stay in free-run mode
-                del self._suspended[others]  # others advanced: re-arm
-            if time <= min(floors) + self.max_lookahead:
+                del self._suspended[others]  # it advanced: re-arm
+            if time <= binding + self.max_lookahead:
                 return
-            if floors != last_floors:
-                # progress resets the stall clock: only genuinely *silent*
-                # streams trip the timeout, a slow-but-live stream keeps
-                # this producer blocked (that is the backpressure)
-                last_floors = floors
+            if last_binding is None or binding > last_binding:
+                # progress of the binding stream resets the stall clock:
+                # only a genuinely *silent* constraint trips the timeout, a
+                # slow-but-live one keeps this producer blocked (that is
+                # the backpressure)
+                last_binding = binding
                 deadline = loop.time() + self.stall_timeout_s
             remaining = deadline - loop.time()
             if remaining <= 0:
-                self._suspended[others] = floors
+                self._suspended[others] = binding
                 logger.warning(
                     "funnel backpressure: stream(s) %s made no progress "
                     "for %.0f s (newest: %s); resuming free-run until they "
