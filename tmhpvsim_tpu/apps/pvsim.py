@@ -140,7 +140,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               realtime: bool = False,
               site_grid=None,
               profile_dir: Optional[str] = None,
-              output: str = "trace") -> None:
+              output: str = "trace",
+              prng_impl: str = "threefry2x32") -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -189,6 +190,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         block_s=block_s,
         site_grid=site_grid,
         output=output,
+        prng_impl=prng_impl,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation

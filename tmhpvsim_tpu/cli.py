@@ -124,9 +124,14 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start):
               default="trace",
               help="trace: per-second CSV rows; reduce: on-device per-chain "
                    "statistics only — scales to 100k+ chains (jax backend)")
+@click.option("--prng-impl", type=click.Choice(["threefry2x32", "rbg"]),
+              default="threefry2x32",
+              help="PRNG: threefry2x32 = fully counter-based (default); "
+                   "rbg = TPU hardware bit generator, ~2x faster blocks "
+                   "(jax backend; see config.SimConfig.prng_impl)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, backend, n_chains, chain, sharded, checkpoint, block_s,
-          site_grid_spec, profile_dir, output):
+          site_grid_spec, profile_dir, output, prng_impl):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     if site_grid_spec and backend != "jax":
@@ -135,6 +140,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--profile requires --backend=jax")
     if output != "trace" and backend != "jax":
         raise click.UsageError("--output=reduce requires --backend=jax")
+    if prng_impl != "threefry2x32" and backend != "jax":
+        raise click.UsageError("--prng-impl requires --backend=jax")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
@@ -160,7 +167,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         pvsim_jax(file, duration_s, n_chains, seed, start, chain,
                   sharded, checkpoint, block_s, realtime=realtime,
                   site_grid=site_grid, profile_dir=profile_dir,
-                  output=output)
+                  output=output, prng_impl=prng_impl)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

@@ -25,6 +25,15 @@ import numpy as np
 _KEY_PREFIX = "key:"
 _META = "__meta__"
 
+#: Version of the *random-stream layout* (how draws are derived from keys
+#: and global indices).  Bump whenever the derivation changes — e.g. v2
+#: switched the per-second streams from per-second fold_in+split to
+#: minute-grouped counter draws — so a checkpoint from an older build is
+#: REFUSED (clear config-mismatch error) instead of silently resuming with
+#: different randomness and producing a hybrid trace no version can
+#: reproduce.
+RNG_STREAM_VERSION = 2
+
 
 def _config_echo(config) -> dict:
     """The full run configuration as JSON-able data — including site and
@@ -37,6 +46,8 @@ def _config_echo(config) -> dict:
         "seed": config.seed,
         "block_s": config.block_s,
         "dtype": config.dtype,
+        "prng_impl": getattr(config, "prng_impl", "threefry2x32"),
+        "rng_stream": RNG_STREAM_VERSION,
         "site": dataclasses.asdict(config.site),
         "site_grid": (dataclasses.asdict(config.site_grid)
                       if config.site_grid is not None else None),
@@ -59,12 +70,14 @@ def _flatten(tree, prefix=""):
     return out
 
 
-def _unflatten(flat):
+def _unflatten(flat, prng_impl: str = "threefry2x32"):
     tree = {}
     for path, value in flat.items():
         if path.startswith(_KEY_PREFIX):
             path = path[len(_KEY_PREFIX):]
-            value = jax.random.wrap_key_data(value)
+            # key_data layout depends on the PRNG impl (threefry: 2 words,
+            # rbg: 4), so the impl rides the checkpoint metadata
+            value = jax.random.wrap_key_data(value, impl=prng_impl)
         node = tree
         *parents, leaf = path.split("/")
         for p in parents:
@@ -86,7 +99,15 @@ def save(path: str, state, next_block: int, config=None) -> None:
     flat = _flatten(state)
     meta = {"next_block": int(next_block)}
     if config is not None:
+        meta["prng_impl"] = getattr(config, "prng_impl", "threefry2x32")
         meta["config"] = _config_echo(config)
+    else:
+        # no config: infer the impl from the stored key_data layout
+        # (threefry: 2 words, rbg: 4) so bare save()/load() round-trips
+        # still reconstruct the right key type
+        widths = {v.shape[-1] for k, v in flat.items()
+                  if k.startswith(_KEY_PREFIX)}
+        meta["prng_impl"] = "rbg" if widths == {4} else "threefry2x32"
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **flat, **{_META: json.dumps(meta)})
@@ -104,6 +125,7 @@ def load(path: str, config=None) -> Tuple[dict, int]:
     with np.load(path, allow_pickle=False) as data:
         meta = json.loads(str(data[_META]))
         flat = {k: data[k] for k in data.files if k != _META}
+    prng_impl = meta.get("prng_impl", "threefry2x32")
     if config is not None and "config" in meta:
         saved = meta["config"]
         # Echoes written before a key existed compare as that key's
@@ -111,6 +133,11 @@ def load(path: str, config=None) -> Tuple[dict, int]:
         # echo schema grows (keys added in round 2 listed here).
         saved.setdefault("site_grid", None)
         saved.setdefault("output", "trace")
+        saved.setdefault("prng_impl", "threefry2x32")
+        # no rng_stream key = stream layout v1: deliberately NOT defaulted
+        # to the current version, so pre-v2 checkpoints are refused rather
+        # than resumed onto a different random stream
+        saved.setdefault("rng_stream", 1)
         current = json.loads(json.dumps(_config_echo(config)))  # tuple->list
         if saved != current:
             keys = set(saved) | set(current)
@@ -122,4 +149,4 @@ def load(path: str, config=None) -> Tuple[dict, int]:
                 f"checkpoint was written by a different configuration: "
                 f"{diffs}"
             )
-    return _unflatten(flat), meta["next_block"]
+    return _unflatten(flat, prng_impl), meta["next_block"]

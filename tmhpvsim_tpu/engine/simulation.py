@@ -25,9 +25,12 @@ Execution layout (SURVEY.md §7 step 7):
 * all chain state lives in one pytree carried block to block: sampler value
   arrays + renewal carry + per-chain keys.  Serialising it (plus the block
   offset) IS the checkpoint (SURVEY.md §5 "checkpoint/resume");
-* every random draw is keyed by a *global* index (second / sampler-value
-  index), so results are bit-identical under any block partition — verified
-  by test_block_split_invariance and the engine block-size test.
+* every random draw is keyed by a *global* index (minute group for the
+  per-second streams — one hash per minute, 60 counter-mode values — and
+  sampler-value index for the slower samplers), so results are
+  bit-identical under any block partition (block_s is always a multiple
+  of 60) — verified by test_block_split_invariance and the engine
+  block-size test.
 
 The per-block device work is one fused computation: per-second csi scan
 (VPU, O(1) carry) -> elementwise PV physics over (chains × block_s) ->
@@ -120,7 +123,7 @@ class Simulation:
         self.n_blocks = self._padded_s // config.block_s
         self._n_minute_vals = None  # fixed after first block (constant shape)
 
-        root = jax.random.key(config.seed)
+        root = jax.random.key(config.seed, impl=config.prng_impl)
         self._k_chains, _ = jax.random.split(root)
         self._block_jit = jax.jit(self._block_step)
         self._stats_jit = jax.jit(self._block_stats)
@@ -304,12 +307,14 @@ class Simulation:
             ac = pvmod.power_from_csi(
                 csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
             )
-            meter_keys = jax.vmap(
-                lambda i: jax.random.fold_in(chain["k_meter"], i)
-            )(block_idx["t"])
+            # one hash per global minute + counter-mode 60-draws: see
+            # ci.csi_scan_block on why (threefry cost dominates the block)
+            meter_keys, off = ci.minute_grouped_keys(
+                chain["k_meter"], block_idx["t"]
+            )
             meter = cfg.meter_max_w * jax.vmap(
-                lambda k: jax.random.uniform(k, (), dtype)
-            )(meter_keys)
+                lambda k: jax.random.uniform(k, (60,), dtype)
+            )(meter_keys).reshape(-1)[off]
             return dict(chain, carry=carry), meter, ac
 
         return jax.vmap(one_chain)(state)

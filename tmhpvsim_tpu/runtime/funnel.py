@@ -21,12 +21,14 @@ Deviations from the reference (both documented in SURVEY.md §5):
   value (so the join can always progress — this ordering makes the wait
   deadlock-free), then blocks until the other streams are within the
   window.  A stream that has never delivered imposes no constraint (there
-  is no clock to be ahead of).  If the other streams stay *silent* for
+  is no clock to be ahead of).  All stall decisions key on the BINDING
+  stream — the one pinning min(newest): if it makes no progress for
   ``stall_timeout_s`` the funnel logs and suspends that producer's
-  backpressure until they advance again — so a meter feed that dies
+  backpressure until it advances again — so a meter feed that dies
   degrades to the old free-run-and-evict behaviour instead of hanging the
-  app, while a merely slow one keeps blocking the producer (any progress
-  resets the stall clock).
+  app, while a merely slow one keeps blocking the producer (the binding
+  stream's progress, and only its progress, resets the stall clock —
+  other live streams must not mask a dead one).
 """
 
 from __future__ import annotations
@@ -65,8 +67,9 @@ class SynchronizingFunnel:
         self.n_evicted = 0
         self._newest: dict = {}       # field -> newest time delivered
         self._advanced = asyncio.Event()
-        #: per-producer suspension: {other-streams key -> floors tuple at
-        #: the moment that producer's backpressure gave up}
+        #: per-producer suspension: {other-streams key -> the BINDING
+        #: (minimum) floor at the moment that producer's backpressure gave
+        #: up; cleared when it advances}
         self._suspended: dict = {}
 
     def __len__(self):
