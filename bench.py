@@ -30,11 +30,15 @@ import subprocess
 import sys
 import time
 
-# Sized so one block's working set (chains x block_s) fits comfortably in
-# HBM: 8192 chains x 8640 s x 4 B x ~4 live arrays ~= 1.1 GB.
-N_CHAINS = 8192
-BLOCK_S = 8640
-N_BLOCKS = 5  # timed steady-state blocks
+# Shape chosen by measurement (round 3): throughput saturates with total
+# per-block work, and XLA materialises ~20 (block_s, chains) f32 temps, so
+# more chains with proportionally smaller blocks beats the reverse; 65536
+# x 1080 was the best point tried that stays well inside HBM.
+N_CHAINS = 65536
+BLOCK_S = 1080
+N_BLOCKS = 5   # timed steady-state blocks per round
+N_ROUNDS = 3   # best-of rounds: the remote-TPU tunnel's throughput varies
+               # ~2x run to run, so a single timing is not trustworthy
 
 # CPU fallback: same shape of work, sized to finish in seconds, clearly
 # labelled — it exists so the harness records *something* diagnosable
@@ -105,10 +109,12 @@ def main() -> None:
     except Exception as e:  # single-process bench must not die on this
         print(f"# jax.distributed init skipped: {e}", file=sys.stderr)
 
+    n_rounds = N_ROUNDS if not fallback else 1
+
     def make_cfg(n):
         return SimConfig(
             start="2019-09-05 00:00:00",
-            duration_s=BLOCK_S * (n_blocks + 1),
+            duration_s=BLOCK_S * (n_blocks * n_rounds + 1),
             n_chains=n,
             seed=0,
             block_s=BLOCK_S,
@@ -116,8 +122,10 @@ def main() -> None:
         )
 
     def timed_reduce_run(sim):
-        """(compile_s, steady_s, rate) for one warm-up + n_blocks timed
-        reduce-mode blocks through the public step_acc path."""
+        """(compile_s, best_steady_s, best_rate): one warm-up block, then
+        n_rounds x n_blocks timed reduce-mode blocks through the public
+        step_acc path, best round kept (the tunnel TPU's throughput varies
+        ~2x between otherwise identical runs)."""
         sim.state = sim.init_state()
         acc = sim.init_reduce_acc()
         t_c = time.perf_counter()
@@ -126,14 +134,18 @@ def main() -> None:
         jax.block_until_ready(acc)
         compile_s = time.perf_counter() - t_c
 
-        t0 = time.perf_counter()
-        for bi in range(1, n_blocks + 1):
-            inputs, _ = sim.host_inputs(bi)
-            sim.state, acc = sim.step_acc(sim.state, inputs, acc)
-        jax.block_until_ready(acc)
-        dt = time.perf_counter() - t0
+        best = float("inf")
+        bi = 1
+        for _ in range(n_rounds):
+            t0 = time.perf_counter()
+            for _ in range(n_blocks):
+                inputs, _ = sim.host_inputs(bi)
+                bi += 1
+                sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+            jax.block_until_ready(acc)
+            best = min(best, time.perf_counter() - t0)
         n = sim.config.n_chains
-        return compile_s, dt, n * BLOCK_S * n_blocks / dt
+        return compile_s, best, n * BLOCK_S * n_blocks / best
 
     sim = Simulation(make_cfg(n_chains))
     compile_s, dt, rate = timed_reduce_run(sim)
@@ -154,25 +166,30 @@ def main() -> None:
             "n_chains": sh_chains,
             "rate_per_chip": round(sh_rate / n_dev, 1),
             "compile_s": round(sh_compile_s, 1),
-            "wall_s": round(sh_dt, 2),
+            "best_round_wall_s": round(sh_dt, 2),
         }
     except Exception as e:  # sharded failure must not lose the main number
         print(f"# sharded bench failed: {e}", file=sys.stderr)
         sharded = {"error": str(e)[:200]}
 
     ref_ceiling = 100.0  # simulated s/s/process, reference --no-realtime
+    # north star (BASELINE.json): 100k site-years < 60 s on v5e-8
+    # = 100_000 * 365.25 * 86400 / 60 / 8 site-s/s/chip
+    north_star = 100_000 * 365.25 * 86400 / 60.0 / 8.0
     print(json.dumps({
         "metric": "simulated site-seconds/sec/chip",
         "value": round(rate, 1),
         "unit": "site-s/s/chip",
         "vs_baseline": round(rate / ref_ceiling, 1),
+        "north_star_frac": round(rate / north_star, 3),
         "platform": platform,
         "tpu": platform == "tpu",
         "n_chains": n_chains,
         "block_s": BLOCK_S,
         "timed_blocks": n_blocks,
+        "timed_rounds": n_rounds,
         "compile_s": round(compile_s, 1),
-        "wall_s": round(dt, 2),
+        "best_round_wall_s": round(dt, 2),
         "sharded": sharded,
     }))
 

@@ -139,7 +139,8 @@ class TestFunnel:
 
     def test_backpressure_ignores_stream_that_never_delivered(self):
         """A stream with no values yet has no clock to be ahead of: pv puts
-        must not block at all before the first meter message."""
+        must not block before the first meter message (until the
+        max_initial_pending cache cap)."""
         import time
 
         async def go():
@@ -153,6 +154,32 @@ class TestFunnel:
         t0 = time.perf_counter()
         assert run(go()) == 50
         assert time.perf_counter() - t0 < 1.0  # no stall waits
+
+    def test_backpressure_initial_pending_cap(self):
+        """Before the other stream's first value, a producer may pile up at
+        most max_initial_pending records, then must wait — so a
+        slow-to-start peer's joinable records aren't evicted; its first
+        delivery releases the producer into the normal lookahead window."""
+
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out, max_lookahead=100,
+                                         stall_timeout_s=30.0,
+                                         max_initial_pending=5)
+
+            async def pv_producer():
+                for t in range(20):
+                    await funnel.put(t, pv=float(t))
+
+            task = asyncio.ensure_future(pv_producer())
+            await asyncio.sleep(0.05)
+            assert not task.done()
+            assert len(funnel) == 6  # cap + the blocked put's own record
+            await funnel.put(0, meter=1.0)  # first delivery -> window mode
+            await asyncio.wait_for(task, timeout=5)
+            return out.qsize()
+
+        assert run(go()) == 1  # t=0 joined
 
     def test_backpressure_three_streams_dead_plus_live(self):
         """3-stream join, one constraint stream dead and one live: the live

@@ -22,9 +22,13 @@ from tmhpvsim_tpu.runtime.broker import make_transport
 
 logger = logging.getLogger(__name__)
 
+#: demand ceiling [W] — the reference's uniform [0, 9000) (metersim.py:49-51);
+#: SimConfig.meter_max_w is the engine-side owner of the same value
+METER_MAX_W = 9000.0
+
 
 def get_meter_value(rng: Optional[np.random.Generator] = None,
-                    max_w: float = 9000.0) -> float:
+                    max_w: float = METER_MAX_W) -> float:
     """One uniform [0, max_w) demand sample (metersim.py:49-51)."""
     rng = rng if rng is not None else np.random.default_rng()
     return float(max_w * rng.random())
@@ -38,6 +42,57 @@ async def read_meter_values(queue: asyncio.Queue, realtime: bool,
     async for time in fixedclock(rate=1, realtime=realtime, start=start,
                                  duration_s=duration_s):
         await queue.put((time, get_meter_value(rng)))
+
+
+async def read_meter_values_jax(queue: asyncio.Queue, realtime: bool,
+                                seed=None, duration_s=None,
+                                start: Optional[_dt.datetime] = None,
+                                block_s: int = 600,
+                                prng_impl: str = "threefry2x32") -> None:
+    """Device-batched producer: the ``--backend=jax`` meter stream.
+
+    Same external behaviour as :func:`read_meter_values` (one uniform
+    [0, METER_MAX_W) value per fixedclock tick into the queue), but the
+    values are generated on device in ``block_s``-second blocks with the
+    engine's keyed scheme (``ci.minute_grouped_keys``: one threefry key
+    per minute index, 60 counter-mode draws — the same helper the
+    simulation's meter stream uses), so a run is deterministic per seed
+    and the publisher empties a device buffer instead of calling the RNG
+    per second.  The device call runs in a worker thread: the first block
+    triggers XLA compilation (seconds — and this environment's remote-TPU
+    backend can stall outright), which must not freeze the event loop the
+    publisher and broker heartbeats live on."""
+    import jax
+    import jax.numpy as jnp
+
+    from tmhpvsim_tpu.models import clearsky_index as ci
+
+    if start is None:
+        start = _dt.datetime.now()
+    start = start.replace(microsecond=0)
+    if seed is None:
+        import secrets
+
+        seed = secrets.randbits(31)
+    root = jax.random.key(seed, impl=prng_impl)
+    assert block_s % 60 == 0
+
+    @jax.jit
+    def block_vals(sec0):
+        t = sec0 + jnp.arange(block_s)
+        return ci.meter_block(root, t, METER_MAX_W)
+
+    vals, i, sec = None, 0, 0
+    async for time in fixedclock(rate=1, realtime=realtime, start=start,
+                                 duration_s=duration_s):
+        if vals is None or i == block_s:
+            vals = await asyncio.to_thread(
+                lambda s: np.asarray(block_vals(s)), sec
+            )
+            i = 0
+        await queue.put((time, float(vals[i])))
+        i += 1
+        sec += 1
 
 
 async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
@@ -67,13 +122,21 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
 
 
 async def metersim_main(amqp_url, exchange, realtime, seed=None,
-                        duration_s=None, start=None) -> None:
-    """App orchestrator (metersim.py:64-77): producer + publisher tasks."""
+                        duration_s=None, start=None,
+                        backend: str = "asyncio") -> None:
+    """App orchestrator (metersim.py:64-77): producer + publisher tasks.
+    ``backend='jax'`` swaps the per-second numpy producer for the
+    device-batched one; the transport/publisher side is identical."""
     queue: asyncio.Queue = asyncio.Queue()
-    rng = np.random.default_rng(seed)
-    read = asyncio.create_task(
-        read_meter_values(queue, realtime, rng, duration_s, start)
-    )
+    if backend == "jax":
+        read = asyncio.create_task(
+            read_meter_values_jax(queue, realtime, seed, duration_s, start)
+        )
+    else:
+        rng = np.random.default_rng(seed)
+        read = asyncio.create_task(
+            read_meter_values(queue, realtime, rng, duration_s, start)
+        )
     send = asyncio.create_task(send_queue_to_transport(queue, amqp_url,
                                                        exchange))
     try:

@@ -85,13 +85,18 @@ def _parse_site_grid(spec):
 
 @click.command()
 @_common_options
-def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start):
+@click.option("--backend", type=click.Choice(["asyncio", "jax"]),
+              default="asyncio",
+              help="asyncio: per-second numpy sampling (reference); jax: "
+                   "device-batched blocks feeding the same publisher")
+def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
+             backend):
     """1 Hz electricity-demand producer (reference metersim.py:79-95)."""
     from tmhpvsim_tpu.apps.metersim import metersim_main
 
     _setup_logging(verbose)
     asyncrun(metersim_main(amqp_url, exchange, realtime, seed, duration_s,
-                           _parse_start(start)))
+                           _parse_start(start), backend=backend))
 
 
 @click.command()

@@ -309,12 +309,9 @@ class Simulation:
             )
             # one hash per global minute + counter-mode 60-draws: see
             # ci.csi_scan_block on why (threefry cost dominates the block)
-            meter_keys, off = ci.minute_grouped_keys(
-                chain["k_meter"], block_idx["t"]
+            meter = ci.meter_block(
+                chain["k_meter"], block_idx["t"], cfg.meter_max_w, dtype
             )
-            meter = cfg.meter_max_w * jax.vmap(
-                lambda k: jax.random.uniform(k, (60,), dtype)
-            )(meter_keys).reshape(-1)[off]
             return dict(chain, carry=carry), meter, ac
 
         return jax.vmap(one_chain)(state)

@@ -217,6 +217,16 @@ def minute_grouped_keys(key, t):
     return keys, t - g0 * 60
 
 
+def meter_block(key, t, max_w, dtype=jnp.float32):
+    """Uniform [0, max_w) demand per second of ``t``, minute-grouped keys —
+    THE meter stream derivation, shared by the engine's per-chain stream
+    (engine/simulation.py ``_block_step``) and the standalone jax metersim
+    producer (apps/metersim.py) so the two can never diverge."""
+    kg, off = minute_grouped_keys(key, t)
+    draws = jax.vmap(lambda k: jax.random.uniform(k, (60,), dtype))(kg)
+    return max_w * draws.reshape(-1)[off]
+
+
 def _minute_grouped_draws(key, t, dtype):
     """(uniform, normal) per second of ``t``, one hash per minute."""
     kg, off = minute_grouped_keys(key, t)

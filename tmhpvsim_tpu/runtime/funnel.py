@@ -20,8 +20,12 @@ Deviations from the reference (both documented in SURVEY.md §5):
   run ahead of the slowest *other* stream: ``put`` first delivers its
   value (so the join can always progress — this ordering makes the wait
   deadlock-free), then blocks until the other streams are within the
-  window.  A stream that has never delivered imposes no constraint (there
-  is no clock to be ahead of).  All stall decisions key on the BINDING
+  window.  A stream that has never delivered imposes no *time* constraint
+  (there is no clock to be ahead of), but ``max_initial_pending`` caps how
+  many records a producer may pile up before it — otherwise a slow-to-
+  start peer (first-block XLA compile, broker reconnect) would watch its
+  joinable records get evicted before its first value.  All stall
+  decisions key on the BINDING
   stream — the one pinning min(newest): if it makes no progress for
   ``stall_timeout_s`` the funnel logs and suspends that producer's
   backpressure until it advances again — so a meter feed that dies
@@ -40,6 +44,11 @@ from typing import NamedTuple, Optional, Type
 
 logger = logging.getLogger(__name__)
 
+#: sentinel: "use the default initial-pending cap, clamped under
+#: max_pending" — distinct from an explicit value (validated) or None
+#: (disabled)
+_DEFAULT_INITIAL = object()
+
 
 class SynchronizingFunnel:
     """Merge per-timestamp partial records; emit completed ones in put-order.
@@ -53,7 +62,8 @@ class SynchronizingFunnel:
                  queue: "asyncio.Queue",
                  max_pending: Optional[int] = 10_000,
                  max_lookahead=None,
-                 stall_timeout_s: float = 10.0):
+                 stall_timeout_s: float = 10.0,
+                 max_initial_pending: Optional[int] = _DEFAULT_INITIAL):
         self._type = record_type
         self._blank = record_type(*([math.nan] * len(record_type._fields)))
         self._queue = queue
@@ -64,6 +74,26 @@ class SynchronizingFunnel:
         #: number for numeric grids); None disables backpressure
         self.max_lookahead = max_lookahead
         self.stall_timeout_s = stall_timeout_s
+        #: before the other streams deliver their FIRST value there is no
+        #: clock to be ahead of, but an unbounded free-run would fill the
+        #: cache past max_pending and evict the very records the late
+        #: stream will want to join (e.g. pv racing ahead while a jax
+        #: metersim compiles its first block).  Cap the pending records a
+        #: producer may accumulate in that window; stall/suspend semantics
+        #: apply as usual if the other stream never shows up.
+        if max_initial_pending is _DEFAULT_INITIAL:
+            # default: clamp under max_pending so eviction can never keep
+            # the cache below the cap and silently disable it
+            max_initial_pending = 3600 if max_pending is None \
+                else min(3600, max(1, max_pending // 2))
+        elif (max_pending is not None and max_initial_pending is not None
+                and max_initial_pending >= max_pending):
+            raise ValueError(
+                f"max_initial_pending ({max_initial_pending}) must be < "
+                f"max_pending ({max_pending}): eviction would keep the "
+                "cache below the cap and silently disable it"
+            )
+        self.max_initial_pending = max_initial_pending
         self.n_evicted = 0
         self._newest: dict = {}       # field -> newest time delivered
         self._advanced = asyncio.Event()
@@ -108,22 +138,28 @@ class SynchronizingFunnel:
         last_binding = None if first is None else min(first)
         while True:
             floors = self._floors(others)
-            if floors is None:
-                # a stream that never delivered has no clock to be ahead
-                # of; backpressure starts at its first value
-                return
             # All decisions key on the BINDING floor (the slowest other
             # stream): with 3+ streams, a live stream's progress must
             # neither reset the stall clock for a dead one pinning the
-            # minimum, nor re-arm a suspension taken against it.
-            binding = min(floors)
+            # minimum, nor re-arm a suspension taken against it.  A None
+            # binding means some stream has not delivered at all yet —
+            # no clock to be ahead of, but the pending-cache cap applies.
+            binding = None if floors is None else min(floors)
             if others in self._suspended:
-                if binding <= self._suspended[others]:
+                susp = self._suspended[others]
+                advanced = (binding is not None
+                            and (susp is None or binding > susp))
+                if not advanced:
                     return  # still stalled: stay in free-run mode
                 del self._suspended[others]  # it advanced: re-arm
-            if time <= binding + self.max_lookahead:
+            if binding is None:
+                if self.max_initial_pending is None or \
+                        len(self._cache) <= self.max_initial_pending:
+                    return
+            elif time <= binding + self.max_lookahead:
                 return
-            if last_binding is None or binding > last_binding:
+            if binding is not None and \
+                    (last_binding is None or binding > last_binding):
                 # progress of the binding stream resets the stall clock:
                 # only a genuinely *silent* constraint trips the timeout, a
                 # slow-but-live one keeps this producer blocked (that is
