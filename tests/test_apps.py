@@ -157,6 +157,27 @@ def test_cli_pvsim_jax_reduce_mode(tmp_path):
     assert float(rows[-1][pv_sum]) == pytest.approx(chain_total, rel=1e-4)
 
 
+def test_cli_pvsim_ensemble_mode(tmp_path):
+    """--output=ensemble: reference row shape, fleet-mean values."""
+    out = tmp_path / "ens.csv"
+    r = CliRunner().invoke(
+        cli_main,
+        ["pvsim", str(out), "--backend=jax", "--no-realtime",
+         "--duration", "180", "--chains", "4", "--seed", "5",
+         "--output", "ensemble", "--start", "2019-09-05 10:00:00"],
+    )
+    assert r.exit_code == 0, r.output
+    with open(out) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["time", "meter", "pv", "residual load"]
+    assert len(rows) == 1 + 180
+    for _, meter, pv, residual in rows[1:]:
+        assert 0 <= float(meter) < 9000  # mean of uniforms stays in range
+        assert float(meter) - float(pv) == pytest.approx(
+            float(residual), abs=1e-2
+        )
+
+
 def test_cli_pvsim_site_grid(tmp_path):
     """--site-grid: one chain per grid site, end to end through the CLI."""
     out = tmp_path / "grid.csv"

@@ -147,6 +147,24 @@ def test_step_reduced_is_one_block_of_stats(run):
     assert (np.asarray(stats["n_seconds"]) == 3600).all()
 
 
+def test_ensemble_mode_is_chain_mean(run):
+    """run_ensemble must yield exactly the per-second mean over chains of
+    the trace-mode blocks (same seed, same stream)."""
+    _, blocks = run
+    sim = Simulation(small_config())
+    for eblk, tblk in zip(sim.run_ensemble(), blocks):
+        assert eblk.meter.shape == (1, tblk.meter.shape[1])
+        np.testing.assert_allclose(
+            eblk.meter[0], tblk.meter.mean(axis=0), rtol=1e-5, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            eblk.pv[0], tblk.pv.mean(axis=0), rtol=1e-5, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            eblk.residual[0], eblk.meter[0] - eblk.pv[0], rtol=1e-6
+        )
+
+
 def test_rbg_prng_impl_end_to_end():
     """prng_impl='rbg' (TPU hardware bit generator) must run the whole
     chain and keep the physical invariants; streams differ from threefry

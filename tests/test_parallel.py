@@ -70,6 +70,17 @@ def test_ensemble_psum_is_global_mean():
         )
 
 
+def test_sharded_ensemble_mode_matches_single():
+    """run_ensemble under shard_map (psum consumer) must agree with the
+    single-device fleet mean to the usual ULP tolerance."""
+    single = list(Simulation(cfg()).run_ensemble())
+    sharded = list(ShardedSimulation(cfg()).run_ensemble())
+    assert len(single) == len(sharded)
+    for a, b in zip(single, sharded):
+        np.testing.assert_allclose(a.meter, b.meter, rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(a.pv, b.pv, rtol=1e-5, atol=1e-3)
+
+
 def test_uneven_chains_rejected():
     with pytest.raises(ValueError, match="divisible"):
         ShardedSimulation(cfg(n_chains=6))

@@ -154,8 +154,14 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
 
     With ``output='reduce'``, no per-second trace is materialised at all:
     per-chain running statistics accumulate on device and FILE gets one
-    summary row per chain plus an ``ensemble`` row — the only output mode
-    that scales to the 100k-1M chain configs (BASELINE #4/#5).
+    summary row per chain plus an ``ensemble`` row — the output mode that
+    scales to the 100k-1M chain configs (BASELINE #4/#5).
+
+    With ``output='ensemble'``, FILE gets the reference's row-per-second
+    CSV shape but each row is the fleet MEAN over all chains (the "grid
+    operator" stream): only (block_s,) vectors reach the host, so this
+    also scales to 100k+ chains — one psum per block on a sharded mesh.
+    Checkpoint/resume and --realtime pacing work exactly as in trace mode.
     """
     import contextlib
     import os
@@ -250,6 +256,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         )
         return
 
+    if output == "ensemble" and chain != 0:
+        raise ValueError("ensemble mode writes the fleet mean; --chain "
+                         "does not apply (drop it or use trace mode)")
+
     state, start_block = None, 0
     if checkpoint and os.path.exists(checkpoint):
         state, start_block = ckpt.load(checkpoint, cfg)
@@ -269,10 +279,11 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             )
 
     timer = BlockTimer(cfg.n_chains, cfg.block_s)
+    runner = sim.run_ensemble if output == "ensemble" else sim.run_blocks
 
     def blocks():
         for bi, blk in enumerate(
-            sim.run_blocks(state=state, start_block=start_block),
+            runner(state=state, start_block=start_block),
             start=start_block,
         ):
             timer.tick()

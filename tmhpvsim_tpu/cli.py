@@ -125,10 +125,12 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
 @click.option("--profile", "profile_dir", default=None,
               help="Write a jax.profiler device trace to this directory "
                    "(jax backend; view in TensorBoard/Perfetto)")
-@click.option("--output", type=click.Choice(["trace", "reduce"]),
+@click.option("--output", type=click.Choice(["trace", "reduce", "ensemble"]),
               default="trace",
-              help="trace: per-second CSV rows; reduce: on-device per-chain "
-                   "statistics only — scales to 100k+ chains (jax backend)")
+              help="trace: per-second CSV rows (one chain); reduce: "
+                   "on-device per-chain statistics only; ensemble: "
+                   "per-second fleet-mean rows — reduce/ensemble scale to "
+                   "100k+ chains (jax backend)")
 @click.option("--prng-impl", type=click.Choice(["threefry2x32", "rbg"]),
               default="threefry2x32",
               help="PRNG: threefry2x32 = fully counter-based (default); "
@@ -144,7 +146,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
     if profile_dir and backend != "jax":
         raise click.UsageError("--profile requires --backend=jax")
     if output != "trace" and backend != "jax":
-        raise click.UsageError("--output=reduce requires --backend=jax")
+        raise click.UsageError(f"--output={output} requires --backend=jax")
     if prng_impl != "threefry2x32" and backend != "jax":
         raise click.UsageError("--prng-impl requires --backend=jax")
     if backend == "jax":

@@ -150,8 +150,10 @@ class SimConfig:
     #: intervals and every block compiles to the same shapes
     block_s: int = 8640
 
-    #: 'trace'  -> per-second (meter, pv, residual) arrays are returned
-    #: 'reduce' -> only per-chain running statistics (sum/min/max/count)
+    #: 'trace'    -> per-second (meter, pv, residual) arrays are returned
+    #: 'reduce'   -> only per-chain running statistics (sum/min/max/count)
+    #: 'ensemble' -> per-second fleet means (one psum per block when
+    #:               sharded; only (block_s,) vectors reach the host)
     output: str = "trace"
 
     #: computation dtype for the per-second path on device

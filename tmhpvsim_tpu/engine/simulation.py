@@ -128,6 +128,7 @@ class Simulation:
         self._block_jit = jax.jit(self._block_step)
         self._stats_jit = jax.jit(self._block_stats)
         self._stats_acc_jit = jax.jit(self._block_stats_acc)
+        self._series_jit = jax.jit(self._ensemble_series)
         #: memoized jitted initializers keyed by (kind, sharding) — a fresh
         #: jax.jit(closure) per call would never hit the trace cache, which
         #: matters for per-block users of step_reduced/init_reduce_acc
@@ -341,6 +342,39 @@ class Simulation:
         state, meter, pv = self._block_jit(state, inputs)
         return state, self._stats_jit(meter, pv, inputs["block_idx"]["t"])
 
+    def _ensemble_series(self, meter, pv):
+        """Per-second cross-chain sums of one block's materialised arrays
+        (its own jit via ``_series_jit`` — the usual no-refusion split).
+        Returns (meter_sum, pv_sum), each (block_s,)."""
+        return meter.sum(axis=0), pv.sum(axis=0)
+
+    def run_ensemble(self, state=None, start_block: int = 0
+                     ) -> Iterator[BlockResult]:
+        """Fleet-level 1 Hz time series: per-second MEANS of meter, pv and
+        residual over all chains — the "grid operator" stream.  Yields
+        BlockResults whose arrays have a leading axis of 1 (the fleet
+        mean), so every trace consumer (write_csv, _paced, checkpointing)
+        works unchanged; only (block_s,) vectors ever reach the host, so
+        this scales to the 100k-1M chain configs like reduce mode while
+        still producing the reference's row-per-second CSV shape.
+        """
+        inv_n = 1.0 / self.config.n_chains
+
+        def make(off, epoch, meter, pv, n_valid):
+            m_sum, p_sum = self._series_jit(meter, pv)
+            m = self._repl_view(m_sum)[None, :n_valid] * inv_n
+            p = self._repl_view(p_sum)[None, :n_valid] * inv_n
+            return BlockResult(offset=off, epoch=epoch, meter=m, pv=p,
+                               residual=m - p)
+
+        return self._iter_blocks(state, start_block, make)
+
+    @staticmethod
+    def _repl_view(arr) -> np.ndarray:
+        """Host copy of a replicated result (overridden by the sharded
+        class for non-addressable meshes)."""
+        return np.asarray(arr)
+
     def init_reduce_acc(self, sharding=None):
         """Zero accumulator for the reduce-mode run: one (n_chains,) leaf per
         statistic, kept ON DEVICE across all blocks so reduce mode never
@@ -389,27 +423,37 @@ class Simulation:
     # run loops
     # ------------------------------------------------------------------
 
-    def run_blocks(self, state=None, start_block: int = 0
-                   ) -> Iterator[BlockResult]:
-        """Yield BlockResults in time order; padding trimmed from the last."""
+    def _iter_blocks(self, state, start_block: int, make_result
+                     ) -> Iterator[BlockResult]:
+        """THE per-block loop, shared by every trace-shaped mode (single
+        and sharded run_blocks, run_ensemble): init/place state, run the
+        producer jit, trim grid padding, delegate the gather to
+        ``make_result(off, epoch, meter, pv, n_valid)``."""
         cfg = self.config
-        if state is None:
-            state = self.init_state()
+        state = self.init_state() if state is None \
+            else self._place_resume(state)
         self.state = state
         for bi in range(start_block, self.n_blocks):
             inputs, epoch = self.host_inputs(bi)
             self.state, meter, pv = self._block_jit(self.state, inputs)
             off = bi * cfg.block_s
             n_valid = min(cfg.block_s, cfg.duration_s - off)
-            m = np.asarray(meter)[:, :n_valid]
-            p = np.asarray(pv)[:, :n_valid]
-            yield BlockResult(
-                offset=off,
-                epoch=np.asarray(epoch[:n_valid]),
-                meter=m,
-                pv=p,
-                residual=m - p,  # host numpy: see _block_step docstring
-            )
+            yield make_result(off, np.asarray(epoch[:n_valid]),
+                              meter, pv, n_valid)
+
+    def _trace_result(self, off, epoch, meter, pv, n_valid) -> BlockResult:
+        """Per-chain gather: the trace-mode ``make_result``."""
+        m = self._host_view(meter)[:, :n_valid]
+        p = self._host_view(pv)[:, :n_valid]
+        return BlockResult(
+            offset=off, epoch=epoch, meter=m, pv=p,
+            residual=m - p,  # host numpy: see _block_step docstring
+        )
+
+    def run_blocks(self, state=None, start_block: int = 0
+                   ) -> Iterator[BlockResult]:
+        """Yield BlockResults in time order; padding trimmed from the last."""
+        return self._iter_blocks(state, start_block, self._trace_result)
 
     def run_reduced(self, state=None, on_block=None, acc=None,
                     start_block: int = 0):

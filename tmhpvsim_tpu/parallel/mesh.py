@@ -92,11 +92,13 @@ class ShardedSimulation(Simulation):
         self._sharded_stats_acc = self._build_sharded_stats_acc()
         self._trace_ensemble = self._build_trace_ensemble()
         self._sharded_ensemble = self._build_sharded_ensemble()
-        # Rebind the reduce-path jits to their shard_map versions (same
-        # signatures) so the parent's step_acc/run_reduced drive the
-        # sharded path unchanged — one copy of the per-block sequence.
+        # Rebind the reduce/ensemble-path jits to their shard_map versions
+        # (same signatures) so the parent's step_acc/run_reduced and
+        # run_ensemble drive the sharded path unchanged — one copy of each
+        # per-block sequence.
         self._block_jit = self._sharded_block
         self._stats_acc_jit = self._sharded_stats_acc
+        self._series_jit = self._trace_ensemble
 
     def init_state(self):
         return super().init_state(sharding=chain_sharding(self.mesh))
@@ -133,15 +135,17 @@ class ShardedSimulation(Simulation):
         return jax.jit(mapped)
 
     def _build_trace_ensemble(self):
-        """Trace-mode consumer: per-second ensemble sums of pv and residual
+        """Trace/ensemble-mode consumer: per-second sums of meter and pv
         over *all* chains — one ``psum`` over ICI, replicated on every chip.
         This collective is exactly where the reference's AMQP fan-out +
-        funnel join used to sit (SURVEY.md §2.4)."""
+        funnel join used to sit (SURVEY.md §2.4).  Same signature as the
+        parent's ``_ensemble_series``, so it rebinds as ``_series_jit``
+        and ``run_ensemble`` runs sharded unchanged."""
 
         def ens(meter, pv):
-            pv_sum = jax.lax.psum(pv.sum(axis=0), CHAIN_AXIS)
-            res_sum = jax.lax.psum((meter - pv).sum(axis=0), CHAIN_AXIS)
-            return pv_sum, res_sum
+            m_sum = jax.lax.psum(meter.sum(axis=0), CHAIN_AXIS)
+            p_sum = jax.lax.psum(pv.sum(axis=0), CHAIN_AXIS)
+            return m_sum, p_sum
 
         mapped = shard_map(
             ens, mesh=self.mesh,
@@ -246,29 +250,20 @@ class ShardedSimulation(Simulation):
         host's contiguous slice only (``_host_view``), while ``.ensemble``
         is always the global fleet view (replicated psum output) — so a
         per-host CSV writer and a global grid-operator stream both work on
-        a pod slice without any DCN gather."""
-        cfg = self.config
-        if state is None:
-            state = self.init_state()
-        self.state = state
-        inv_n = 1.0 / cfg.n_chains
-        for bi in range(start_block, self.n_blocks):
-            inputs, epoch = self.host_inputs(bi)
-            self.state, meter, pv = self._sharded_block(self.state, inputs)
-            pv_sum, res_sum = self._trace_ensemble(meter, pv)
-            off = bi * cfg.block_s
-            n_valid = min(cfg.block_s, cfg.duration_s - off)
-            m = self._host_view(meter)[:, :n_valid]
-            p = self._host_view(pv)[:, :n_valid]
-            blk = BlockResult(
-                offset=off,
-                epoch=np.asarray(epoch[:n_valid]),
-                meter=m,
-                pv=p,
-                residual=m - p,  # host numpy: see Simulation._block_step
-            )
+        a pod slice without any DCN gather.  Runs the parent's shared
+        block loop; only the gather differs (per-chain result + the psum
+        ensemble attachment)."""
+        inv_n = 1.0 / self.config.n_chains
+
+        def make(off, epoch, meter, pv, n_valid):
+            m_sum, p_sum = self._trace_ensemble(meter, pv)
+            blk = self._trace_result(off, epoch, meter, pv, n_valid)
+            ms = self._repl_view(m_sum)[:n_valid]
+            ps = self._repl_view(p_sum)[:n_valid]
             blk.ensemble = {
-                "pv_mean": self._repl_view(pv_sum)[:n_valid] * inv_n,
-                "residual_mean": self._repl_view(res_sum)[:n_valid] * inv_n,
+                "pv_mean": ps * inv_n,
+                "residual_mean": (ms - ps) * inv_n,
             }
-            yield blk
+            return blk
+
+        return self._iter_blocks(state, start_block, make)
