@@ -87,6 +87,13 @@ def main() -> None:
     fallback = platform is None
     if fallback:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # 8 virtual devices so the sharded entry still exercises (and
+        # times) the real shard_map mechanics, like tests/conftest.py
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     import jax
 
@@ -94,6 +101,10 @@ def main() -> None:
         # sitecustomize may have imported jax already; backends are lazy,
         # so redirecting the config here still works (tests/conftest.py).
         jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except Exception:
+            pass  # already created; the XLA_FLAGS path may still hold
         platform = "cpu-fallback"
         n_chains, n_blocks = CPU_N_CHAINS, CPU_N_BLOCKS
     else:

@@ -191,3 +191,88 @@ class TestSiteGridEngine:
         # unchanged grid resumes fine
         state, nb = ckpt.load(path, cfg)
         assert nb == 1
+
+
+class TestSiteGridFromCsv:
+    """SiteGrid.from_csv: arbitrary fleet lists (the --sites-csv path)."""
+
+    def _write(self, tmp_path, text):
+        p = tmp_path / "sites.csv"
+        p.write_text(text)
+        return str(p)
+
+    def test_full_columns(self, tmp_path):
+        path = self._write(tmp_path, (
+            "latitude,longitude,altitude,surface_tilt,surface_azimuth,"
+            "albedo,owner\n"
+            "48.1,11.6,520,30,180,0.2,alice\n"
+            "47.0,9.5,800,45,170,0.3,bob\n"
+        ))
+        g = SiteGrid.from_csv(path)
+        assert len(g) == 2
+        assert g.latitude == (48.1, 47.0)
+        assert g.altitude == (520.0, 800.0)
+        assert g.albedo == (0.2, 0.3)  # extra 'owner' column ignored
+
+    def test_defaults_applied(self, tmp_path):
+        path = self._write(tmp_path, (
+            "latitude,longitude\n48.1,11.6\n47.0,9.5\n"
+        ))
+        g = SiteGrid.from_csv(path)
+        assert g.altitude == (100.0, 100.0)
+        assert g.surface_tilt == (48.1, 47.0)  # tilt-equals-latitude
+        assert g.surface_azimuth == (180.0, 180.0)
+        assert g.albedo == (0.25, 0.25)
+
+    def test_missing_required_column(self, tmp_path):
+        path = self._write(tmp_path, "latitude,altitude\n48.1,100\n")
+        with pytest.raises(ValueError, match="longitude"):
+            SiteGrid.from_csv(path)
+
+    def test_bad_value_reports_line(self, tmp_path):
+        path = self._write(tmp_path,
+                           "latitude,longitude\n48.1,11.6\n48.2,oops\n")
+        with pytest.raises(ValueError, match="line 3"):
+            SiteGrid.from_csv(path)
+
+    def test_empty_file_rejected(self, tmp_path):
+        path = self._write(tmp_path, "latitude,longitude\n")
+        with pytest.raises(ValueError, match="no data rows"):
+            SiteGrid.from_csv(path)
+
+    def test_cli_sites_csv_end_to_end(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tmhpvsim_tpu.cli import main as cli_main
+
+        sites = self._write(tmp_path, (
+            "latitude,longitude\n48.1,11.6\n47.0,9.5\n46.0,8.0\n45.0,7.0\n"
+        ))
+        out = tmp_path / "fleet.csv"
+        r = CliRunner().invoke(cli_main, [
+            "pvsim", str(out), "--backend=jax", "--no-realtime",
+            "--duration", "120", "--seed", "5", "--sites-csv", sites,
+            "--output", "reduce", "--start", "2019-09-05 10:00:00",
+        ])
+        assert r.exit_code == 0, r.output
+        with open(out) as f:
+            lines = f.read().splitlines()
+        assert len(lines) == 1 + 4 + 1  # header + 4 sites + ensemble row
+
+    def test_ragged_and_blank_cells_rejected_cleanly(self, tmp_path):
+        # ragged row: longitude missing entirely
+        path = self._write(tmp_path, "latitude,longitude\n48.1\n")
+        with pytest.raises(ValueError, match="line 2.*required"):
+            SiteGrid.from_csv(path)
+        # blank required cell
+        path = self._write(tmp_path, "latitude,longitude\n,11.6\n")
+        with pytest.raises(ValueError, match="line 2.*required"):
+            SiteGrid.from_csv(path)
+
+    def test_line_numbers_skip_blank_lines(self, tmp_path):
+        path = self._write(
+            tmp_path,
+            "latitude,longitude\n48.1,11.6\n\n47.0,9.5\n48.2,oops\n",
+        )
+        with pytest.raises(ValueError, match="line 5"):
+            SiteGrid.from_csv(path)

@@ -122,6 +122,12 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
               help="Multi-site lat/lon grid 'LAT0:LAT1:NLAT,LON0:LON1:NLON' "
                    "— one chain per site, geometry on device (jax backend; "
                    "overrides --chains)")
+@click.option("--sites-csv", "sites_csv", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="Arbitrary site list from a CSV (columns latitude, "
+                   "longitude [, altitude, surface_tilt, surface_azimuth, "
+                   "albedo]) — one chain per row (jax backend; overrides "
+                   "--chains; mutually exclusive with --site-grid)")
 @click.option("--profile", "profile_dir", default=None,
               help="Write a jax.profiler device trace to this directory "
                    "(jax backend; view in TensorBoard/Perfetto)")
@@ -138,11 +144,15 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "(jax backend; see config.SimConfig.prng_impl)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, backend, n_chains, chain, sharded, checkpoint, block_s,
-          site_grid_spec, profile_dir, output, prng_impl):
+          site_grid_spec, sites_csv, profile_dir, output, prng_impl):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
-    if site_grid_spec and backend != "jax":
-        raise click.UsageError("--site-grid requires --backend=jax")
+    if (site_grid_spec or sites_csv) and backend != "jax":
+        raise click.UsageError("--site-grid/--sites-csv require "
+                               "--backend=jax")
+    if site_grid_spec and sites_csv:
+        raise click.UsageError("--site-grid and --sites-csv are mutually "
+                               "exclusive")
     if profile_dir and backend != "jax":
         raise click.UsageError("--profile requires --backend=jax")
     if output != "trace" and backend != "jax":
@@ -154,7 +164,15 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 
         if duration_s is None:
             raise click.UsageError("--duration is required with --backend=jax")
-        site_grid = _parse_site_grid(site_grid_spec)
+        if sites_csv:
+            from tmhpvsim_tpu.config import SiteGrid
+
+            try:
+                site_grid = SiteGrid.from_csv(sites_csv)
+            except ValueError as e:
+                raise click.UsageError(str(e)) from e
+        else:
+            site_grid = _parse_site_grid(site_grid_spec)
         if seed is None:
             import os as _os
 

@@ -30,6 +30,13 @@ class Site:
     linke_turbidity_monthly: tuple = LINKE_TURBIDITY_MONTHLY_MUNICH
 
 
+#: columns SiteGrid.from_csv reads (others in the file are ignored)
+_SITE_CSV_COLUMNS = frozenset({
+    "latitude", "longitude", "altitude", "surface_tilt",
+    "surface_azimuth", "albedo",
+})
+
+
 @dataclasses.dataclass(frozen=True)
 class SiteGrid:
     """Per-chain site parameters for multi-site runs (BASELINE config #3:
@@ -66,6 +73,63 @@ class SiteGrid:
 
     def __len__(self):
         return len(self.latitude)
+
+    @classmethod
+    def from_csv(cls, path: str, **kw):
+        """A site list from a CSV with header.  Required columns
+        ``latitude``, ``longitude``; optional ``altitude`` (default 100 m),
+        ``surface_tilt`` (default: the site's latitude — the reference's
+        tilt-equals-latitude convention, pvmodel.py:24), ``surface_azimuth``
+        (default 180 = south), ``albedo`` (default 0.25).  Extra columns
+        are ignored, so an asset-register export works as-is."""
+        import csv as _csv
+
+        rows = []
+        with open(path, newline="") as f:
+            reader = _csv.DictReader(f)
+            cols = set(reader.fieldnames or ()) & _SITE_CSV_COLUMNS
+            missing = {"latitude", "longitude"} - cols
+            if missing:
+                raise ValueError(
+                    f"{path}: missing required column(s) {sorted(missing)}"
+                )
+            for row in reader:
+                vals = {}
+                for k in cols:
+                    v = row.get(k)
+                    if v is None or v == "":  # ragged row / blank cell
+                        continue
+                    try:
+                        vals[k] = float(v)
+                    except ValueError:
+                        raise ValueError(
+                            f"{path} line {reader.line_num}: bad value "
+                            f"{v!r} for {k}"
+                        ) from None
+                if "latitude" not in vals or "longitude" not in vals:
+                    raise ValueError(
+                        f"{path} line {reader.line_num}: latitude and "
+                        "longitude are required in every row"
+                    )
+                rows.append(vals)
+        if not rows:
+            raise ValueError(f"{path}: no data rows")
+
+        def col(name, default=None):
+            return tuple(
+                r.get(name, r["latitude"] if default == "latitude"
+                      else default) for r in rows
+            )
+
+        return cls(
+            latitude=col("latitude"),
+            longitude=col("longitude"),
+            altitude=col("altitude", 100.0),
+            surface_tilt=col("surface_tilt", "latitude"),
+            surface_azimuth=col("surface_azimuth", 180.0),
+            albedo=col("albedo", 0.25),
+            **kw,
+        )
 
     @classmethod
     def regular(cls, lat_range, lon_range, n_lat: int, n_lon: int,
