@@ -1,39 +1,54 @@
-"""Benchmark: simulated site-seconds per wall second per chip.
+"""Benchmark harness: headline number + the five BASELINE configs.
 
-Runs the JAX-backend block loop (per-second stochastic csi scan + PV
-physics + meter stream, device-side reduction) for a large chain batch on
-whatever accelerator is available, and prints ONE JSON line:
+Default (no args) — the driver-run headline: simulated site-seconds per
+wall second per chip for the reduce-mode block loop (per-second stochastic
+csi scan + PV physics + meter stream, on-device statistics), printed as ONE
+JSON line:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Baseline: the reference caps at ~100 simulated seconds/sec/process under
 ``--no-realtime`` (the 10 ms sleep floor in fixedclock, utils.py:36;
 SURVEY.md §6) — vs_baseline is the speedup over that ceiling per chip.
+The headline config is the fastest documented mode: scan-fused block
+(SimConfig.block_impl='scan'), hardware PRNG (prng_impl='rbg'); the
+threefry and wide variants are measured and reported alongside it.
+
+Roofline fields: analytic+compiled accounting of the hot jit — flops and
+HBM bytes from XLA's own cost model (``compiled.cost_analysis()``), wall
+time from the steady-state measurement, reported as achieved GFLOP/s,
+GB/s, and fractions of the chip's peak VPU / HBM rates (see _PEAKS for
+the provenance of the peak numbers).
+
+Subcommands (artifact producers, run during the build, committed under
+benchmarks/):
+
+    bench.py --config N    one of the five BASELINE.md configs (1-5)
+    bench.py --scaling     1->8 device scaling on the virtual CPU mesh
+    bench.py --profile DIR jax.profiler trace of steady headline blocks
 
 Resilience: the environment pins ``JAX_PLATFORMS`` to a remote TPU tunnel
-whose backend init can *hang* (not just error) — round 1 lost its only
-measurement to exactly that.  Backend init happens deep inside process
-state, so the only safe probe is a separate process: we spawn a child that
-must complete one matmul within a deadline.  If it can't (twice), we flip
-this process to the CPU backend (backends initialise lazily, so the config
-update still takes effect — same mechanism as tests/conftest.py) and run a
-scaled-down benchmark so a number is ALWAYS produced.  The JSON line then
-carries ``"platform": "cpu-fallback"`` so nobody mistakes it for a TPU
-measurement.
+whose backend init can *hang* (not just error).  Backend init happens deep
+inside process state, so the only safe probe is a separate process: we
+spawn a child that must complete one matmul within a deadline.  If it
+can't (twice), we flip this process to the CPU backend (backends
+initialise lazily, so the config update still takes effect — same
+mechanism as tests/conftest.py) and run a scaled-down benchmark so a
+number is ALWAYS produced, labelled ``"platform": "cpu-fallback"``.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
 import sys
 import time
 
-# Shape chosen by measurement (round 3): throughput saturates with total
-# per-block work, and XLA materialises ~20 (block_s, chains) f32 temps, so
-# more chains with proportionally smaller blocks beats the reverse; 65536
-# x 1080 was the best point tried that stays well inside HBM.
+# Headline shape (chosen by measurement, rounds 3-4): with the scan-fused
+# block the throughput saturates with total per-block work; 65536 x 1080
+# stays well inside HBM while amortising dispatch.
 N_CHAINS = 65536
 BLOCK_S = 1080
 N_BLOCKS = 5   # timed steady-state blocks per round
@@ -45,6 +60,18 @@ N_ROUNDS = 3   # best-of rounds: the remote-TPU tunnel's throughput varies
 # rather than rc=1/rc=124 (the round-1 failure mode).
 CPU_N_CHAINS = 256
 CPU_N_BLOCKS = 2
+
+#: Peak rates used for the roofline fractions, per chip.
+#: * TPU v5e HBM: 819 GB/s (public v5e spec sheet).
+#: * TPU v5e VPU f32: ~6.1e12 op/s — DERIVED estimate, not a published
+#:   number: the public 197 TFLOP/s bf16 MXU spec with 4 128x128 MXUs
+#:   implies a ~1.5 GHz clock; the VPU is (8, 128) lanes x 4 independent
+#:   ALUs (scaling-book hardware chapter) = 4096 f32 lanes -> 6.1e12/s.
+#: Fractions against an estimated peak are labelled as such in the output.
+_PEAKS = {
+    "TPU v5 lite": {"hbm_gbs": 819.0, "vpu_f32_gops": 6100.0,
+                    "vpu_is_estimate": True},
+}
 
 _PROBE_SRC = (
     "import jax, jax.numpy as jnp;"
@@ -76,41 +103,171 @@ def _probe_backend(timeout_s: float) -> str | None:
     return (r.stdout or "").strip().splitlines()[-1] or None
 
 
-def main() -> None:
+def _force_cpu(n_devices: int = 8):
+    """Redirect this process to the CPU backend with virtual devices."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    # sitecustomize may have imported jax already; backends are lazy, so
+    # redirecting the config here still works (tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:
+        pass  # already created; the XLA_FLAGS path may still hold
+
+
+def _probe_or_fallback() -> tuple[str, bool]:
+    """(platform, fallback?) — probe the pinned backend, else force CPU."""
     platform = None
     for attempt, deadline in enumerate((180.0, 90.0), 1):
         platform = _probe_backend(deadline)
         if platform:
             break
         print(f"# probe attempt {attempt} failed", file=sys.stderr)
+    if platform is None:
+        _force_cpu()
+        return "cpu-fallback", True
+    return platform, False
 
-    fallback = platform is None
-    if fallback:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        # 8 virtual devices so the sharded entry still exercises (and
-        # times) the real shard_map mechanics, like tests/conftest.py
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
+
+def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
+              **kw):
+    from tmhpvsim_tpu.config import SimConfig
+
+    base = dict(
+        start="2019-09-05 00:00:00",
+        duration_s=block_s * n_blocks_total,
+        n_chains=n_chains,
+        seed=0,
+        block_s=block_s,
+        dtype="float32",
+        prng_impl="rbg",        # fastest documented mode (config.py)
+        block_impl="auto",      # scan-fused on accelerators
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _timed_reduce_run(sim, n_blocks: int, n_rounds: int, profile_dir=None):
+    """(compile_s, best_steady_s, rate): one warm-up block, then n_rounds x
+    n_blocks timed reduce-mode blocks through the public step_acc path,
+    best round kept (the tunnel TPU's throughput varies ~2x between
+    otherwise identical runs)."""
+    import contextlib
 
     import jax
 
-    if fallback:
-        # sitecustomize may have imported jax already; backends are lazy,
-        # so redirecting the config here still works (tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            jax.config.update("jax_num_cpu_devices", 8)
-        except Exception:
-            pass  # already created; the XLA_FLAGS path may still hold
-        platform = "cpu-fallback"
-        n_chains, n_blocks = CPU_N_CHAINS, CPU_N_BLOCKS
-    else:
-        n_chains, n_blocks = N_CHAINS, N_BLOCKS
+    sim.state = sim.init_state()
+    acc = sim.init_reduce_acc()
+    t_c = time.perf_counter()
+    inputs, _ = sim.host_inputs(0)
+    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+    jax.block_until_ready(acc)
+    compile_s = time.perf_counter() - t_c
 
-    from tmhpvsim_tpu.config import SimConfig
+    trace = contextlib.nullcontext()
+    if profile_dir:
+        from tmhpvsim_tpu.engine.profiling import device_trace
+
+        trace = device_trace(profile_dir)
+
+    best = float("inf")
+    bi = 1
+    with trace:
+        for _ in range(n_rounds):
+            t0 = time.perf_counter()
+            for _ in range(n_blocks):
+                inputs, _ = sim.host_inputs(bi)
+                bi += 1
+                sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+            jax.block_until_ready(acc)
+            best = min(best, time.perf_counter() - t0)
+    n = sim.config.n_chains
+    bs = sim.config.block_s
+    return compile_s, best, n * bs * n_blocks / best
+
+
+def _hot_jit_cost(sim) -> dict:
+    """XLA's own cost model for the hot per-block jit: flops + HBM bytes.
+
+    ``cost_analysis`` sums operand/result bytes per *fused* instruction,
+    so it is an upper bound on true HBM traffic; flops are exact for the
+    arithmetic it models (transcendentals counted approximately)."""
+    import jax
+
+    try:
+        sim.state = sim.init_state()
+        acc = sim.init_reduce_acc()
+        inputs, _ = sim.host_inputs(0)
+        if getattr(sim, "_use_scan", False):
+            jf, args = sim._scan_acc_jit, (sim.state, inputs, acc)
+        elif getattr(sim, "_use_fused", False):
+            jf, args = sim._fused_acc_jit, (sim.state, inputs, acc)
+        else:
+            jf, args = sim._block_jit, (sim.state, inputs)
+        ca = jf.lower(*args).compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return {
+            "flops_per_block": float(ca.get("flops", float("nan"))),
+            "bytes_per_block": float(
+                ca.get("bytes accessed", float("nan"))
+            ),
+        }
+    except Exception as e:  # cost model availability varies per backend
+        print(f"# cost_analysis unavailable: {e}", file=sys.stderr)
+        return {}
+
+
+def _roofline(cost: dict, block_wall_s: float, n_chains: int,
+              block_s: int, device_kind: str) -> dict:
+    """Achieved rates + fractions of the chip's peak VPU/HBM rates."""
+    out = dict(cost)
+    site_s = n_chains * block_s
+    if "flops_per_block" in cost and block_wall_s > 0:
+        out["flops_per_site_second"] = round(
+            cost["flops_per_block"] / site_s, 1
+        )
+        out["bytes_per_site_second"] = round(
+            cost["bytes_per_block"] / site_s, 1
+        )
+        out["achieved_gflops"] = round(
+            cost["flops_per_block"] / block_wall_s / 1e9, 1
+        )
+        out["achieved_gbs"] = round(
+            cost["bytes_per_block"] / block_wall_s / 1e9, 1
+        )
+        peaks = _PEAKS.get(device_kind)
+        if peaks:
+            out["pct_peak_vpu"] = round(
+                100.0 * out["achieved_gflops"] / peaks["vpu_f32_gops"], 1
+            )
+            out["pct_peak_hbm"] = round(
+                100.0 * out["achieved_gbs"] / peaks["hbm_gbs"], 1
+            )
+            out["peaks"] = peaks
+    return out
+
+
+NORTH_STAR = 100_000 * 365.25 * 86400 / 60.0 / 8.0  # site-s/s/chip
+REF_CEILING = 100.0  # simulated s/s/process, reference --no-realtime
+
+
+def headline() -> None:
+    platform, fallback = _probe_or_fallback()
+    import jax
+
+    if fallback:
+        n_chains, n_blocks, n_rounds = CPU_N_CHAINS, CPU_N_BLOCKS, 1
+    else:
+        n_chains, n_blocks, n_rounds = N_CHAINS, N_BLOCKS, N_ROUNDS
+
     from tmhpvsim_tpu.engine import Simulation
     from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
     from tmhpvsim_tpu.parallel.distributed import initialize_from_env
@@ -120,89 +277,359 @@ def main() -> None:
     except Exception as e:  # single-process bench must not die on this
         print(f"# jax.distributed init skipped: {e}", file=sys.stderr)
 
-    n_rounds = N_ROUNDS if not fallback else 1
+    n_total = n_blocks * n_rounds + 1
 
-    def make_cfg(n):
-        return SimConfig(
-            start="2019-09-05 00:00:00",
-            duration_s=BLOCK_S * (n_blocks * n_rounds + 1),
-            n_chains=n,
-            seed=0,
-            block_s=BLOCK_S,
-            dtype="float32",
-        )
+    # --- variant matrix: the headline is the best documented mode; the
+    # others are reported so the artifact shows WHY it is the headline.
+    variant_cfgs = {
+        "scan-rbg": dict(prng_impl="rbg", block_impl="auto"),
+        "scan-threefry": dict(prng_impl="threefry2x32", block_impl="auto"),
+        "wide-rbg": dict(prng_impl="rbg", block_impl="wide",
+                         stats_fusion="fused"),
+    }
+    variants, sims = {}, {}
+    for name, kw in variant_cfgs.items():
+        try:
+            sim = Simulation(_make_cfg(n_chains, n_total, **kw))
+            c_s, dt, rate = _timed_reduce_run(sim, n_blocks, n_rounds)
+            variants[name] = {
+                "rate": round(rate, 1), "compile_s": round(c_s, 1),
+                "best_round_wall_s": round(dt, 2),
+                # the RESOLVED topology ('auto' depends on the backend; on
+                # the cpu-fallback a 'scan-*' label would otherwise
+                # misdocument a wide run)
+                "impl": ("scan" if sim._use_scan
+                         else "fused" if sim._use_fused else "split"),
+            }
+            sims[name] = (sim, dt)
+        except Exception as e:
+            print(f"# variant {name} failed: {e}", file=sys.stderr)
+            variants[name] = {"error": str(e)[:200]}
 
-    def timed_reduce_run(sim):
-        """(compile_s, best_steady_s, best_rate): one warm-up block, then
-        n_rounds x n_blocks timed reduce-mode blocks through the public
-        step_acc path, best round kept (the tunnel TPU's throughput varies
-        ~2x between otherwise identical runs)."""
-        sim.state = sim.init_state()
-        acc = sim.init_reduce_acc()
-        t_c = time.perf_counter()
-        inputs, _ = sim.host_inputs(0)
-        sim.state, acc = sim.step_acc(sim.state, inputs, acc)
-        jax.block_until_ready(acc)
-        compile_s = time.perf_counter() - t_c
+    ok = {k: v for k, v in variants.items() if "rate" in v}
+    if not ok:
+        print(json.dumps({"metric": "simulated site-seconds/sec/chip",
+                          "value": 0.0, "unit": "site-s/s/chip",
+                          "vs_baseline": 0.0, "platform": platform,
+                          "error": "all variants failed",
+                          "variants": variants}))
+        return
+    best_name = max(ok, key=lambda k: ok[k]["rate"])
+    rate = ok[best_name]["rate"]
+    best_sim, best_dt = sims[best_name]
 
-        best = float("inf")
-        bi = 1
-        for _ in range(n_rounds):
-            t0 = time.perf_counter()
-            for _ in range(n_blocks):
-                inputs, _ = sim.host_inputs(bi)
-                bi += 1
-                sim.state, acc = sim.step_acc(sim.state, inputs, acc)
-            jax.block_until_ready(acc)
-            best = min(best, time.perf_counter() - t0)
-        n = sim.config.n_chains
-        return compile_s, best, n * BLOCK_S * n_blocks / best
-
-    sim = Simulation(make_cfg(n_chains))
-    compile_s, dt, rate = timed_reduce_run(sim)
-    print(f"# warm-up (compile) {compile_s:.1f}s on "
-          f"{jax.devices()[0].platform}", file=sys.stderr)
+    # --- roofline of the winning variant's hot jit
+    device_kind = jax.devices()[0].device_kind
+    cost = _hot_jit_cost(best_sim)
+    roofline = _roofline(cost, best_dt / n_blocks, n_chains, BLOCK_S,
+                         device_kind)
 
     # Sharded path over all local devices: on the single real TPU chip this
     # is a 1-device mesh (validates the shard_map machinery at full size);
-    # scaling efficiency needs a real multi-chip slice (BASELINE.md).
+    # scaling efficiency needs a real multi-chip slice (--scaling runs the
+    # virtual-CPU-mesh mechanics artifact).
     devices = jax.local_devices()
     n_dev = len(devices)
     sh_chains = max(n_dev, (n_chains // n_dev) * n_dev)
     try:
-        ssim = ShardedSimulation(make_cfg(sh_chains), mesh=make_mesh(devices))
-        sh_compile_s, sh_dt, sh_rate = timed_reduce_run(ssim)
+        ssim = ShardedSimulation(_make_cfg(sh_chains, n_total),
+                                 mesh=make_mesh(devices))
+        sh_c, sh_dt, sh_rate = _timed_reduce_run(ssim, n_blocks, n_rounds)
         sharded = {
             "n_devices": n_dev,
             "n_chains": sh_chains,
             "rate_per_chip": round(sh_rate / n_dev, 1),
-            "compile_s": round(sh_compile_s, 1),
+            "compile_s": round(sh_c, 1),
             "best_round_wall_s": round(sh_dt, 2),
         }
     except Exception as e:  # sharded failure must not lose the main number
         print(f"# sharded bench failed: {e}", file=sys.stderr)
         sharded = {"error": str(e)[:200]}
 
-    ref_ceiling = 100.0  # simulated s/s/process, reference --no-realtime
-    # north star (BASELINE.json): 100k site-years < 60 s on v5e-8
-    # = 100_000 * 365.25 * 86400 / 60 / 8 site-s/s/chip
-    north_star = 100_000 * 365.25 * 86400 / 60.0 / 8.0
     print(json.dumps({
         "metric": "simulated site-seconds/sec/chip",
-        "value": round(rate, 1),
+        "value": rate,
         "unit": "site-s/s/chip",
-        "vs_baseline": round(rate / ref_ceiling, 1),
-        "north_star_frac": round(rate / north_star, 3),
+        "vs_baseline": round(rate / REF_CEILING, 1),
+        "north_star_frac": round(rate / NORTH_STAR, 3),
         "platform": platform,
         "tpu": platform == "tpu",
+        "device_kind": device_kind,
+        "headline_variant": best_name,
         "n_chains": n_chains,
         "block_s": BLOCK_S,
         "timed_blocks": n_blocks,
         "timed_rounds": n_rounds,
-        "compile_s": round(compile_s, 1),
-        "best_round_wall_s": round(dt, 2),
+        "variants": variants,
+        "roofline": roofline,
         "sharded": sharded,
     }))
+
+
+# ---------------------------------------------------------------------------
+# BASELINE.md configs 1-5 (artifact producers)
+# ---------------------------------------------------------------------------
+
+
+def _reduce_config_run(label: str, cfg, sharded: bool, note: str,
+                       scaled_from: str | None = None) -> None:
+    """Shared runner for configs 2-5: a reduce-mode run, full wall-time
+    measurement (compile excluded), one JSON artifact line."""
+    import jax
+
+    from tmhpvsim_tpu.engine import Simulation
+    from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
+
+    if sharded:
+        sim = ShardedSimulation(cfg, mesh=make_mesh(jax.local_devices()))
+    else:
+        sim = Simulation(cfg)
+    if sim.n_blocks < 2:
+        raise ValueError(
+            f"config {label!r} needs >= 2 blocks (warm-up + timed); "
+            f"got duration_s={cfg.duration_s}, block_s={cfg.block_s}"
+        )
+    # warm-up on block 0, one timed round over blocks 1..n-1 — the shared
+    # measurement protocol (_timed_reduce_run)
+    compile_s, steady_s, rate = _timed_reduce_run(sim, sim.n_blocks - 1, 1)
+    n_dev = len(jax.local_devices()) if sharded else 1
+    print(json.dumps({
+        "config": label,
+        "metric": "simulated site-seconds/sec/chip",
+        "value": round(rate / n_dev, 1),
+        "unit": "site-s/s/chip",
+        "vs_baseline": round(rate / n_dev / REF_CEILING, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+        "echo": {
+            "n_chains": cfg.n_chains, "duration_s": cfg.duration_s,
+            "block_s": cfg.block_s, "prng_impl": cfg.prng_impl,
+            "site_grid": cfg.site_grid is not None,
+            "start": cfg.start, "seed": cfg.seed,
+        },
+        "compile_s": round(compile_s, 1),
+        "steady_wall_s": round(steady_s, 2),
+        "scaled_from": scaled_from,
+        "note": note,
+    }))
+
+
+def config_1() -> None:
+    """1 site, 1 day @ 1 Hz on the asyncio/CPU reference path: the real
+    app pair (metersim producer -> local transport -> pvsim consumer ->
+    funnel join -> CSV), --no-realtime."""
+    import asyncio
+    import tempfile
+
+    _force_cpu(1)
+
+    from tmhpvsim_tpu.apps import metersim as m_app
+    from tmhpvsim_tpu.apps import pvsim as p_app
+
+    duration = 86_400
+
+    async def pair(csv_path):
+        import datetime as dt
+
+        url, exchange = "local://bench", "meter"
+        start = dt.datetime(2019, 9, 5, 0, 0, 0)
+        # the test-suite's e2e pattern (tests/test_apps.py): consumer runs
+        # unbounded, producer bounds the run, then drain + cancel
+        cons = asyncio.create_task(
+            p_app.pvsim_main(csv_path, url, exchange, realtime=False,
+                             seed=2, duration_s=None, start=start)
+        )
+        await asyncio.sleep(0.05)
+        await m_app.metersim_main(url, exchange, realtime=False, seed=1,
+                                  duration_s=duration, start=start)
+        await asyncio.sleep(0.5)
+        cons.cancel()
+        try:
+            await cons
+        except asyncio.CancelledError:
+            pass
+
+    with tempfile.TemporaryDirectory() as d:
+        csv_path = os.path.join(d, "out.csv")
+        t0 = time.perf_counter()
+        asyncio.run(pair(csv_path))
+        wall = time.perf_counter() - t0
+        rows = sum(1 for _ in open(csv_path)) - 1
+    rate = duration / wall
+    print(json.dumps({
+        "config": "1: 1 site x 1 day, asyncio/CPU reference path",
+        "metric": "simulated seconds/sec (1 site)",
+        "value": round(rate, 1),
+        "unit": "sim-s/s",
+        "vs_baseline": round(rate / REF_CEILING, 1),
+        "platform": "cpu",
+        "echo": {"duration_s": duration, "realtime": False,
+                 "transport": "local://", "joined_rows": rows},
+        "wall_s": round(wall, 2),
+        "note": ("full app pair: metersim producer + pvsim consumer + "
+                 "funnel join + CSV sink; the reference's own ceiling on "
+                 "this config is ~100 sim-s/s (utils.py:36 10 ms floor)"),
+    }))
+
+
+def config_2() -> None:
+    """1k chains x 1 site, 1 year @ 1 Hz, single chip."""
+    platform, fallback = _probe_or_fallback()
+    year = 365 * 86_400
+    if fallback:
+        cfg = _make_cfg(1000, 4, block_s=8640)
+        note = "cpu-fallback: duration scaled to 4 blocks"
+        scaled = "1000 chains x 1 year"
+    else:
+        cfg = _make_cfg(1000, year // 8640, block_s=8640)
+        note = "full 1-year run, 1000 chains, single chip"
+        scaled = None
+    _reduce_config_run("2: 1k chains x 1 year, single chip", cfg,
+                       sharded=False, note=note, scaled_from=scaled)
+
+
+def config_3() -> None:
+    """10k-site lat/lon grid, 1 year, device-side per-site geometry."""
+    from tmhpvsim_tpu.config import SiteGrid
+
+    platform, fallback = _probe_or_fallback()
+    grid = SiteGrid.regular((45.0, 55.0), (5.0, 15.0), 100, 100)
+    year = 365 * 86_400
+    if fallback:
+        cfg = _make_cfg(len(grid), 2, block_s=4320, site_grid=grid)
+        note = "cpu-fallback: duration scaled to 2 blocks"
+        scaled = "10k sites x 1 year"
+    else:
+        cfg = _make_cfg(len(grid), year // 8640, block_s=8640,
+                        site_grid=grid)
+        note = ("full 1-year run, 100x100 lat/lon grid over central "
+                "Europe, solar geometry evaluated per site on device")
+        scaled = None
+    _reduce_config_run("3: 10k-site grid x 1 year", cfg, sharded=False,
+                       note=note, scaled_from=scaled)
+
+
+def config_4() -> None:
+    """100k chains, per-second, sharded over the available mesh."""
+    platform, fallback = _probe_or_fallback()
+    if fallback:
+        cfg = _make_cfg(100_000 // 125, 3, block_s=1080)
+        note = "cpu-fallback: 800 chains x 3 blocks"
+        scaled = "100k chains x 1 day"
+    else:
+        cfg = _make_cfg(100_000, 86_400 // 8640, block_s=8640)
+        note = ("100k chains x 1 day, sharded over all local devices "
+                "(a 1-device mesh on the single available chip; the "
+                "BASELINE target hardware is v5e-8 — per-chip rate is "
+                "the comparable number)")
+        scaled = None
+    _reduce_config_run("4: 100k chains per-second, sharded", cfg,
+                       sharded=True, note=note, scaled_from=scaled)
+
+
+def config_5() -> None:
+    """1M-chain ensemble, 10-year: SCALED dryrun on the virtual CPU mesh.
+
+    The real config needs a v5e pod slice (and block-windowed sampler
+    arrays for the 10-year horizon); this artifact proves the 1M-chain
+    mechanics — state construction, sharding, scan-fused reduce step —
+    execute end-to-end on an 8-device mesh, with duration scaled down.
+    """
+    _force_cpu(8)
+    # threefry here: rbg works on CPU but is slower there, and this
+    # artifact's point is the 1M-chain mechanics, not the CPU rate
+    cfg = _make_cfg(1_000_000, 2, block_s=120, prng_impl="threefry2x32")
+    _reduce_config_run(
+        "5: 1M-chain ensemble (scaled dryrun, 8 virtual CPU devices)",
+        cfg, sharded=True,
+        note=("full 1M chain count, duration scaled 10 years -> 2 blocks "
+              "x 120 s; validates sharded state + scan-fused step at the "
+              "target batch size (virtual CPU mesh, not TPU hardware)"),
+        scaled_from="1M chains x 10 years on a pod slice",
+    )
+
+
+def scaling() -> None:
+    """Weak-scaling mechanics on the virtual CPU mesh: same per-device
+    work on 1, 2, 4, 8 devices.
+
+    CAVEAT recorded in the artifact: this host has ONE physical core, so
+    all virtual devices share it and wall time grows ~linearly with the
+    device count — the artifact validates that the sharded program
+    compiles, runs, and partitions correctly at every mesh size (the
+    mechanics a real 1->8-chip measurement exercises), not hardware
+    scaling efficiency, which needs a real multi-chip slice.
+    """
+    _force_cpu(8)
+    import jax
+
+    import multiprocessing
+
+    from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
+
+    per_dev = 128
+    n_total = 3
+    results = []
+    for n_dev in (1, 2, 4, 8):
+        devices = jax.devices("cpu")[:n_dev]
+        cfg = _make_cfg(per_dev * n_dev, n_total, block_s=360,
+                        prng_impl="threefry2x32")
+        sim = ShardedSimulation(cfg, mesh=make_mesh(devices))
+        c_s, dt, rate = _timed_reduce_run(sim, n_total - 1, 1)
+        results.append({
+            "n_devices": n_dev, "n_chains": per_dev * n_dev,
+            "rate": round(rate, 1),
+            "rate_per_device": round(rate / n_dev, 1),
+            "wall_s": round(dt, 3),
+        })
+        print(f"# {n_dev} devices: {rate:.3g} site-s/s", file=sys.stderr)
+    base = results[0]["rate_per_device"]
+    for r in results:
+        r["efficiency_vs_1dev"] = round(r["rate_per_device"] / base, 3)
+    print(json.dumps({
+        "artifact": "weak-scaling mechanics, virtual CPU mesh",
+        "per_device_chains": per_dev,
+        "results": results,
+        "physical_cores": multiprocessing.cpu_count(),
+        "caveat": ("all virtual devices share this host's "
+                   f"{multiprocessing.cpu_count()} physical core(s); this "
+                   "validates sharded-program mechanics at each mesh "
+                   "size, NOT hardware scaling efficiency (needs a real "
+                   "multi-chip slice)"),
+    }))
+
+
+def profile(out_dir: str) -> None:
+    """Capture a jax.profiler trace of steady headline blocks."""
+    platform, fallback = _probe_or_fallback()
+    n_chains = CPU_N_CHAINS if fallback else N_CHAINS
+    from tmhpvsim_tpu.engine import Simulation
+
+    sim = Simulation(_make_cfg(n_chains, 4))
+    c_s, dt, rate = _timed_reduce_run(sim, 3, 1, profile_dir=out_dir)
+    print(json.dumps({
+        "artifact": "profiler trace", "dir": out_dir,
+        "platform": platform, "rate": round(rate, 1),
+        "compile_s": round(c_s, 1),
+    }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, choices=range(1, 6))
+    ap.add_argument("--scaling", action="store_true")
+    ap.add_argument("--profile", metavar="DIR")
+    args = ap.parse_args()
+    if args.config:
+        {1: config_1, 2: config_2, 3: config_3, 4: config_4,
+         5: config_5}[args.config]()
+    elif args.scaling:
+        scaling()
+    elif args.profile:
+        profile(args.profile)
+    else:
+        headline()
 
 
 if __name__ == "__main__":

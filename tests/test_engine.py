@@ -120,6 +120,47 @@ def test_resume_equals_straight_run():
     np.testing.assert_allclose(b1.pv, blocks[1].pv, atol=1e-5)
 
 
+def test_scan_impl_matches_wide(run):
+    """SimConfig.block_impl='scan' (the TPU formulation: whole pipeline in
+    one lax.scan, stats in the carry) must produce the same per-chain
+    statistics as the wide formulation — same RNG streams by construction
+    (scan_draws_tmajor/meter_block_tmajor), so only float reassociation
+    may differ.  CPU resolves 'auto' to 'wide', so this forces both."""
+    wide = Simulation(small_config(block_impl="wide")).run_reduced()
+    scan = Simulation(small_config(block_impl="scan")).run_reduced()
+    np.testing.assert_array_equal(scan["n_seconds"], wide["n_seconds"])
+    for k in wide:
+        np.testing.assert_allclose(scan[k], wide[k], rtol=2e-5, atol=1e-2,
+                                   err_msg=k)
+
+
+def test_scan_impl_matches_wide_site_grid():
+    """Same check on the site-grid path, where the scan body evaluates
+    per-site solar geometry on device per step."""
+    from tmhpvsim_tpu.config import SiteGrid
+
+    grid = SiteGrid.regular((46, 50), (9, 13), 2, 2)
+    base = dict(start="2019-09-05 10:00:00", duration_s=5400, n_chains=4,
+                seed=7, block_s=3600, dtype="float32", site_grid=grid)
+    wide = Simulation(SimConfig(block_impl="wide", **base)).run_reduced()
+    scan = Simulation(SimConfig(block_impl="scan", **base)).run_reduced()
+    for k in wide:
+        np.testing.assert_allclose(scan[k], wide[k], rtol=2e-5, atol=1e-2,
+                                   err_msg=k)
+
+
+def test_fused_stats_topology_matches_split(run):
+    """SimConfig.stats_fusion='fused' (one producer+stats+merge jit, the
+    TPU reduce-mode topology) must produce the same per-chain statistics
+    as the default split topology — fusion is a scheduling decision, not a
+    semantic one.  Float sums may differ by reassociation ULPs only."""
+    split = Simulation(small_config(stats_fusion="split")).run_reduced()
+    fused = Simulation(small_config(stats_fusion="fused")).run_reduced()
+    np.testing.assert_array_equal(fused["n_seconds"], split["n_seconds"])
+    for k in split:
+        np.testing.assert_allclose(fused[k], split[k], rtol=1e-6, atol=1e-3)
+
+
 def test_reduce_mode_consistent(run):
     sim, blocks = run
     stats = Simulation(small_config()).run_reduced()

@@ -135,6 +135,28 @@ class TestShardedReduce:
                 np.asarray(b_stats[k], np.float64), rtol=1e-5, atol=1e-2,
             )
 
+    @pytest.mark.parametrize("variant", [
+        dict(stats_fusion="fused"),
+        dict(block_impl="scan"),
+    ], ids=["fused", "scan"])
+    def test_alt_topologies_match_split(self, variant):
+        """The fused and scan-fused reduce topologies under shard_map must
+        match the default split/wide one — same statistics, still
+        chain-sharded (SimConfig.stats_fusion / .block_impl)."""
+        split = ShardedSimulation(cfg(stats_fusion="split"))
+        alt = ShardedSimulation(cfg(**variant))
+        r_split = split.run_reduced()
+        r_alt = alt.run_reduced()
+        sh = alt._last_acc["pv_sum"].sharding
+        assert sh.is_equivalent_to(chain_sharding(alt.mesh), ndim=1)
+        np.testing.assert_array_equal(
+            r_alt["n_seconds"], r_split["n_seconds"]
+        )
+        for k in r_split:
+            np.testing.assert_allclose(
+                r_alt[k], r_split[k], rtol=2e-5, atol=1e-2
+            )
+
     def test_accumulator_stays_sharded(self):
         sim = ShardedSimulation(cfg())
         sim.run_reduced()

@@ -184,6 +184,20 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         logger.warning("jax.distributed init failed (%s); continuing "
                        "single-host", e)
 
+    import jax
+
+    if jax.process_count() > 1:
+        # Pod slice: every host writes (and checkpoints) only the chains
+        # its own devices hold — per-host files, no DCN gathers.  Resume
+        # must use the same process count/layout; mismatched shard shapes
+        # fail loudly in ShardedSimulation._place_resume.
+        suffix = f".host{jax.process_index()}"
+        file = f"{file}{suffix}"
+        if checkpoint:
+            checkpoint = f"{checkpoint}{suffix}"
+        logger.info("multi-host run (%d processes): output %s",
+                    jax.process_count(), file)
+
     if start is None:
         start = _dt.datetime.now().replace(microsecond=0).isoformat(" ")
     if block_s is None:
@@ -216,16 +230,6 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         # is written once at the end, so unlike trace mode there is no
         # partial-rows window to truncate on resume.
         state, acc, start_block = None, None, 0
-        if checkpoint:
-            import jax
-
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "reduce-mode --checkpoint is single-host only: on a "
-                    "pod slice the state spans non-addressable devices "
-                    "and needs per-host checkpoint files (see "
-                    "ShardedSimulation._place_resume); drop --checkpoint"
-                )
         if checkpoint and os.path.exists(checkpoint):
             tree, start_block = ckpt.load(checkpoint, cfg)
             state, acc = tree["state"], tree["acc"]
@@ -238,7 +242,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         def on_block(bi, state, acc):
             timer.tick()
             if checkpoint:
-                ckpt.save(checkpoint, {"state": state, "acc": acc},
+                # host_local_tree: on a pod slice each host saves only its
+                # chain slice (the per-host file this process owns)
+                ckpt.save(checkpoint,
+                          sim.host_local_tree({"state": state, "acc": acc}),
                           bi + 1, cfg)
 
         with trace:
@@ -246,7 +253,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                                       start_block=start_block,
                                       on_block=on_block)
         ensemble = sim.ensemble_stats()
-        _write_reduced_csv(file, reduced, ensemble)
+        sl, local = sim.local_reduced_view(reduced)
+        _write_reduced_csv(file, local, ensemble, chain_start=sl.start or 0)
         stats = timer.summary()
         print(
             f"pvsim[reduce]: {cfg.n_chains} chains x {cfg.duration_s} s at "
@@ -259,6 +267,30 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     if output == "ensemble" and chain != 0:
         raise ValueError("ensemble mode writes the fleet mean; --chain "
                          "does not apply (drop it or use trace mode)")
+
+    # Trace mode on a pod slice: --chain is a GLOBAL chain id, but each
+    # host's BlockResults carry only its local slice (ShardedSimulation
+    # run_blocks).  The owning host writes the trace; the others still
+    # iterate every block (the per-block ensemble psum is a collective all
+    # hosts must join) but skip the CSV.
+    write_trace = True
+    if output == "trace" and sharded and jax.process_count() > 1:
+        from tmhpvsim_tpu.parallel.distributed import local_chain_slice
+
+        if not (0 <= chain < cfg.n_chains):
+            raise ValueError(
+                f"--chain {chain} out of range for {cfg.n_chains} chains"
+            )
+        sl = local_chain_slice(cfg.n_chains, sim.mesh)
+        write_trace = sl.start <= chain < sl.stop
+        if write_trace:
+            chain -= sl.start
+        else:
+            logger.info(
+                "global chain %d lives on another host (this host owns "
+                "%d-%d); participating without writing a trace",
+                chain, sl.start, sl.stop - 1,
+            )
 
     state, start_block = None, 0
     if checkpoint and os.path.exists(checkpoint):
@@ -295,15 +327,20 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             # this block's rows — only then is the checkpoint advanced, so
             # a crash can duplicate work but never lose rows
             if checkpoint:
-                ckpt.save(checkpoint, sim.state, bi + 1, cfg)
+                ckpt.save(checkpoint, sim.host_local_tree(sim.state),
+                          bi + 1, cfg)
 
     tzname = (cfg.site_grid.timezone if cfg.site_grid is not None
               else cfg.site.timezone)
     trace = device_trace(profile_dir) if profile_dir else \
         contextlib.nullcontext()
     with trace:
-        write_csv(file, blocks(), chain=chain, tz=ZoneInfo(tzname),
-                  append=start_block > 0)
+        if write_trace:
+            write_csv(file, blocks(), chain=chain, tz=ZoneInfo(tzname),
+                      append=start_block > 0)
+        else:  # non-owning host: run every block (collectives), no CSV
+            for _ in blocks():
+                pass
     stats = timer.summary()
     print(
         f"pvsim: {cfg.n_chains} chains x {cfg.duration_s} s simulated at "
@@ -313,12 +350,16 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     )
 
 
-def _write_reduced_csv(path: str, reduced: dict, ensemble: dict) -> None:
+def _write_reduced_csv(path: str, reduced: dict, ensemble: dict,
+                       chain_start: int = 0) -> None:
     """Per-chain summary rows + one fleet 'ensemble' row.
 
     Columns come from ``REDUCE_STATS`` (engine/simulation.py); *_sum
     columns are watt-seconds over the simulated duration (divide by 3600
-    for Wh).
+    for Wh).  ``chain_start`` offsets the chain ids so a pod-slice host
+    writing its local slice labels rows with GLOBAL chain numbers; the
+    ensemble row is the fleet-wide psum view and is identical across
+    hosts' files.
     """
     import csv
 
@@ -330,7 +371,7 @@ def _write_reduced_csv(path: str, reduced: dict, ensemble: dict) -> None:
         w.writerow(["chain"] + keys)
         n = len(reduced[keys[0]])
         for i in range(n):
-            w.writerow([i] + [reduced[k][i] for k in keys])
+            w.writerow([chain_start + i] + [reduced[k][i] for k in keys])
         w.writerow(["ensemble"] + [ensemble[k] for k in keys])
 
 

@@ -223,6 +223,40 @@ class SimConfig:
     #: computation dtype for the per-second path on device
     dtype: str = "float32"
 
+    #: reduce-mode block formulation.  'wide' generates every per-second
+    #: stream as (n_chains, block_s) arrays (batched RNG + elementwise
+    #: pipeline + a minimal renewal scan) — best on XLA:CPU, but on TPU it
+    #: is HBM-bandwidth-bound: ~20 (n_chains, block_s) f32 intermediates
+    #: (sampler-interpolation gathers, physics stages, scan inputs) each
+    #: round-trip HBM (measured v5e: ~55 GB accessed per 65536x1080 block,
+    #: rate flat under a 2.3x flops change).  'scan' runs ONE lax.scan
+    #: over the block's seconds with the entire pipeline (interpolation,
+    #: renewal, physics, statistics fold) in the body on (n_chains,)
+    #: vectors — nothing of shape (n_chains, block_s) is materialised
+    #: except the three pre-drawn RNG streams, cutting HBM traffic ~20x.
+    #: Identical RNG streams, so both produce the same simulation up to
+    #: float reassociation (tested).  'auto': scan on accelerators, wide
+    #: on CPU.  Applies to reduce mode; trace/ensemble modes need the wide
+    #: arrays anyway.
+    block_impl: str = "auto"
+
+    #: lax.scan unroll factor for the per-second scan (both impls): keeps
+    #: the carry in registers across iterations instead of round-tripping
+    #: HBM (measured ~2x on the wide impl's renewal scan)
+    scan_unroll: int = 8
+
+    #: producer/stats jit topology for reduce mode.  'split' keeps the
+    #: block step and the statistics fold in separate jits so XLA cannot
+    #: re-fuse the stats backwards into a duplicated producer chain — the
+    #: right call on XLA:CPU (measured: 2.56 vs 1.13 GFLOP compiled, ~3.5x
+    #: wall; see Simulation._block_step).  'fused' runs producer + stats +
+    #: accumulator merge as ONE jit: XLA:TPU does not duplicate the
+    #: producer, and fusing means the (n_chains, block_s) meter/pv arrays
+    #: never round-trip HBM — the stats fold consumes them from registers
+    #: (measured on TPU v5e: the split path writes + re-reads ~566 MB per
+    #: 65536x1080 block).  'auto' picks fused on accelerators, split on CPU.
+    stats_fusion: str = "auto"
+
     #: JAX PRNG implementation for every stochastic draw.  'threefry2x32'
     #: (the JAX default) is fully counter-based and splittable but costs
     #: ~100 ALU ops per 64 bits — at one draw per site-second it is the

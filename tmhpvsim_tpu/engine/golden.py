@@ -162,6 +162,8 @@ class GoldenClearskyIndex:
             cc, self.windspeed_day.interpolate(self._day_fraction)
         )
         covered = bool(next(self.renewal))
+        #: exposed for the long-horizon parity harness (tests/test_parity.py)
+        self.last_covered = covered
 
         # second-scale noise uses the clear sigmas in both branches
         # (clearskyindexmodel.py:152,158)

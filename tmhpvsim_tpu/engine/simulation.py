@@ -125,9 +125,39 @@ class Simulation:
 
         root = jax.random.key(config.seed, impl=config.prng_impl)
         self._k_chains, _ = jax.random.split(root)
-        self._block_jit = jax.jit(self._block_step)
+        self._block_jit = jax.jit(self._block_step, donate_argnums=0)
         self._stats_jit = jax.jit(self._block_stats)
-        self._stats_acc_jit = jax.jit(self._block_stats_acc)
+        self._stats_acc_jit = jax.jit(self._block_stats_acc,
+                                      donate_argnums=3)
+        #: reduce-mode fused path: producer + stats + merge in ONE jit so
+        #: the (n_chains, block_s) meter/pv arrays never reach HBM (see
+        #: SimConfig.stats_fusion); state and accumulator are donated so
+        #: XLA reuses their buffers block to block
+        self._fused_acc_jit = jax.jit(self._step_acc_fused,
+                                      donate_argnums=(0, 2))
+        #: reduce-mode scan-fused path (SimConfig.block_impl='scan'): the
+        #: whole per-second pipeline inside one lax.scan, statistics in
+        #: the carry — the TPU formulation (the wide one is HBM-bound)
+        self._scan_acc_jit = jax.jit(self._block_step_scan_acc,
+                                     donate_argnums=(0, 2))
+        if config.stats_fusion == "auto":
+            self._use_fused = jax.default_backend() != "cpu"
+        elif config.stats_fusion in ("fused", "split"):
+            self._use_fused = config.stats_fusion == "fused"
+        else:
+            raise ValueError(
+                f"stats_fusion must be 'auto', 'fused' or 'split', "
+                f"got {config.stats_fusion!r}"
+            )
+        if config.block_impl == "auto":
+            self._use_scan = jax.default_backend() != "cpu"
+        elif config.block_impl in ("wide", "scan"):
+            self._use_scan = config.block_impl == "scan"
+        else:
+            raise ValueError(
+                f"block_impl must be 'auto', 'wide' or 'scan', "
+                f"got {config.block_impl!r}"
+            )
         self._series_jit = jax.jit(self._ensemble_series)
         #: memoized jitted initializers keyed by (kind, sharding) — a fresh
         #: jax.jit(closure) per call would never hit the trace cache, which
@@ -304,6 +334,7 @@ class Simulation:
             carry, csi, _covered = ci.csi_scan_block(
                 chain["k_scan"], chain["arrays"], mvals, mlo,
                 chain["carry"], block_idx, cfg.options, dtype,
+                unroll=cfg.scan_unroll,
             )
             ac = pvmod.power_from_csi(
                 csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
@@ -413,8 +444,127 @@ class Simulation:
         """Stats of one block folded into the running accumulator."""
         return self._merge_acc(acc, self._block_stats(meter, pv, t))
 
+    def _step_acc_fused(self, state, inputs, acc):
+        """Producer + stats + merge as one traced computation (the
+        reduce-mode 'fused' topology, SimConfig.stats_fusion)."""
+        state, meter, pv = self._block_step(state, inputs)
+        acc = self._block_stats_acc(meter, pv, inputs["block_idx"]["t"], acc)
+        return state, acc
+
+    def _block_step_scan_acc(self, state, inputs, acc):
+        """Scan-fused reduce-mode block (SimConfig.block_impl='scan').
+
+        One ``lax.scan`` over the block's seconds; each step runs the FULL
+        pipeline — sampler interpolation, renewal, PV physics, meter,
+        statistics fold — on (n_chains,) vectors, with the running
+        statistics carried alongside the renewal state.  Nothing of shape
+        (n_chains, block_s) is ever materialised except the three
+        pre-drawn RNG streams (whose values are bit-identical to the wide
+        path's, models/clearsky_index.py scan_draws_tmajor), which is what
+        removes the wide formulation's ~20 HBM-round-tripped
+        intermediates (measured bandwidth-bound on TPU v5e).
+        """
+        cfg = self.config
+        dtype = self.dtype
+        opts = cfg.options
+        bi = inputs["block_idx"]
+        t = bi["t"]
+        mlo = inputs["mlo"]
+        shared_geom = inputs.get("geom")
+        arrays = state["arrays"]
+
+        mvals = jax.vmap(
+            lambda k, cc: ci.minute_noise_values_device(
+                k, cc, mlo, inputs["mfeats"], dtype
+            )
+        )(state["k_min"], arrays["cc"])
+        tables = ci.value_major_tables(arrays, mvals)
+
+        # blocks are minute-aligned by construction (block_s % 60 == 0 and
+        # offsets are whole blocks), so local second s is draw slot s % 60
+        # of group s // 60 — exactly n_groups = block_s // 60 groups
+        g0 = t[0] // 60
+        n_groups = t.shape[0] // 60
+        u_T, z_T = ci.scan_draws_tmajor(state["k_scan"], g0, n_groups, dtype)
+        meter_T = ci.meter_block_tmajor(
+            state["k_meter"], g0, n_groups, cfg.meter_max_w, dtype
+        )
+
+        if shared_geom is None:
+            ts = inputs["time_split"]
+            site = state["site"]
+            turbidity = jnp.asarray(
+                cfg.site_grid.linke_turbidity_monthly, dtype
+            )
+            geom_xs = {k: ts[k] for k in ("day2000", "sec_of_day", "doy")}
+            geom_const = None
+        else:
+            # (block_s,) features ride the scan as xs rows; python-float
+            # site constants close over
+            geom_xs = {k: v for k, v in shared_geom.items()
+                       if isinstance(v, jax.Array) and v.ndim == 1}
+            geom_const = {k: v for k, v in shared_geom.items()
+                          if k not in geom_xs}
+
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        xs = {
+            "t": t,
+            "h": bi["hour_idx"], "d": bi["day_idx"], "m": bi["min_idx"] - mlo,
+            "hf": bi["hour_frac"], "df": bi["day_frac"], "mf": bi["min_frac"],
+            "u": u_T, "z": z_T, "meter": meter_T,
+            "geom": geom_xs,
+        }
+
+        def body(carry, x):
+            rc, st = carry
+            rc, csi, _covered = ci.csi_compose_step(
+                tables, x, rc, opts, dtype
+            )
+            if shared_geom is None:
+                g = solar.device_geometry(
+                    x["geom"]["day2000"], x["geom"]["sec_of_day"],
+                    x["geom"]["doy"],
+                    site["latitude"], site["longitude"], site["altitude"],
+                    site["surface_tilt"], site["surface_azimuth"],
+                    site["albedo"], turbidity, xp=jnp,
+                )
+            else:
+                g = dict(geom_const, **x["geom"])
+            # astype: under jax_enable_x64 (test/golden envs) numpy-f64
+            # physics constants weakly promote ac, which would break the
+            # scan-carry type contract; on TPU (x32) this is a no-op
+            ac = pvmod.power_from_csi(
+                csi, g, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
+            ).astype(dtype)
+            meter = x["meter"].astype(dtype)
+            residual = meter - ac
+            valid = x["t"] < cfg.duration_s      # scalar: padding mask
+            vz = jnp.where(valid, 1.0, 0.0).astype(dtype)
+            st = {
+                "pv_sum": st["pv_sum"] + ac * vz,
+                "pv_max": jnp.maximum(st["pv_max"],
+                                      jnp.where(valid, ac, -big)),
+                "meter_sum": st["meter_sum"] + meter * vz,
+                "residual_sum": st["residual_sum"] + residual * vz,
+                "residual_min": jnp.minimum(st["residual_min"],
+                                            jnp.where(valid, residual, big)),
+                "residual_max": jnp.maximum(st["residual_max"],
+                                            jnp.where(valid, residual, -big)),
+                "n_seconds": st["n_seconds"] + valid.astype(jnp.int32),
+            }
+            return (rc, st), None
+
+        (rcarry, acc), _ = jax.lax.scan(
+            body, (state["carry"], acc), xs, unroll=cfg.scan_unroll
+        )
+        return dict(state, carry=rcarry), acc
+
     def step_acc(self, state, inputs, acc):
         """One reduce-mode block folded into the on-device accumulator."""
+        if self._use_scan:
+            return self._scan_acc_jit(state, inputs, acc)
+        if self._use_fused:
+            return self._fused_acc_jit(state, inputs, acc)
         state, meter, pv = self._block_jit(state, inputs)
         acc = self._stats_acc_jit(meter, pv, inputs["block_idx"]["t"], acc)
         return state, acc
@@ -500,6 +650,20 @@ class Simulation:
         subclass applies the chain sharding so a resumed run (including one
         with zero remaining blocks) has real device arrays."""
         return tree
+
+    def host_local_tree(self, tree):
+        """The checkpointable (host-addressable) view of a state/acc
+        pytree.  Single-device state is already fully addressable; the
+        sharded subclass restricts every chain-sharded leaf to this host's
+        slice so each pod-slice host saves exactly the chains it owns
+        (per-host checkpoint files, apps/pvsim.py)."""
+        return tree
+
+    def local_reduced_view(self, reduced: dict) -> tuple:
+        """(global chain slice, host-local dict) of a ``run_reduced``
+        result — trivially everything on a single host; the sharded class
+        returns this host's contiguous slice (parallel/mesh.py)."""
+        return slice(0, self.config.n_chains), reduced
 
     @staticmethod
     def _host_view(arr) -> np.ndarray:

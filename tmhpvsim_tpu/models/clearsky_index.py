@@ -227,6 +227,50 @@ def meter_block(key, t, max_w, dtype=jnp.float32):
     return max_w * draws.reshape(-1)[off]
 
 
+def scan_draws_tmajor(keys, g0, n_groups, dtype):
+    """Batched (u_cycle, z_sec) for a minute-ALIGNED block, time-major.
+
+    ``keys`` is the (n_chains,) stacked ``k_scan`` key array; returns two
+    (n_groups*60, n_chains) arrays whose row t is the per-chain draw for
+    local second t.  Values are bit-identical to the per-chain
+    :func:`_minute_grouped_draws` stream (same fold_in indices, same
+    counter slots) — only the memory layout differs, which is what the
+    scan-fused engine path needs (engine/simulation.py): the per-second
+    scan consumes row slices, so nothing is gathered or transposed.
+    """
+    n = keys.shape[0]
+
+    def per_group(g):
+        def per_chain(k):
+            kg = jax.random.fold_in(k, g)
+            u = jax.random.uniform(jax.random.fold_in(kg, 0), (60,), dtype)
+            z = jax.random.normal(jax.random.fold_in(kg, 1), (60,), dtype)
+            return u, z
+        return jax.vmap(per_chain, out_axes=1)(keys)   # (60, n) each
+
+    u, z = jax.vmap(per_group)(g0 + jnp.arange(n_groups))
+    return u.reshape(-1, n), z.reshape(-1, n)
+
+
+def meter_block_tmajor(keys, g0, n_groups, max_w, dtype):
+    """Time-major batched meter stream for a minute-aligned block:
+    (n_groups*60, n_chains), row t = per-chain demand at local second t.
+    Bit-identical values to :func:`meter_block` (same fold_in/counter
+    indexing), laid out for the scan-fused engine path."""
+    n = keys.shape[0]
+
+    def per_group(g):
+        return jax.vmap(
+            lambda k: jax.random.uniform(
+                jax.random.fold_in(k, g), (60,), dtype
+            ),
+            out_axes=1,
+        )(keys)
+
+    u = jax.vmap(per_group)(g0 + jnp.arange(n_groups))
+    return max_w * u.reshape(-1, n)
+
+
 def _minute_grouped_draws(key, t, dtype):
     """(uniform, normal) per second of ``t``, one hash per minute."""
     kg, off = minute_grouped_keys(key, t)
@@ -240,7 +284,7 @@ def _minute_grouped_draws(key, t, dtype):
 
 
 def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
-                   options: ModelOptions, dtype=jnp.float32):
+                   options: ModelOptions, dtype=jnp.float32, unroll=8):
     """One block of per-second csi for one chain.
 
     TPU layout: the *only* sequential dependency is the renewal carry, so
@@ -316,7 +360,66 @@ def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
         return renewal.step_from_cycle(c, x["cl"], x["to"], dtype)
 
     carry, covered = jax.lax.scan(
-        body, carry, {"cl": cloud_cand, "to": total_cand}, unroll=8
+        body, carry, {"cl": cloud_cand, "to": total_cand}, unroll=unroll
+    )
+
+    is_cov = covered > 0.5
+    use_clear = is_cov if not options.swap_covered_branches else ~is_cov
+    base = jnp.where(use_clear, base_clear, base_cloudy)
+    nmin = jnp.where(use_clear, nmin_clear, nmin_cloudy)
+    return carry, base * (nmin + noise_sec), covered
+
+
+def value_major_tables(arrays, minute_vals):
+    """Sampler tables transposed to value-major (n_values, n_chains) for
+    the scan-fused path: the per-second body indexes ROWS by the step's
+    scalar interval index (a dynamic-slice), instead of the wide path's
+    per-chain (n_chains, block_s) gathers — the single biggest HBM-traffic
+    term of the wide formulation (measured on TPU v5e: the wide block step
+    is bandwidth-bound, engine/simulation.py)."""
+    return {
+        "cc": arrays["cc"].T,
+        "cloudy": arrays["cloudy"].T,
+        "clear_day": arrays["clear_day"].T,
+        "ws": arrays["ws"].T,
+        "ml": minute_vals["noise_min_clear"].T,
+        "mc": minute_vals["noise_min_cloudy"].T,
+    }
+
+
+def csi_compose_step(tables, x, carry, options: ModelOptions,
+                     dtype=jnp.float32):
+    """One simulated second of csi for ALL chains (the scan-fused body).
+
+    Same math as :func:`csi_scan_block`, evaluated per step on (n_chains,)
+    vectors: ``tables`` from :func:`value_major_tables`; ``x`` carries the
+    step's scalar calendar indices/fractions (h, d, m, hf, df, mf) and the
+    per-chain pre-drawn (u, z); ``carry`` is the renewal carry.  Returns
+    (carry', csi, covered).  Consumes the identical RNG stream as the wide
+    path (scan_draws_tmajor), so both formulations produce the same
+    simulation up to float reassociation.
+    """
+    h, d, m = x["h"], x["d"], x["m"]
+    hf, df, mf = x["hf"], x["df"], x["mf"]
+
+    cc_t = tables["cc"][h] * (1 - hf) + tables["cc"][h + 1] * hf
+    ws_t = tables["ws"][d] * (1 - df) + tables["ws"][d + 1] * df
+
+    s0, s1 = NOISE_CLEAR
+    noise_sec = SIGMA_SEC_FACTOR * (s0 + s1 * 8.0 * cc_t) * x["z"]
+
+    cd = h + d
+    base_clear = (tables["clear_day"][cd] * (1 - df)
+                  + tables["clear_day"][cd + 1] * df)
+    h_c = h if options.advance_cloudy_hour else 0
+    base_cloudy = (tables["cloudy"][h_c] * (1 - hf)
+                   + tables["cloudy"][h_c + 1] * hf)
+    nmin_clear = tables["ml"][m] * (1 - mf) + tables["ml"][m + 1] * mf
+    nmin_cloudy = tables["mc"][m] * (1 - mf) + tables["mc"][m + 1] * mf
+
+    cloud_cand, total_cand = renewal.cycle_from_u(x["u"], cc_t, ws_t)
+    carry, covered = renewal.step_from_cycle(
+        carry, cloud_cand, total_cand, dtype
     )
 
     is_cov = covered > 0.5

@@ -98,6 +98,8 @@ class ShardedSimulation(Simulation):
         # per-block sequence.
         self._block_jit = self._sharded_block
         self._stats_acc_jit = self._sharded_stats_acc
+        self._fused_acc_jit = self._build_sharded_fused_acc()
+        self._scan_acc_jit = self._build_sharded_scan_acc()
         self._series_jit = self._trace_ensemble
 
     def init_state(self):
@@ -117,7 +119,7 @@ class ShardedSimulation(Simulation):
             out_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS), P(CHAIN_AXIS)),
             check_vma=False,
         )
-        return jax.jit(mapped)
+        return jax.jit(mapped, donate_argnums=0)
 
     def _build_sharded_stats_acc(self):
         """Reduce-mode consumer under shard_map: fold this shard's
@@ -132,7 +134,35 @@ class ShardedSimulation(Simulation):
             out_specs=spec_c,
             check_vma=False,
         )
-        return jax.jit(mapped)
+        return jax.jit(mapped, donate_argnums=3)
+
+    def _build_sharded_fused_acc(self):
+        """Reduce-mode fused topology under shard_map (see
+        SimConfig.stats_fusion): producer + stats + merge per shard in one
+        jit, zero collectives, state and accumulator donated."""
+        spec_c, spec_r = P(CHAIN_AXIS), P()
+        mapped = shard_map(
+            self._step_acc_fused,
+            mesh=self.mesh,
+            in_specs=(spec_c, spec_r, spec_c),
+            out_specs=(spec_c, spec_c),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 2))
+
+    def _build_sharded_scan_acc(self):
+        """Scan-fused reduce topology under shard_map (see
+        SimConfig.block_impl): the whole per-second pipeline per shard,
+        zero collectives, state and accumulator donated."""
+        spec_c, spec_r = P(CHAIN_AXIS), P()
+        mapped = shard_map(
+            self._block_step_scan_acc,
+            mesh=self.mesh,
+            in_specs=(spec_c, spec_r, spec_c),
+            out_specs=(spec_c, spec_c),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 2))
 
     def _build_trace_ensemble(self):
         """Trace/ensemble-mode consumer: per-second sums of meter and pv
@@ -186,14 +216,56 @@ class ShardedSimulation(Simulation):
     def init_reduce_acc(self):
         return super().init_reduce_acc(sharding=chain_sharding(self.mesh))
 
+    def _is_multihost(self) -> bool:
+        return any(d.process_index != jax.process_index()
+                   for d in self.mesh.devices.flat)
+
     def _place_resume(self, tree):
         """Checkpointed pytrees re-enter with the chain sharding they were
         saved from (host numpy otherwise reaches ``_host_view`` unplaced
-        when a resume has no blocks left to run).  Single-host only for
-        now: on a pod slice each host holds only its chain slice, so resume
-        needs per-host checkpoint files (device_put below raises loudly on
-        non-addressable meshes rather than fabricating state)."""
-        return jax.device_put(tree, chain_sharding(self.mesh))
+        when a resume has no blocks left to run).
+
+        Single host: a plain ``device_put`` of the full tree.  Pod slice:
+        each host loaded only ITS chain slice from its per-host checkpoint
+        file (``host_local_tree`` + apps/pvsim.py naming), so the global
+        sharded arrays are assembled with
+        ``jax.make_array_from_process_local_data`` — every process
+        contributes the contiguous chains its devices own, no DCN
+        traffic.  PRNG-key leaves ride as their key_data words and are
+        re-wrapped on the assembled array."""
+        sh = chain_sharding(self.mesh)
+        if not self._is_multihost():
+            return jax.device_put(tree, sh)
+
+        def place(v):
+            if hasattr(v, "dtype") and jax.dtypes.issubdtype(
+                    v.dtype, jax.dtypes.prng_key):
+                kd = np.asarray(jax.random.key_data(v))
+                arr = jax.make_array_from_process_local_data(sh, kd)
+                return jax.random.wrap_key_data(
+                    arr, impl=self.config.prng_impl
+                )
+            return jax.make_array_from_process_local_data(sh, np.asarray(v))
+
+        return jax.tree.map(place, tree)
+
+    def host_local_tree(self, tree):
+        """Restrict every chain-sharded leaf to this host's contiguous
+        chain slice (``_host_view``) so a pod-slice host checkpoints
+        exactly the chains it owns — the save-side counterpart of
+        ``_place_resume``'s per-process reassembly.  PRNG-key leaves are
+        sliced via their key_data words and re-wrapped."""
+
+        def conv(v):
+            if hasattr(v, "dtype") and jax.dtypes.issubdtype(
+                    v.dtype, jax.dtypes.prng_key):
+                kd = self._host_view(jax.random.key_data(v))
+                return jax.random.wrap_key_data(
+                    jnp.asarray(kd), impl=self.config.prng_impl
+                )
+            return self._host_view(v)
+
+        return jax.tree.map(conv, tree)
 
     @staticmethod
     def _host_view(arr) -> np.ndarray:

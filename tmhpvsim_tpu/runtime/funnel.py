@@ -38,6 +38,7 @@ Deviations from the reference (both documented in SURVEY.md §5):
 from __future__ import annotations
 
 import asyncio
+import heapq
 import logging
 import math
 from typing import NamedTuple, Optional, Type
@@ -68,6 +69,10 @@ class SynchronizingFunnel:
         self._blank = record_type(*([math.nan] * len(record_type._fields)))
         self._queue = queue
         self._cache: dict = {}
+        #: min-heap of times ever inserted into the cache, for O(log n)
+        #: oldest-first eviction; entries go stale when a record completes
+        #: (lazy deletion: _evict_if_needed skips keys no longer cached)
+        self._age_heap: list = []
         self.max_pending = max_pending
         #: max `time` distance a producer may run ahead of the slowest other
         #: stream (same type as `time - time`: timedelta for datetimes,
@@ -108,10 +113,26 @@ class SynchronizingFunnel:
     async def put(self, time, **fields) -> None:
         rec = self._cache.get(time, self._blank)._replace(**fields)
         if any(isinstance(v, float) and math.isnan(v) for v in rec):
+            if time not in self._cache:
+                heapq.heappush(self._age_heap, time)
             self._cache[time] = rec
             await self._evict_if_needed()
         else:
             self._cache.pop(time, None)
+            # drain stale heap entries now, not only at eviction time: in a
+            # healthy join the cache stays small and eviction never runs,
+            # but every record passed through the heap — without this the
+            # heap gains one entry per joined timestamp forever.  Times
+            # arrive near-monotonically, so completed records surface at
+            # the heap top and this stays amortised O(log n)...
+            while self._age_heap and self._age_heap[0] not in self._cache:
+                heapq.heappop(self._age_heap)
+            # ...and a compaction backstop bounds the pathological case
+            # (completions in anti-chronological order keep stale entries
+            # buried mid-heap)
+            if len(self._age_heap) > 2 * len(self._cache) + 64:
+                self._age_heap = list(self._cache)
+                heapq.heapify(self._age_heap)
             await self._queue.put((time, rec))
         for f in fields:
             cur = self._newest.get(f)
@@ -184,7 +205,13 @@ class SynchronizingFunnel:
     async def _evict_if_needed(self):
         if self.max_pending is None or len(self._cache) <= self.max_pending:
             return
-        oldest = min(self._cache)
+        # pop stale heap entries (records that completed and left the cache)
+        # until the top is a live pending time — amortised O(log n) vs the
+        # O(n) min(self._cache) scan this replaces
+        while True:
+            oldest = heapq.heappop(self._age_heap)
+            if oldest in self._cache:
+                break
         self._cache.pop(oldest)
         self.n_evicted += 1
         if self.n_evicted == 1 or self.n_evicted % 1000 == 0:
