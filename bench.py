@@ -405,6 +405,8 @@ def _reduce_config_run(label: str, cfg, sharded: bool, note: str,
         "echo": {
             "n_chains": cfg.n_chains, "duration_s": cfg.duration_s,
             "block_s": cfg.block_s, "prng_impl": cfg.prng_impl,
+            "block_impl": ("scan" if sim._use_scan
+                           else "fused" if sim._use_fused else "split"),
             "site_grid": cfg.site_grid is not None,
             "start": cfg.start, "seed": cfg.seed,
         },
@@ -537,9 +539,12 @@ def config_5() -> None:
     execute end-to-end on an 8-device mesh, with duration scaled down.
     """
     _force_cpu(8)
-    # threefry here: rbg works on CPU but is slower there, and this
-    # artifact's point is the 1M-chain mechanics, not the CPU rate
-    cfg = _make_cfg(1_000_000, 2, block_s=120, prng_impl="threefry2x32")
+    # threefry here (rbg works on CPU but is slower there; the point is
+    # the 1M-chain mechanics, not the CPU rate); block_impl='scan' FORCED
+    # so the artifact exercises the TPU production path at the target
+    # batch size — 'auto' would silently resolve to 'wide' on this host
+    cfg = _make_cfg(1_000_000, 2, block_s=120, prng_impl="threefry2x32",
+                    block_impl="scan")
     _reduce_config_run(
         "5: 1M-chain ensemble (scaled dryrun, 8 virtual CPU devices)",
         cfg, sharded=True,

@@ -120,6 +120,22 @@ def test_resume_equals_straight_run():
     np.testing.assert_allclose(b1.pv, blocks[1].pv, atol=1e-5)
 
 
+def test_state_is_duration_independent(run):
+    """Windowed sampler arrays: the per-chain state must have the SAME
+    leaf shapes for a 2-hour and a 90-day run — sampler values are
+    regenerated per block from global-index-keyed draws, so nothing in
+    the carried pytree scales with duration (the property that makes the
+    10-year x 1M-chain BASELINE config memory-feasible)."""
+    sim, _ = run
+    s_short = sim.init_state()
+    s_long = Simulation(small_config(duration_s=90 * 86400)).init_state()
+    import jax
+
+    short_shapes = jax.tree.map(lambda a: a.shape, s_short)
+    long_shapes = jax.tree.map(lambda a: a.shape, s_long)
+    assert short_shapes == long_shapes
+
+
 def test_scan_impl_matches_wide(run):
     """SimConfig.block_impl='scan' (the TPU formulation: whole pipeline in
     one lax.scan, stats in the carry) must produce the same per-chain

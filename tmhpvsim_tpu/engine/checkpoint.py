@@ -26,13 +26,15 @@ _KEY_PREFIX = "key:"
 _META = "__meta__"
 
 #: Version of the *random-stream layout* (how draws are derived from keys
-#: and global indices).  Bump whenever the derivation changes — e.g. v2
+#: and global indices).  Bump whenever the derivation changes — v2
 #: switched the per-second streams from per-second fold_in+split to
-#: minute-grouped counter draws — so a checkpoint from an older build is
-#: REFUSED (clear config-mismatch error) instead of silently resuming with
-#: different randomness and producing a hybrid trace no version can
-#: reproduce.
-RNG_STREAM_VERSION = 2
+#: minute-grouped counter draws; v3 switched the hourly/daily samplers to
+#: global-index-keyed (fold_in) draws so any window regenerates without
+#: history (windowed arrays, engine/simulation.py) — so a checkpoint from
+#: an older build is REFUSED (clear config-mismatch error) instead of
+#: silently resuming with different randomness and producing a hybrid
+#: trace no version can reproduce.
+RNG_STREAM_VERSION = 3
 
 
 def _config_echo(config) -> dict:

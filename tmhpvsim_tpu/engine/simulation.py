@@ -52,6 +52,7 @@ from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
 from tmhpvsim_tpu.models import clearsky_index as ci
 from tmhpvsim_tpu.models import pv as pvmod
+from tmhpvsim_tpu.models import renewal
 from tmhpvsim_tpu.models import solar
 from tmhpvsim_tpu.models.timegrid import TimeGridSpec
 
@@ -122,6 +123,20 @@ class Simulation:
         self.dtype = jnp.dtype(config.dtype)
         self.n_blocks = self._padded_s // config.block_s
         self._n_minute_vals = None  # fixed after first block (constant shape)
+        # Static per-block sampler-window sizes (windowed arrays: the state
+        # carries only RNG keys + a Markov carry, and each block
+        # regenerates the hourly/daily sampler values its seconds touch —
+        # every draw is keyed by GLOBAL value index, so windows reproduce
+        # the same values as a full-run precompute.  Memory is O(block),
+        # not O(duration): the property that makes 10-year x 1M-chain runs
+        # feasible).  Bounds: a block of block_s seconds spans at most
+        # block_s//3600 + 1 hour intervals; +1 early start (cloudy draws
+        # read cc[k-1]), +2 interpolation upper values, +1 slack, checked
+        # per block in host_inputs.
+        bs = config.block_s
+        self._w_hours = bs // 3600 + 5
+        self._w_days = bs // 86400 + 3
+        self._w_cd = self._w_hours + self._w_days
 
         root = jax.random.key(config.seed, impl=config.prng_impl)
         self._k_chains, _ = jax.random.split(root)
@@ -169,9 +184,14 @@ class Simulation:
     # ------------------------------------------------------------------
 
     def init_state(self, sharding=None):
-        """Initial carried pytree for all chains: sampler arrays, renewal
-        carry, and per-chain keys.  With the block offset this is a complete
-        checkpoint of the simulation.
+        """Initial carried pytree for all chains.  With the block offset
+        this is a complete checkpoint of the simulation — and it is
+        O(1) PER CHAIN regardless of run duration: sampler values are
+        regenerated per block from global-index-keyed draws (windowed
+        arrays, see __init__), so the state holds only the per-chain RNG
+        keys, the Markov-chain carry, the renewal carry, and three
+        construction-time scalars (cc0 + the frozen cloudy pair the
+        reference-compat mode interpolates forever).
 
         ``sharding`` (a NamedSharding over the chain axis) is applied as
         the jit's ``out_shardings`` so every leaf — including the site
@@ -185,11 +205,24 @@ class Simulation:
 
         def one(key):
             k_arr, k_min, k_renew, k_scan, k_meter = jax.random.split(key, 5)
-            arrays = ci.build_chain_arrays(k_arr, feats, opts, dtype)
-            carry = ci.init_renewal(k_renew, arrays, dtype)
+            k_cc, k_cloudy, _k_day, k_ws = jax.random.split(k_arr, 4)
+            # construction-time primer values (global indices 0, 1): the
+            # renewal process starts from the samplers' *before* values
+            # (clearskyindexmodel.py:98-99), cc0 is the construction-time
+            # cloud-cover interpolation every k<2 cloudy draw sees, and
+            # the cloudy pair is what compat mode interpolates forever
+            cc01, _ = ci.cc_window(k_cc, 0, 2, jnp.asarray(1.0, dtype),
+                                   opts, dtype)
+            cc0 = cc01[0] * (1 - feats.f0_hour) + cc01[1] * feats.f0_hour
+            ws0 = ci.ws_window(k_ws, 0, 1, dtype)[0]
+            carry = renewal.init(k_renew, cc01[0], ws0, dtype)
             return {
-                "arrays": arrays,
+                "cc_carry": jnp.asarray(1.0, dtype),  # state before hour 0
+                "cc0": cc0,
+                "cloudy_pair": ci.cloudy_window(k_cloudy, 0, 2, cc01, 0,
+                                                cc0, dtype),
                 "carry": carry,
+                "k_arr": k_arr,
                 "k_min": k_min,
                 "k_scan": k_scan,
                 "k_meter": k_meter,
@@ -234,11 +267,19 @@ class Simulation:
 
         Geometry is evaluated here in float64 numpy — it is O(block_s) and
         shared by every chain — then cast to the compute dtype.
+
+        Sampler indices (hour/day/pair) are REBASED to the block's sampler
+        windows (``inputs["win"]``): the device step regenerates exactly
+        the window of hourly/daily values this block touches from
+        global-index-keyed draws, so the rebased index into the window
+        reads the same value a full-run precompute would hold at the
+        global index (windowed arrays, __init__).
         """
         cfg = self.config
         off = block_i * cfg.block_s
+        blk = self.spec.block(off, cfg.block_s)
         block_idx, (mlo, mhi) = ci.host_block_index(
-            self.spec, off, cfg.block_s, self.dtype
+            self.spec, off, cfg.block_s, self.dtype, blk=blk
         )
         if self._n_minute_vals is None:
             self._n_minute_vals = mhi - mlo
@@ -248,16 +289,44 @@ class Simulation:
                 "the minute grid aligned"
             )
         h_idx, h_frac = self.spec.minute_value_features(mlo, mhi)
+
+        # --- sampler-window bounds (host ints) + index rebasing
+        hb = int(blk.hour_idx[0])
+        he = int(blk.hour_idx[-1])
+        db = int(blk.day_idx[0])
+        de = int(blk.day_idx[-1])
+        hour_lo = max(hb - 1, 0)  # cloudy value k reads cc[k-1]
+        day_lo = db
+        cd_lo = hour_lo + day_lo  # rebased pair index (h-hour_lo)+(d-day_lo)
+        hour_hi_need = max(he + 1, int(h_idx.max()) + 1)  # interp upper
+        assert hour_hi_need - hour_lo + 1 <= self._w_hours, (
+            hour_lo, hour_hi_need, self._w_hours
+        )
+        assert de + 1 - day_lo + 1 <= self._w_days, (day_lo, de)
+        assert he + de + 1 - cd_lo + 1 <= self._w_cd, (cd_lo, he + de)
+        if block_i + 1 < self.n_blocks:
+            nxt = self.spec.block((block_i + 1) * cfg.block_s, 1)
+            hour_next_lo = max(int(nxt.hour_idx[0]) - 1, 0)
+        else:
+            hour_next_lo = hour_lo  # last block: carry stays put
+
+        block_idx["hour_idx"] = block_idx["hour_idx"] - jnp.int32(hour_lo)
+        block_idx["day_idx"] = block_idx["day_idx"] - jnp.int32(day_lo)
         mfeats = (
-            jnp.asarray(h_idx, dtype=jnp.int32),
+            jnp.asarray(h_idx - hour_lo, dtype=jnp.int32),
             jnp.asarray(h_frac, dtype=self.dtype),
         )
 
-        blk = self.spec.block(off, cfg.block_s)
         inputs = {
             "block_idx": block_idx,
             "mlo": jnp.asarray(mlo, dtype=jnp.int32),
             "mfeats": mfeats,
+            "win": {
+                "hour_lo": jnp.asarray(hour_lo, dtype=jnp.int32),
+                "hour_next_lo": jnp.asarray(hour_next_lo, dtype=jnp.int32),
+                "day_lo": jnp.asarray(day_lo, dtype=jnp.int32),
+                "cd_lo": jnp.asarray(cd_lo, dtype=jnp.int32),
+            },
         }
         if cfg.site_grid is None:
             # shared site: exact float64 geometry on the host, cast once
@@ -286,6 +355,40 @@ class Simulation:
     # device block step (jitted once; shapes constant across blocks)
     # ------------------------------------------------------------------
 
+    def _windows_one_chain(self, chain, inputs):
+        """Regenerate ONE chain's sampler windows for one block (traced).
+
+        Returns (arrays, minute_vals, new_cc_carry): the window arrays have
+        the same structure as a full-run ``build_chain_arrays`` result but
+        length O(block); indices arriving in ``inputs`` are already rebased
+        to them (host_inputs).  The Markov carry is advanced to the next
+        block's window start by selecting the already-generated state —
+        blocks re-run from a checkpoint resume bit-identically because
+        every draw is keyed by global index."""
+        cfg = self.config
+        dtype = self.dtype
+        win = inputs["win"]
+        k_cc, k_cloudy, k_day, k_ws = jax.random.split(chain["k_arr"], 4)
+
+        cc_w, _ = ci.cc_window(k_cc, win["hour_lo"], self._w_hours,
+                               chain["cc_carry"], cfg.options, dtype)
+        nxt, lo = win["hour_next_lo"], win["hour_lo"]
+        adv = jnp.clip(nxt - lo - 1, 0, self._w_hours - 1)
+        cc_carry = jnp.where(nxt == lo, chain["cc_carry"], cc_w[adv])
+
+        arrays = {
+            "cc": cc_w,
+            "cloudy": ci.cloudy_window(k_cloudy, lo, self._w_hours, cc_w,
+                                       lo, chain["cc0"], dtype),
+            "clear_day": ci.clear_day_window(k_day, win["cd_lo"],
+                                             self._w_cd, dtype),
+            "ws": ci.ws_window(k_ws, win["day_lo"], self._w_days, dtype),
+        }
+        mvals = ci.minute_noise_values_device(
+            chain["k_min"], cc_w, inputs["mlo"], inputs["mfeats"], dtype
+        )
+        return arrays, mvals, cc_carry
+
     def _block_step(self, state, inputs):
         """(state, inputs) -> (state', meter, pv), all on device.
 
@@ -308,7 +411,6 @@ class Simulation:
         cfg = self.config
         block_idx = inputs["block_idx"]
         mlo = inputs["mlo"]
-        mfeats = inputs["mfeats"]
         dtype = self.dtype
         shared_geom = inputs.get("geom")
         if shared_geom is None:
@@ -328,13 +430,12 @@ class Simulation:
                     site["surface_tilt"], site["surface_azimuth"],
                     site["albedo"], turbidity, xp=jnp,
                 )
-            mvals = ci.minute_noise_values_device(
-                chain["k_min"], chain["arrays"]["cc"], mlo, mfeats, dtype
-            )
+            arrays, mvals, cc_carry = self._windows_one_chain(chain, inputs)
             carry, csi, _covered = ci.csi_scan_block(
-                chain["k_scan"], chain["arrays"], mvals, mlo,
+                chain["k_scan"], arrays, mvals, mlo,
                 chain["carry"], block_idx, cfg.options, dtype,
                 unroll=cfg.scan_unroll,
+                cloudy_pair=chain["cloudy_pair"],
             )
             ac = pvmod.power_from_csi(
                 csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
@@ -344,7 +445,7 @@ class Simulation:
             meter = ci.meter_block(
                 chain["k_meter"], block_idx["t"], cfg.meter_max_w, dtype
             )
-            return dict(chain, carry=carry), meter, ac
+            return dict(chain, carry=carry, cc_carry=cc_carry), meter, ac
 
         return jax.vmap(one_chain)(state)
 
@@ -469,16 +570,13 @@ class Simulation:
         opts = cfg.options
         bi = inputs["block_idx"]
         t = bi["t"]
-        mlo = inputs["mlo"]
         shared_geom = inputs.get("geom")
-        arrays = state["arrays"]
 
-        mvals = jax.vmap(
-            lambda k, cc: ci.minute_noise_values_device(
-                k, cc, mlo, inputs["mfeats"], dtype
-            )
-        )(state["k_min"], arrays["cc"])
+        arrays, mvals, cc_carry = jax.vmap(
+            lambda ch: self._windows_one_chain(ch, inputs)
+        )(state)
         tables = ci.value_major_tables(arrays, mvals)
+        tables["cloudy_pair"] = state["cloudy_pair"].T
 
         # blocks are minute-aligned by construction (block_s % 60 == 0 and
         # offsets are whole blocks), so local second s is draw slot s % 60
@@ -509,7 +607,8 @@ class Simulation:
         big = jnp.asarray(jnp.finfo(dtype).max, dtype)
         xs = {
             "t": t,
-            "h": bi["hour_idx"], "d": bi["day_idx"], "m": bi["min_idx"] - mlo,
+            "h": bi["hour_idx"], "d": bi["day_idx"],
+            "m": bi["min_idx"] - inputs["mlo"],
             "hf": bi["hour_frac"], "df": bi["day_frac"], "mf": bi["min_frac"],
             "u": u_T, "z": z_T, "meter": meter_T,
             "geom": geom_xs,
@@ -557,7 +656,7 @@ class Simulation:
         (rcarry, acc), _ = jax.lax.scan(
             body, (state["carry"], acc), xs, unroll=cfg.scan_unroll
         )
-        return dict(state, carry=rcarry), acc
+        return dict(state, carry=rcarry, cc_carry=cc_carry), acc
 
     def step_acc(self, state, inputs, acc):
         """One reduce-mode block folded into the on-device accumulator."""

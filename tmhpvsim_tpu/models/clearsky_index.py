@@ -112,9 +112,63 @@ def _cloudy_csi_draw(key, cc, dtype):
     return jnp.where(cc < 6 / 8, z, g)
 
 
+def cc_window(k_cc, lo, n, carry, options: ModelOptions, dtype=jnp.float32):
+    """Hourly cloud-cover values for global indices [lo, lo+n).
+
+    ``carry`` is the chain state before transition ``lo`` (ignored in the
+    iid-compat mode).  Returns (values[n], new_carry).  Every draw is
+    keyed by its global index (markov_hourly.chain_window/iid_window), so
+    any window regenerates identically — the foundation of the engine's
+    O(window) state (SURVEY.md §5 checkpoint note)."""
+    if options.persistent_cloud_chain:
+        return markov_hourly.chain_window(k_cc, lo, n, carry, dtype)
+    return markov_hourly.iid_window(k_cc, lo, n, dtype), carry
+
+
+def cloudy_window(k_cloudy, lo, n, cc_vals, cc_lo, cc0, dtype=jnp.float32):
+    """Cloudy-csi values for global indices [lo, lo+n).
+
+    Value k >= 2 is drawn at hour rollover k-1 (hour_fraction == 0), so it
+    sees cc == cc[k-1]; the two primer values (k < 2) see the
+    construction-time interpolation ``cc0`` = lerp(cc[0], cc[1], f0_hour).
+    ``cc_vals``/``cc_lo`` supply the hourly window covering [lo-1, lo+n-2]
+    (entries outside it are never consumed: the windowed caller's window
+    always starts one hour early, and the k < 2 branch covers the rest).
+    """
+    idx = lo + jnp.arange(n)
+    cc_at = jnp.where(
+        idx < 2, cc0,
+        cc_vals[jnp.clip(idx - 1 - cc_lo, 0, cc_vals.shape[0] - 1)],
+    )
+    keys = jax.vmap(lambda i: jax.random.fold_in(k_cloudy, i))(idx)
+    return jax.vmap(lambda k, c: _cloudy_csi_draw(k, c, dtype))(keys, cc_at)
+
+
+def clear_day_window(k_day, lo, n, dtype=jnp.float32):
+    """Clear-sky-day values for global pair indices [lo, lo+n) (the pair
+    index is hour_idx + day_idx: the sampler advances on both rollovers).
+    Index-keyed i.i.d. draws — randomly accessible."""
+    idx = lo + jnp.arange(n)
+    return jax.vmap(
+        lambda i: dist.normal(jax.random.fold_in(k_day, i),
+                              CSI_CLEAR_DAY_LOC, CSI_CLEAR_DAY_SCALE,
+                              (), dtype)
+    )(idx)
+
+
+def ws_window(k_ws, lo, n, dtype=jnp.float32):
+    """Daily windspeed values for global day indices [lo, lo+n)."""
+    idx = lo + jnp.arange(n)
+    return jax.vmap(
+        lambda i: dist.windspeed(jax.random.fold_in(k_ws, i), (), dtype)
+    )(idx)
+
+
 def build_chain_arrays(key, feats: HostFeatures, options: ModelOptions,
                        dtype=jnp.float32):
-    """All above-second-rate sampler values for ONE chain.
+    """All above-second-rate sampler values for ONE chain, full run — the
+    window functions above evaluated over the whole grid (tests and small
+    runs; the engine generates per-block windows instead).
 
     Returns dict of arrays:
       cc     [n_hours+1]           hourly cloud cover (Markov chain states)
@@ -124,29 +178,17 @@ def build_chain_arrays(key, feats: HostFeatures, options: ModelOptions,
     """
     k_cc, k_cloudy, k_day, k_ws = jax.random.split(key, 4)
 
-    if options.persistent_cloud_chain:
-        cc = markov_hourly.chain(k_cc, feats.n_hours + 1, dtype=dtype)
-    else:
-        cc = markov_hourly.iid_from_one(k_cc, feats.n_hours + 1, dtype=dtype)
-
-    # cloudy-csi: value k>=2 is drawn at hour rollover k-1, where
-    # hour_fraction == 0, so it sees cc == cc[k-1]; the two primer values see
-    # the construction-time interpolation lerp(cc[0], cc[1], f0_hour).
+    cc, _ = cc_window(k_cc, 0, feats.n_hours + 1, jnp.asarray(1.0, dtype),
+                      options, dtype)
     cc0 = cc[0] * (1 - feats.f0_hour) + cc[1] * feats.f0_hour
-    n_cloudy = feats.n_hours + 1
-    idx = jnp.arange(n_cloudy)
-    cc_at_draw = jnp.where(idx < 2, cc0, cc[jnp.maximum(idx - 1, 0)])
-    keys = jax.vmap(lambda i: jax.random.fold_in(k_cloudy, i))(idx)
-    cloudy = jax.vmap(lambda k, c: _cloudy_csi_draw(k, c, dtype))(keys, cc_at_draw)
+    cloudy = cloudy_window(k_cloudy, 0, feats.n_hours + 1, cc, 0, cc0,
+                           dtype)
     # (reference-compat frozen pair is handled at gather time in
     # csi_scan_block: the pair index is pinned to 0 so (cloudy[0], cloudy[1])
     # interpolate forever, exactly like a sampler that never advances)
-
-    n_cd = feats.n_hours + feats.n_days + 1
-    clear_day = dist.normal(
-        k_day, CSI_CLEAR_DAY_LOC, CSI_CLEAR_DAY_SCALE, (n_cd,), dtype
-    )
-    ws = dist.windspeed(k_ws, (feats.n_days + 1,), dtype)
+    clear_day = clear_day_window(k_day, 0, feats.n_hours + feats.n_days + 1,
+                                 dtype)
+    ws = ws_window(k_ws, 0, feats.n_days + 1, dtype)
     return {"cc": cc, "cloudy": cloudy, "clear_day": clear_day, "ws": ws}
 
 
@@ -284,7 +326,8 @@ def _minute_grouped_draws(key, t, dtype):
 
 
 def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
-                   options: ModelOptions, dtype=jnp.float32, unroll=8):
+                   options: ModelOptions, dtype=jnp.float32, unroll=8,
+                   cloudy_pair=None):
     """One block of per-second csi for one chain.
 
     TPU layout: the *only* sequential dependency is the renewal carry, so
@@ -339,11 +382,17 @@ def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
     noise_sec = SIGMA_SEC_FACTOR * (s0 + s1 * 8.0 * cc_t) * z_sec
 
     base_clear = clear_day[cd] * (1 - df) + clear_day[cd + 1] * df
-    # reference-compat: the cloudy sampler never advances, so its pair
-    # index stays 0 (clearskyindexmodel.py:101-111 advances every sampler
-    # except this one)
-    h_c = h if options.advance_cloudy_hour else jnp.zeros_like(h)
-    base_cloudy = cloudy[h_c] * (1 - hf) + cloudy[h_c + 1] * hf
+    if options.advance_cloudy_hour:
+        base_cloudy = cloudy[h] * (1 - hf) + cloudy[h + 1] * hf
+    else:
+        # reference-compat: the cloudy sampler never advances, so the two
+        # CONSTRUCTION-TIME values (global indices 0 and 1) interpolate
+        # forever (clearskyindexmodel.py:101-111 advances every sampler
+        # except this one).  Windowed callers pass them as ``cloudy_pair``
+        # (the window need not contain global index 0); full-run callers
+        # leave None and they are cloudy[:2].
+        pair = cloudy[:2] if cloudy_pair is None else cloudy_pair
+        base_cloudy = pair[0] * (1 - hf) + pair[1] * hf
     mrel = m - minute_lo
     nmin_clear = ml[mrel] * (1 - mf) + ml[mrel + 1] * mf
     nmin_cloudy = mc[mrel] * (1 - mf) + mc[mrel + 1] * mf
@@ -411,9 +460,15 @@ def csi_compose_step(tables, x, carry, options: ModelOptions,
     cd = h + d
     base_clear = (tables["clear_day"][cd] * (1 - df)
                   + tables["clear_day"][cd + 1] * df)
-    h_c = h if options.advance_cloudy_hour else 0
-    base_cloudy = (tables["cloudy"][h_c] * (1 - hf)
-                   + tables["cloudy"][h_c + 1] * hf)
+    if options.advance_cloudy_hour:
+        base_cloudy = (tables["cloudy"][h] * (1 - hf)
+                       + tables["cloudy"][h + 1] * hf)
+    else:
+        # construction-time frozen pair (see csi_scan_block); windowed
+        # callers supply it under "cloudy_pair" in value-major (2, chains)
+        pair = tables.get("cloudy_pair")
+        pair = tables["cloudy"][:2] if pair is None else pair
+        base_cloudy = pair[0] * (1 - hf) + pair[1] * hf
     nmin_clear = tables["ml"][m] * (1 - mf) + tables["ml"][m + 1] * mf
     nmin_cloudy = tables["mc"][m] * (1 - mf) + tables["mc"][m + 1] * mf
 
@@ -430,9 +485,14 @@ def csi_compose_step(tables, x, carry, options: ModelOptions,
 
 
 def host_block_index(spec: TimeGridSpec, offset: int, length: int,
-                     dtype=jnp.float32):
-    """Shared (chain-independent) scan inputs for one block, as device arrays."""
-    blk = spec.block(offset, length)
+                     dtype=jnp.float32, blk=None):
+    """Shared (chain-independent) scan inputs for one block, as device
+    arrays.  ``blk`` reuses an already-computed ``spec.block(offset,
+    length)`` — the O(block_s) float64 calendar precompute is the per-block
+    host cost, so callers that need the TimeBlock anyway (engine
+    host_inputs) pass it in instead of paying it twice."""
+    if blk is None:
+        blk = spec.block(offset, length)
     return {
         "t": jnp.asarray(blk.offset + np.arange(len(blk.epoch)), dtype=jnp.int32),
         "hour_idx": jnp.asarray(blk.hour_idx, dtype=jnp.int32),

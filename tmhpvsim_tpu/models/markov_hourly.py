@@ -66,29 +66,57 @@ def transition(key, state, params, dtype=jnp.float32):
     return jnp.clip(state + step, 0.0, 1.0)
 
 
+def chain_window(key, start, n, state, dtype=jnp.float32, params=None):
+    """``n`` successive chain states for global value indices
+    [start, start+n), continuing from ``state`` (the state the chain held
+    before transition ``start``).
+
+    Transition i is keyed by ``fold_in(key, i)`` — a pure function of the
+    global index — so ANY window of the chain can be generated from (key,
+    start, carry) without replaying history: the property that makes
+    simulation state O(window) instead of O(run duration)
+    (engine/simulation.py "windowed sampler arrays").  ``start`` may be a
+    traced scalar; ``n`` must be static.  Returns (values[n], new_state)
+    where new_state == values[n-1].
+    """
+    if params is None:
+        params = step_params(dtype)
+
+    def body(s, i):
+        nxt = transition(jax.random.fold_in(key, i), s, params, dtype)
+        return nxt, nxt
+
+    final, samples = jax.lax.scan(body, state, start + jnp.arange(n))
+    return samples, final
+
+
 def chain(key, n_samples, initial_state=1.0, dtype=jnp.float32):
     """Persistent chain: `n_samples` successive states after `initial_state`.
 
     Returns shape (n_samples,).  vmap over keys for independent chains.
+    The full-run convenience form of :func:`chain_window`.
     """
-    params = step_params(dtype)
     init = jnp.asarray(np.clip(initial_state, 0.0, 1.0), dtype=dtype)
-
-    def body(state, k):
-        nxt = transition(k, state, params, dtype)
-        return nxt, nxt
-
-    _, samples = jax.lax.scan(body, init, jax.random.split(key, n_samples))
+    samples, _ = chain_window(key, 0, n_samples, init, dtype)
     return samples
 
 
-def iid_from_one(key, n_samples, dtype=jnp.float32):
-    """Reference-compat mode: i.i.d. draws, each one step from state 1.0
-    (the accidental behaviour of clearskyindexmodel.py:61-63)."""
+def iid_window(key, start, n, dtype=jnp.float32):
+    """Reference-compat mode, windowed: value i is one i.i.d. step from
+    state 1.0 (the accidental behaviour of clearskyindexmodel.py:61-63),
+    keyed by global index — randomly accessible like
+    :func:`chain_window`, no carry."""
     params = step_params(dtype)
-    state = jnp.ones((n_samples,), dtype=dtype)
-    keys = jax.random.split(key, n_samples)
-    return jax.vmap(lambda k, s: transition(k, s, params, dtype))(keys, state)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        start + jnp.arange(n)
+    )
+    ones = jnp.ones((n,), dtype=dtype)
+    return jax.vmap(lambda k, s: transition(k, s, params, dtype))(keys, ones)
+
+
+def iid_from_one(key, n_samples, dtype=jnp.float32):
+    """Full-run convenience form of :func:`iid_window`."""
+    return iid_window(key, 0, n_samples, dtype)
 
 
 # ---------------------------------------------------------------------------
