@@ -8,8 +8,9 @@ TPU-era extensions: ``--backend {asyncio,jax}``, ``--seed``, ``--chains``,
 ``--duration``, ``--start``, ``--sharded``.
 
 The default transport URL is ``local://default`` (in-process fanout) so
-the two apps run out of the box without a broker; any amqp:// URL selects
-real AMQP (runtime/broker.py).
+the two apps run out of the box without a broker; ``tcp://HOST:PORT``
+speaks to the in-tree ``fanoutbroker`` server (cross-process, no external
+services); any amqp:// URL selects real AMQP (runtime/broker.py).
 """
 
 from __future__ import annotations
@@ -25,8 +26,9 @@ from tmhpvsim_tpu.runtime import asyncrun
 def _common_options(f):
     f = click.option(
         "--amqp-url", default=lambda: os.environ.get("AMQP_URL"),
-        help="AMQP URL, or local://NAME for the in-process broker "
-             "(defaults to 'local://default')",
+        help="broker URL: amqp://... (RabbitMQ), tcp://HOST:PORT (the "
+             "in-tree fanoutbroker command), or local://NAME (in-process; "
+             "the default, 'local://default')",
     )(f)
     f = click.option(
         "--exchange",
@@ -81,6 +83,32 @@ def _parse_site_grid(spec):
         raise click.UsageError(
             f"bad --site-grid {spec!r} (want LAT0:LAT1:NLAT,LON0:LON1:NLON)"
         ) from e
+
+
+@click.command()
+@click.option("--host", default="127.0.0.1", show_default=True,
+              help="interface to listen on")
+@click.option("--port", type=int, default=5673, show_default=True,
+              help="TCP port (0 picks a free one)")
+@click.option("-v", "--verbose", count=True)
+def fanoutbroker(host, port, verbose):
+    """Standalone fanout broker for tcp:// transports — the in-tree
+    replacement for the external RabbitMQ server the reference's
+    deployment needs (runtime/tcpbroker.py): run this in one shell, then
+    ``metersim --amqp-url tcp://HOST:PORT`` and ``pvsim out.csv
+    --amqp-url tcp://HOST:PORT`` in two others."""
+    from tmhpvsim_tpu.runtime.tcpbroker import TcpFanoutBroker
+
+    _setup_logging(verbose)
+
+    async def run():
+        broker = TcpFanoutBroker(host, port)
+        await broker.start()
+        click.echo(f"fanout broker listening on {broker.host}:{broker.port}",
+                   err=True)
+        await broker.serve_forever()
+
+    asyncrun(run())
 
 
 @click.command()
@@ -208,6 +236,7 @@ def main():
 
 main.add_command(metersim)
 main.add_command(pvsim)
+main.add_command(fanoutbroker)
 
 
 if __name__ == "__main__":

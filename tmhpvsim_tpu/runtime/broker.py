@@ -168,8 +168,14 @@ class AmqpTransport:
 
 
 def make_transport(url: Optional[str], exchange: str):
-    """Transport from a URL: ``local://`` -> in-process, else AMQP."""
+    """Transport from a URL: ``local://`` -> in-process, ``tcp://`` ->
+    the in-tree TCP fanout broker (runtime/tcpbroker.py, no external
+    services), else AMQP/RabbitMQ."""
     url = url or "local://default"
     if url.startswith("local://"):
         return LocalTransport(url, exchange)
+    if url.startswith("tcp://"):
+        from tmhpvsim_tpu.runtime.tcpbroker import TcpTransport
+
+        return TcpTransport(url, exchange)
     return AmqpTransport(url, exchange)

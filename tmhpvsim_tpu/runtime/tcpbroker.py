@@ -1,0 +1,225 @@
+"""TCP fanout broker: real cross-process streaming without RabbitMQ.
+
+The reference's documented deployment is two shells joined through an
+external RabbitMQ server (README.rst; SURVEY.md §2.4) — the broker is an
+unshipped third component.  The ``local://`` transport (runtime/broker.py)
+removed the dependency but cannot span OS processes; ``amqp://`` speaks to
+real RabbitMQ but needs aio-pika + a running broker.  This module closes
+the gap with an in-tree fanout broker speaking a minimal newline-delimited
+JSON protocol over TCP:
+
+    shell 1:  fanoutbroker --port 5673
+    shell 2:  metersim --amqp-url tcp://127.0.0.1:5673
+    shell 3:  pvsim out.csv --amqp-url tcp://127.0.0.1:5673
+
+— the reference's exact deployment shape, zero external services.
+
+Semantics mirror the AMQP fanout contract the apps rely on
+(metersim.py:25-42, pvsim.py:56-67): named exchanges, every subscriber
+sees every message published after it subscribed, measurement time rides
+with the value.  Slow subscribers get per-connection buffering with
+oldest-first drop beyond a cap (the funnel's leak-fix policy,
+runtime/funnel.py) so one stalled consumer can never wedge the broker —
+a deliberate improvement over the unbounded queues RabbitMQ would grow.
+
+Wire protocol (one JSON object per line, UTF-8):
+
+    {"op": "sub", "exchange": E}                  client -> broker
+    {"op": "pub", "exchange": E, "v": f, "ts": t} client -> broker
+    {"v": f, "ts": t}                             broker -> subscriber
+
+``ts`` is POSIX seconds (float) — the AMQP ``timestamp`` property's wire
+meaning.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import datetime as _dt
+import json
+import logging
+from typing import AsyncIterator, Dict, Optional, Set, Tuple
+from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
+
+#: per-subscriber buffered messages before oldest-first drop
+MAX_SUBSCRIBER_BACKLOG = 10_000
+
+
+class _Subscriber:
+    """One consumer connection: a bounded queue + drain task, so a slow or
+    stalled consumer back-pressures onto ITS buffer, never the broker."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.n_dropped = 0
+
+    def offer(self, line: bytes) -> None:
+        while self.queue.qsize() >= MAX_SUBSCRIBER_BACKLOG:
+            self.queue.get_nowait()
+            self.n_dropped += 1
+            if self.n_dropped == 1 or self.n_dropped % 1000 == 0:
+                logger.warning(
+                    "tcp broker: subscriber backlog exceeded %d; dropped "
+                    "%d oldest messages (consumer stalled?)",
+                    MAX_SUBSCRIBER_BACKLOG, self.n_dropped,
+                )
+        self.queue.put_nowait(line)
+
+    async def drain(self) -> None:
+        while True:
+            line = await self.queue.get()
+            self.writer.write(line)
+            await self.writer.drain()
+
+
+class TcpFanoutBroker:
+    """The broker server: named fanout exchanges over one TCP port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5673):
+        self.host = host
+        self.port = port
+        self._exchanges: Dict[str, Set[_Subscriber]] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def __aenter__(self):
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.stop()
+        return False
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        # resolve port 0 -> the bound port, so tests can ask for "any"
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("tcp fanout broker listening on %s:%d",
+                    self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        sub: Optional[_Subscriber] = None
+        sub_exchange: Optional[str] = None
+        drain_task: Optional[asyncio.Task] = None
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    frame = json.loads(line)
+                    op = frame["op"]
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    logger.warning("tcp broker: malformed frame %r",
+                                   line[:100])
+                    continue
+                if op == "pub":
+                    v, ts = frame.get("v"), frame.get("ts")
+                    # validate here: forwarding a malformed frame would
+                    # crash EVERY subscriber's decode loop, not just the
+                    # bad publisher
+                    if not isinstance(v, (int, float)) or \
+                            not isinstance(ts, (int, float)):
+                        logger.warning(
+                            "tcp broker: dropping pub frame with "
+                            "non-numeric v/ts: %r", line[:100],
+                        )
+                        continue
+                    out = json.dumps({"v": v, "ts": ts}).encode() + b"\n"
+                    for s in self._exchanges.get(frame.get("exchange"),
+                                                 ()):  # fanout
+                        s.offer(out)
+                elif op == "sub" and sub is None:
+                    sub = _Subscriber(writer)
+                    sub_exchange = frame.get("exchange")
+                    self._exchanges.setdefault(sub_exchange, set()).add(sub)
+                    drain_task = asyncio.create_task(sub.drain())
+                else:
+                    logger.warning("tcp broker: unexpected op %r", op)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            if sub is not None:
+                self._exchanges.get(sub_exchange, set()).discard(sub)
+            if drain_task is not None:
+                drain_task.cancel()
+                # the drain task may already be DONE with a ConnectionError
+                # (consumer died mid-write) — that must not re-raise here
+                # and skip the writer cleanup below
+                with contextlib.suppress(asyncio.CancelledError,
+                                         ConnectionError):
+                    await drain_task
+            writer.close()
+            with contextlib.suppress(ConnectionError):
+                await writer.wait_closed()
+
+
+class TcpTransport:
+    """Client transport for ``tcp://host:port`` URLs — same interface as
+    LocalTransport/AmqpTransport (runtime/broker.py), so the apps'
+    forever-retry wrappers give the same broker-outage resilience the
+    reference gets from aio-pika reconnects (metersim.py:13, pvsim.py:43):
+    a dropped connection raises out of publish/subscribe and the app
+    reconnects with backoff."""
+
+    def __init__(self, url: str, exchange: str):
+        parsed = urlparse(url)
+        if parsed.scheme != "tcp":
+            raise ValueError(f"TcpTransport needs a tcp:// URL, got {url!r}")
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or 5673
+        self._exchange = exchange
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def __aenter__(self):
+        self._reader, self._writer = await asyncio.open_connection(
+            self._host, self._port
+        )
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._writer is not None:
+            self._writer.close()
+            with contextlib.suppress(ConnectionError):
+                await self._writer.wait_closed()
+        return False
+
+    async def _send(self, frame: dict) -> None:
+        self._writer.write(json.dumps(frame).encode() + b"\n")
+        await self._writer.drain()
+
+    async def publish(self, value: float, time: _dt.datetime) -> None:
+        # shielded like the AMQP path (metersim.py:43-45): a cancellation
+        # mid-publish must not truncate the frame on the wire
+        await asyncio.shield(self._send({
+            "op": "pub", "exchange": self._exchange,
+            "v": value, "ts": time.timestamp(),
+        }))
+
+    async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
+        await self._send({"op": "sub", "exchange": self._exchange})
+        while True:
+            line = await self._reader.readline()
+            if not line:
+                raise ConnectionError("tcp broker closed the connection")
+            frame = json.loads(line)
+            yield (_dt.datetime.fromtimestamp(frame["ts"]), frame["v"])
