@@ -128,11 +128,12 @@ def test_three_process_deployment(tmp_path):
     env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     out = tmp_path / "out.csv"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     broker = subprocess.Popen(
         [sys.executable, "-m", "tmhpvsim_tpu.cli", "fanoutbroker",
          "--port", "0"],
-        env=env, stderr=subprocess.PIPE, text=True, cwd="/root/repo",
+        env=env, stderr=subprocess.PIPE, text=True, cwd=repo,
     )
     try:
         line = broker.stderr.readline()  # "... listening on host:port"
@@ -143,7 +144,7 @@ def test_three_process_deployment(tmp_path):
         consumer = subprocess.Popen(
             [sys.executable, "-m", "tmhpvsim_tpu.cli", "pvsim", str(out),
              "--amqp-url", url, "--no-realtime", "--start", start],
-            env=env, stderr=subprocess.PIPE, text=True, cwd="/root/repo",
+            env=env, stderr=subprocess.PIPE, text=True, cwd=repo,
         )
         try:
             # Fanout delivers only to ALREADY-bound subscribers, and the
@@ -163,7 +164,7 @@ def test_three_process_deployment(tmp_path):
                  "--amqp-url", url, "--no-realtime", "--duration", "40",
                  "--start", start, "--seed", "3"],
                 env=env, capture_output=True, text=True, timeout=120,
-                cwd="/root/repo",
+                cwd=repo,
             )
             assert producer.returncode == 0, producer.stderr
             # let the join drain, then stop the (unbounded) consumer

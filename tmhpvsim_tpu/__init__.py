@@ -30,4 +30,11 @@ Layout (mirrors SURVEY.md section 7's build order):
   data/      vendored distribution shape parameters + PV coefficients
 """
 
-__version__ = "0.1.0"
+def __getattr__(name):
+    # lazy: resolving the version may shell out to git (tmhpvsim_tpu/
+    # _version.py); importing the package must not pay that
+    if name == "__version__":
+        from tmhpvsim_tpu._version import __version__ as v
+
+        return v
+    raise AttributeError(name)

@@ -1,0 +1,75 @@
+"""SCM-derived version without vendored machinery.
+
+The reference ships 2,342 lines of versioneer boilerplate
+(versioneer.py + tmhpvsim/_version.py; setup.cfg:1-6) to derive versions
+from git tags.  Same capability here in a few dozen lines: the installed
+package reports its distribution version; a git checkout derives
+``tag[+N.ghash]`` from ``git describe`` (versioneer's tag-distance-hash
+idea as a PEP 440 local version), falling back to the static base when
+git or tags are absent.  Resolution is LAZY (module ``__getattr__``):
+importing the package never shells out to git — only reading
+``__version__`` does, once.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+
+BASE_VERSION = "0.1.0"
+
+_DESCRIBE_RE = re.compile(
+    r"^v?(?P<tag>.+?)(?:-(?P<n>\d+)-g(?P<hash>[0-9a-f]+))?"
+    r"(?P<dirty>-dirty)?$"
+)
+
+
+def _git_describe() -> str | None:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo, ".git")):
+        return None
+    try:
+        r = subprocess.run(
+            ["git", "-C", repo, "describe", "--tags", "--always", "--dirty"],
+            capture_output=True, text=True, timeout=5,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return r.stdout.strip() or None if r.returncode == 0 else None
+
+
+def get_version() -> str:
+    """Best available version: installed metadata, else parsed
+    ``git describe`` (exact tag -> ``tag``; past a tag ->
+    ``tag+N.ghash``; untagged repo -> ``base+ghash``; ``.dirty``
+    appended when the tree is modified), else the static base."""
+    try:
+        from importlib.metadata import version
+
+        v = version("tmhpvsim-tpu")
+        if v and v != BASE_VERSION:
+            return v
+    except Exception:
+        pass
+    desc = _git_describe()
+    if not desc:
+        return BASE_VERSION
+    m = _DESCRIBE_RE.match(desc)
+    if m is None:
+        return BASE_VERSION
+    dirty = ".dirty" if m.group("dirty") else ""
+    if m.group("hash"):          # tag-N-ghash: commits past a tag
+        return (f"{m.group('tag')}+{m.group('n')}.g{m.group('hash')}"
+                f"{dirty}")
+    if re.fullmatch(r"[0-9a-f]+", m.group("tag")):  # bare hash: no tags
+        return f"{BASE_VERSION}+g{m.group('tag')}{dirty}"
+    return f"{m.group('tag')}{'+' + dirty[1:] if dirty else ''}"
+
+
+def __getattr__(name: str) -> str:
+    if name == "__version__":
+        v = get_version()
+        globals()["__version__"] = v  # cache: resolve once per process
+        return v
+    raise AttributeError(name)

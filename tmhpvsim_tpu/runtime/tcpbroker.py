@@ -28,8 +28,11 @@ Wire protocol (one JSON object per line, UTF-8):
     {"op": "pub", "exchange": E, "v": f, "ts": t} client -> broker
     {"v": f, "ts": t}                             broker -> subscriber
 
-``ts`` is POSIX seconds (float) — the AMQP ``timestamp`` property's wire
-meaning.
+``ts`` is the measurement's NAIVE wall time encoded as seconds since the
+epoch *as if UTC*: the apps join on naive fixedclock datetimes, and
+pinning the wire encoding to UTC makes producer and consumer agree even
+when their hosts run different timezones (a naive ``.timestamp()``
+round-trip would skew by the TZ difference).
 """
 
 from __future__ import annotations
@@ -133,23 +136,31 @@ class TcpFanoutBroker:
                     continue
                 if op == "pub":
                     v, ts = frame.get("v"), frame.get("ts")
+                    exchange = frame.get("exchange")
                     # validate here: forwarding a malformed frame would
                     # crash EVERY subscriber's decode loop, not just the
-                    # bad publisher
+                    # bad publisher (and a non-str exchange would TypeError
+                    # the dict lookup)
                     if not isinstance(v, (int, float)) or \
-                            not isinstance(ts, (int, float)):
+                            not isinstance(ts, (int, float)) or \
+                            not isinstance(exchange, str):
                         logger.warning(
-                            "tcp broker: dropping pub frame with "
-                            "non-numeric v/ts: %r", line[:100],
+                            "tcp broker: dropping malformed pub frame: %r",
+                            line[:100],
                         )
                         continue
                     out = json.dumps({"v": v, "ts": ts}).encode() + b"\n"
-                    for s in self._exchanges.get(frame.get("exchange"),
-                                                 ()):  # fanout
+                    for s in self._exchanges.get(exchange, ()):  # fanout
                         s.offer(out)
                 elif op == "sub" and sub is None:
-                    sub = _Subscriber(writer)
                     sub_exchange = frame.get("exchange")
+                    if not isinstance(sub_exchange, str):
+                        logger.warning(
+                            "tcp broker: dropping malformed sub frame: %r",
+                            line[:100],
+                        )
+                        continue
+                    sub = _Subscriber(writer)
                     self._exchanges.setdefault(sub_exchange, set()).add(sub)
                     drain_task = asyncio.create_task(sub.drain())
                 else:
@@ -208,11 +219,18 @@ class TcpTransport:
         await self._writer.drain()
 
     async def publish(self, value: float, time: _dt.datetime) -> None:
+        # naive wall time -> as-if-UTC epoch (see module docstring: makes
+        # the join timezone-independent across hosts); aware datetimes
+        # keep their real instant
+        if time.tzinfo is None:
+            ts = time.replace(tzinfo=_dt.timezone.utc).timestamp()
+        else:
+            ts = time.timestamp()
         # shielded like the AMQP path (metersim.py:43-45): a cancellation
         # mid-publish must not truncate the frame on the wire
         await asyncio.shield(self._send({
             "op": "pub", "exchange": self._exchange,
-            "v": value, "ts": time.timestamp(),
+            "v": value, "ts": ts,
         }))
 
     async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
@@ -222,4 +240,6 @@ class TcpTransport:
             if not line:
                 raise ConnectionError("tcp broker closed the connection")
             frame = json.loads(line)
-            yield (_dt.datetime.fromtimestamp(frame["ts"]), frame["v"])
+            # inverse of publish: as-if-UTC epoch -> naive wall time
+            t = _dt.datetime.fromtimestamp(frame["ts"], _dt.timezone.utc)
+            yield (t.replace(tzinfo=None), frame["v"])
