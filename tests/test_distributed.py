@@ -241,6 +241,27 @@ assert resumed == ref, (
 # global chain ids: host 0 rows 0-7, host 1 rows 8-15
 first_chain = resumed.splitlines()[1].split(",")[0]
 assert first_chain == ("0" if pid == 0 else "8"), first_chain
+
+# Trace mode kill/resume: --chain is GLOBAL (chain 2 lives on host 0), so
+# only host 0 writes the CSV; host 1 checkpoints state but must resume
+# WITHOUT tripping the CSV exactly-once check on its never-written file.
+tkw = dict(duration_s=240, n_chains=16, seed=5,
+           start="2019-09-05 10:00:00", block_s=60,
+           sharded=True, output="trace", chain=2)
+BlockTimer.tick = tick_bomb
+try:
+    app.pvsim_jax(f"{workdir}/tr.csv", checkpoint=f"{workdir}/tr.npz", **tkw)
+    raise AssertionError("expected the injected crash")
+except Boom:
+    pass
+finally:
+    BlockTimer.tick = real_tick
+app.pvsim_jax(f"{workdir}/tr.csv", checkpoint=f"{workdir}/tr.npz", **tkw)
+if pid == 0:
+    rows = open(f"{workdir}/tr.csv.host0").read().splitlines()
+    assert len(rows) == 1 + 240, len(rows)  # header + every second, once
+else:
+    assert not os.path.exists(f"{workdir}/tr.csv.host1")
 print(f"CKPTOK {pid}", flush=True)
 """
 

@@ -104,6 +104,32 @@ class TestFanoutSemantics:
 
         assert _run(main())
 
+    def test_stop_with_live_clients_does_not_hang(self):
+        """Broker shutdown while a subscriber is still connected must
+        return promptly: since Python 3.12.1, Server.wait_closed() also
+        waits for connection handlers, so stop() has to disconnect live
+        clients itself or it deadlocks behind a parked readline()."""
+
+        async def main():
+            broker = TcpFanoutBroker(port=0)
+            await broker.start()
+            url = f"tcp://127.0.0.1:{broker.port}"
+
+            async def consume():
+                async with TcpTransport(url, "meter") as t:
+                    async for _ in t.subscribe():
+                        pass
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.1)  # subscriber bound and parked
+            await asyncio.wait_for(broker.stop(), timeout=5)
+            with pytest.raises((ConnectionError, asyncio.IncompleteReadError,
+                                OSError)):
+                await asyncio.wait_for(task, timeout=5)
+            return True
+
+        assert _run(main())
+
     def test_connection_error_raises_for_retry(self):
         """A dead broker must raise out of the transport so the apps'
         forever-retry reconnect loop engages (runtime/retry.py)."""
@@ -124,7 +150,13 @@ class TestFanoutSemantics:
 def test_three_process_deployment(tmp_path):
     """The reference's README deployment, with the in-tree broker instead
     of RabbitMQ: broker, metersim and pvsim as three OS processes joined
-    only by TCP.  The consumer's CSV must contain joined rows."""
+    only by TCP.  The consumer's CSV must contain joined rows.
+
+    Producer and consumer run under DIFFERENT host timezones: the wire
+    protocol carries naive wall time as as-if-UTC epochs
+    (runtime/tcpbroker.py), so the timestamp join must be host-TZ
+    independent — a naive .timestamp() round-trip would skew the streams
+    by 6 hours here and join nothing."""
     env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     out = tmp_path / "out.csv"
@@ -144,7 +176,8 @@ def test_three_process_deployment(tmp_path):
         consumer = subprocess.Popen(
             [sys.executable, "-m", "tmhpvsim_tpu.cli", "pvsim", str(out),
              "--amqp-url", url, "--no-realtime", "--start", start],
-            env=env, stderr=subprocess.PIPE, text=True, cwd=repo,
+            env=dict(env, TZ="America/Chicago"), stderr=subprocess.PIPE,
+            text=True, cwd=repo,
         )
         try:
             # Fanout delivers only to ALREADY-bound subscribers, and the
@@ -163,8 +196,8 @@ def test_three_process_deployment(tmp_path):
                 [sys.executable, "-m", "tmhpvsim_tpu.cli", "metersim",
                  "--amqp-url", url, "--no-realtime", "--duration", "40",
                  "--start", start, "--seed", "3"],
-                env=env, capture_output=True, text=True, timeout=120,
-                cwd=repo,
+                env=dict(env, TZ="UTC"), capture_output=True, text=True,
+                timeout=120, cwd=repo,
             )
             assert producer.returncode == 0, producer.stderr
             # let the join drain, then stop the (unbounded) consumer

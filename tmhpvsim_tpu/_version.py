@@ -27,7 +27,9 @@ _DESCRIBE_RE = re.compile(
 
 def _git_describe() -> str | None:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if not os.path.isdir(os.path.join(repo, ".git")):
+    # exists, not isdir: in worktrees/submodules .git is a FILE pointing
+    # at the real gitdir (git -C handles both)
+    if not os.path.exists(os.path.join(repo, ".git")):
         return None
     try:
         r = subprocess.run(

@@ -300,15 +300,20 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         # written" and "checkpoint for b saved", leaving extra rows from
         # block start_block in the file.  Truncate back to the checkpoint —
         # and refuse to resume against a missing/short CSV (appending there
-        # would silently fabricate a gap-ridden headerless file).
-        expect = 1 + min(cfg.duration_s, start_block * cfg.block_s)
-        got = _truncate_csv(file, expect)
-        if got < expect:
-            raise RuntimeError(
-                f"checkpoint {checkpoint} expects {expect} existing lines "
-                f"in {file} but found {got}; restore the CSV that belongs "
-                f"to this checkpoint or delete the checkpoint to restart"
-            )
+        # would silently fabricate a gap-ridden headerless file).  Gated on
+        # write_trace: a pod-slice host that does not own --chain
+        # checkpoints state but never writes a CSV, so there is nothing to
+        # reconcile there.
+        if write_trace:
+            expect = 1 + min(cfg.duration_s, start_block * cfg.block_s)
+            got = _truncate_csv(file, expect)
+            if got < expect:
+                raise RuntimeError(
+                    f"checkpoint {checkpoint} expects {expect} existing "
+                    f"lines in {file} but found {got}; restore the CSV "
+                    f"that belongs to this checkpoint or delete the "
+                    f"checkpoint to restart"
+                )
 
     timer = BlockTimer(cfg.n_chains, cfg.block_s)
     runner = sim.run_ensemble if output == "ensemble" else sim.run_blocks

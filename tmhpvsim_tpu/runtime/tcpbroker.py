@@ -87,6 +87,11 @@ class TcpFanoutBroker:
         self.port = port
         self._exchanges: Dict[str, Set[_Subscriber]] = {}
         self._server: Optional[asyncio.base_events.Server] = None
+        #: writers of ALL live connections (not just subscribers): since
+        #: Python 3.12.1 Server.wait_closed() also waits for connection
+        #: handlers, so stop() must actively disconnect clients or it
+        #: deadlocks behind a handler parked in readline()
+        self._conn_writers: Set[asyncio.StreamWriter] = set()
 
     async def __aenter__(self):
         await self.start()
@@ -108,6 +113,8 @@ class TcpFanoutBroker:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            for w in list(self._conn_writers):  # see _conn_writers note
+                w.close()
             await self._server.wait_closed()
             self._server = None
 
@@ -122,6 +129,7 @@ class TcpFanoutBroker:
         sub: Optional[_Subscriber] = None
         sub_exchange: Optional[str] = None
         drain_task: Optional[asyncio.Task] = None
+        self._conn_writers.add(writer)
         try:
             while True:
                 line = await reader.readline()
@@ -168,6 +176,7 @@ class TcpFanoutBroker:
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
+            self._conn_writers.discard(writer)
             if sub is not None:
                 self._exchanges.get(sub_exchange, set()).discard(sub)
             if drain_task is not None:
