@@ -165,6 +165,22 @@ def test_scan_impl_matches_wide_site_grid():
                                    err_msg=k)
 
 
+def test_ensemble_scan_matches_wide(run):
+    """Ensemble mode's scan-fused series formulation must yield the same
+    fleet-mean stream as the wide formulation (same RNG streams; float
+    reassociation only — the per-second sum order differs)."""
+    wide = list(Simulation(small_config(block_impl="wide")).run_ensemble())
+    scan = list(Simulation(small_config(block_impl="scan")).run_ensemble())
+    assert len(wide) == len(scan)
+    for w, s in zip(wide, scan):
+        assert s.meter.shape == w.meter.shape
+        np.testing.assert_array_equal(s.epoch, w.epoch)
+        np.testing.assert_allclose(s.meter, w.meter, rtol=2e-5, atol=1e-2)
+        np.testing.assert_allclose(s.pv, w.pv, rtol=2e-5, atol=1e-2)
+        np.testing.assert_allclose(s.residual, w.residual, rtol=2e-5,
+                                   atol=1e-2)
+
+
 def test_fused_stats_topology_matches_split(run):
     """SimConfig.stats_fusion='fused' (one producer+stats+merge jit, the
     TPU reduce-mode topology) must produce the same per-chain statistics

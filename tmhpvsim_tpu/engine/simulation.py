@@ -155,6 +155,8 @@ class Simulation:
         #: the carry — the TPU formulation (the wide one is HBM-bound)
         self._scan_acc_jit = jax.jit(self._block_step_scan_acc,
                                      donate_argnums=(0, 2))
+        self._scan_series_jit = jax.jit(self._block_step_scan_series,
+                                        donate_argnums=0)
         if config.stats_fusion == "auto":
             self._use_fused = jax.default_backend() != "cpu"
         elif config.stats_fusion in ("fused", "split"):
@@ -489,17 +491,29 @@ class Simulation:
         works unchanged; only (block_s,) vectors ever reach the host, so
         this scales to the 100k-1M chain configs like reduce mode while
         still producing the reference's row-per-second CSV shape.
+
+        Two formulations, like reduce mode: the wide producer + psum
+        consumer, or (``block_impl='scan'``, the accelerator default) the
+        scan-fused series step that sums across chains inside the scan
+        body and never materialises (n_chains, block_s) arrays.
         """
         inv_n = 1.0 / self.config.n_chains
+        use_scan = self._use_scan
 
-        def make(off, epoch, meter, pv, n_valid):
-            m_sum, p_sum = self._series_jit(meter, pv)
+        def make(off, epoch, a, b, n_valid):
+            # wide path: (a, b) are the (n_chains, block_s) meter/pv
+            # arrays, reduced by the series jit; scan path: they already
+            # ARE the per-second fleet sums straight from the series step
+            m_sum, p_sum = (a, b) if use_scan else self._series_jit(a, b)
             m = self._repl_view(m_sum)[None, :n_valid] * inv_n
             p = self._repl_view(p_sum)[None, :n_valid] * inv_n
             return BlockResult(offset=off, epoch=epoch, meter=m, pv=p,
                                residual=m - p)
 
-        return self._iter_blocks(state, start_block, make)
+        return self._iter_blocks(
+            state, start_block, make,
+            block_jit=self._scan_series_jit if use_scan else None,
+        )
 
     @staticmethod
     def _repl_view(arr) -> np.ndarray:
@@ -552,19 +566,12 @@ class Simulation:
         acc = self._block_stats_acc(meter, pv, inputs["block_idx"]["t"], acc)
         return state, acc
 
-    def _block_step_scan_acc(self, state, inputs, acc):
-        """Scan-fused reduce-mode block (SimConfig.block_impl='scan').
-
-        One ``lax.scan`` over the block's seconds; each step runs the FULL
-        pipeline — sampler interpolation, renewal, PV physics, meter,
-        statistics fold — on (n_chains,) vectors, with the running
-        statistics carried alongside the renewal state.  Nothing of shape
-        (n_chains, block_s) is ever materialised except the three
-        pre-drawn RNG streams (whose values are bit-identical to the wide
-        path's, models/clearsky_index.py scan_draws_tmajor), which is what
-        removes the wide formulation's ~20 HBM-round-tripped
-        intermediates (measured bandwidth-bound on TPU v5e).
-        """
+    def _scan_block_setup(self, state, inputs):
+        """Shared preamble of the scan-fused paths (traced): windows,
+        value-major tables, pre-drawn time-major RNG streams, geometry
+        routing.  Returns (xs, step, cc_carry) where ``step(rc, x) ->
+        (rc', meter, ac)`` runs one second of the full pipeline on
+        (n_chains,) vectors."""
         cfg = self.config
         dtype = self.dtype
         opts = cfg.options
@@ -604,7 +611,6 @@ class Simulation:
             geom_const = {k: v for k, v in shared_geom.items()
                           if k not in geom_xs}
 
-        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
         xs = {
             "t": t,
             "h": bi["hour_idx"], "d": bi["day_idx"],
@@ -614,8 +620,7 @@ class Simulation:
             "geom": geom_xs,
         }
 
-        def body(carry, x):
-            rc, st = carry
+        def step(rc, x):
             rc, csi, _covered = ci.csi_compose_step(
                 tables, x, rc, opts, dtype
             )
@@ -635,7 +640,32 @@ class Simulation:
             ac = pvmod.power_from_csi(
                 csi, g, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
             ).astype(dtype)
-            meter = x["meter"].astype(dtype)
+            return rc, x["meter"].astype(dtype), ac
+
+        return xs, step, cc_carry
+
+    def _block_step_scan_acc(self, state, inputs, acc):
+        """Scan-fused reduce-mode block (SimConfig.block_impl='scan').
+
+        One ``lax.scan`` over the block's seconds; each step runs the FULL
+        pipeline — sampler interpolation, renewal, PV physics, meter,
+        statistics fold — on (n_chains,) vectors, with the running
+        statistics carried alongside the renewal state.  Nothing of shape
+        (n_chains, block_s) is ever materialised except the three
+        pre-drawn RNG streams (whose values are bit-identical to the wide
+        path's, models/clearsky_index.py scan_draws_tmajor), which is what
+        removes the wide formulation's ~20 HBM-round-tripped
+        intermediates (measured bandwidth-bound on TPU v5e;
+        benchmarks/PERF_ANALYSIS.md).
+        """
+        cfg = self.config
+        dtype = self.dtype
+        xs, step, cc_carry = self._scan_block_setup(state, inputs)
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+
+        def body(carry, x):
+            rc, st = carry
+            rc, meter, ac = step(rc, x)
             residual = meter - ac
             valid = x["t"] < cfg.duration_s      # scalar: padding mask
             vz = jnp.where(valid, 1.0, 0.0).astype(dtype)
@@ -658,6 +688,24 @@ class Simulation:
         )
         return dict(state, carry=rcarry, cc_carry=cc_carry), acc
 
+    def _block_step_scan_series(self, state, inputs):
+        """Scan-fused ensemble-mode block: same pipeline as
+        ``_block_step_scan_acc`` but the per-step output is the local
+        cross-chain SUM of meter and pv — (block_s,) vectors, so the
+        fleet-mean stream scales exactly like reduce mode.  Returns
+        (state', meter_sum, pv_sum); the sharded wrapper psums the sums
+        over the mesh once per block."""
+        xs, step, cc_carry = self._scan_block_setup(state, inputs)
+
+        def body(rc, x):
+            rc, meter, ac = step(rc, x)
+            return rc, (meter.sum(), ac.sum())
+
+        rcarry, (m_sum, p_sum) = jax.lax.scan(
+            body, state["carry"], xs, unroll=self.config.scan_unroll
+        )
+        return dict(state, carry=rcarry, cc_carry=cc_carry), m_sum, p_sum
+
     def step_acc(self, state, inputs, acc):
         """One reduce-mode block folded into the on-device accumulator."""
         if self._use_scan:
@@ -672,23 +720,26 @@ class Simulation:
     # run loops
     # ------------------------------------------------------------------
 
-    def _iter_blocks(self, state, start_block: int, make_result
-                     ) -> Iterator[BlockResult]:
+    def _iter_blocks(self, state, start_block: int, make_result,
+                     block_jit=None) -> Iterator[BlockResult]:
         """THE per-block loop, shared by every trace-shaped mode (single
-        and sharded run_blocks, run_ensemble): init/place state, run the
-        producer jit, trim grid padding, delegate the gather to
-        ``make_result(off, epoch, meter, pv, n_valid)``."""
+        and sharded run_blocks, run_ensemble in both formulations):
+        init/place state, run the producer jit — ``block_jit`` overrides
+        the default wide producer, any (state, inputs) -> (state, a, b)
+        jit fits — trim grid padding, delegate the gather to
+        ``make_result(off, epoch, a, b, n_valid)``."""
         cfg = self.config
+        jit = self._block_jit if block_jit is None else block_jit
         state = self.init_state() if state is None \
             else self._place_resume(state)
         self.state = state
         for bi in range(start_block, self.n_blocks):
             inputs, epoch = self.host_inputs(bi)
-            self.state, meter, pv = self._block_jit(self.state, inputs)
+            self.state, a, b = jit(self.state, inputs)
             off = bi * cfg.block_s
             n_valid = min(cfg.block_s, cfg.duration_s - off)
             yield make_result(off, np.asarray(epoch[:n_valid]),
-                              meter, pv, n_valid)
+                              a, b, n_valid)
 
     def _trace_result(self, off, epoch, meter, pv, n_valid) -> BlockResult:
         """Per-chain gather: the trace-mode ``make_result``."""

@@ -100,6 +100,7 @@ class ShardedSimulation(Simulation):
         self._stats_acc_jit = self._sharded_stats_acc
         self._fused_acc_jit = self._build_sharded_fused_acc()
         self._scan_acc_jit = self._build_sharded_scan_acc()
+        self._scan_series_jit = self._build_sharded_scan_series()
         self._series_jit = self._trace_ensemble
 
     def init_state(self):
@@ -163,6 +164,24 @@ class ShardedSimulation(Simulation):
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 2))
+
+    def _build_sharded_scan_series(self):
+        """Ensemble mode's scan-fused step under shard_map: each shard
+        scans its chains and emits LOCAL per-second sums; one psum pair
+        per block replicates the fleet totals — the same single
+        collective per block as the wide ensemble path."""
+        def fn(state, inputs):
+            state, m_sum, p_sum = self._block_step_scan_series(state, inputs)
+            return (state, jax.lax.psum(m_sum, CHAIN_AXIS),
+                    jax.lax.psum(p_sum, CHAIN_AXIS))
+
+        mapped = shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(P(CHAIN_AXIS), P()),
+            out_specs=(P(CHAIN_AXIS), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=0)
 
     def _build_trace_ensemble(self):
         """Trace/ensemble-mode consumer: per-second sums of meter and pv
