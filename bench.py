@@ -255,6 +255,13 @@ def _roofline(cost: dict, block_wall_s: float, n_chains: int,
     return out
 
 
+
+def _impl_label(sim) -> str:
+    """The block topology a Simulation will actually run (resolved from
+    'auto') — echoed into every artifact so labels never lie."""
+    return ("scan" if sim._use_scan
+            else "fused" if sim._use_fused else "split")
+
 NORTH_STAR = 100_000 * 365.25 * 86400 / 60.0 / 8.0  # site-s/s/chip
 REF_CEILING = 100.0  # simulated s/s/process, reference --no-realtime
 
@@ -298,8 +305,7 @@ def headline() -> None:
                 # the RESOLVED topology ('auto' depends on the backend; on
                 # the cpu-fallback a 'scan-*' label would otherwise
                 # misdocument a wide run)
-                "impl": ("scan" if sim._use_scan
-                         else "fused" if sim._use_fused else "split"),
+                "impl": _impl_label(sim),
             }
             sims[name] = (sim, dt)
         except Exception as e:
@@ -405,8 +411,7 @@ def _reduce_config_run(label: str, cfg, sharded: bool, note: str,
         "echo": {
             "n_chains": cfg.n_chains, "duration_s": cfg.duration_s,
             "block_s": cfg.block_s, "prng_impl": cfg.prng_impl,
-            "block_impl": ("scan" if sim._use_scan
-                           else "fused" if sim._use_fused else "split"),
+            "block_impl": _impl_label(sim),
             "site_grid": cfg.site_grid is not None,
             "start": cfg.start, "seed": cfg.seed,
         },
@@ -605,6 +610,47 @@ def scaling() -> None:
     }))
 
 
+def sweep() -> None:
+    """Tuning matrix: one JSON line per (impl, prng, unroll, shape)
+    variant — the measurement driver behind PERF_ANALYSIS.md."""
+    platform, fallback = _probe_or_fallback()
+    from tmhpvsim_tpu.engine import Simulation
+
+    # scale down on anything that is not real TPU hardware (including an
+    # env-pinned CPU backend, where the probe "succeeds" on CPU)
+    scale = 1 if platform == "tpu" else 256
+    variants = [
+        ("scan-rbg-u8", 65536, 1080, "rbg", "scan", 8),
+        ("scan-rbg-u4", 65536, 1080, "rbg", "scan", 4),
+        ("scan-rbg-u16", 65536, 1080, "rbg", "scan", 16),
+        ("scan-threefry-u8", 65536, 1080, "threefry2x32", "scan", 8),
+        ("wide-rbg", 65536, 1080, "rbg", "wide", 8),
+        ("scan-rbg-u8-big", 65536, 4320, "rbg", "scan", 8),
+        ("scan-rbg-u8-x4chains", 262144, 1080, "rbg", "scan", 8),
+    ]
+    n_blocks, n_rounds = (2, 1) if fallback else (4, 3)
+    for label, n, bs, prng, impl, unroll in variants:
+        try:
+            cfg = _make_cfg(max(n // scale, 8),
+                            n_blocks * n_rounds + 1, block_s=bs,
+                            prng_impl=prng, block_impl=impl,
+                            scan_unroll=unroll)
+            sim = Simulation(cfg)
+            c_s, dt, rate = _timed_reduce_run(sim, n_blocks, n_rounds)
+            cost = _hot_jit_cost(sim)
+            print(json.dumps({
+                "label": label, "platform": platform,
+                "rate": round(rate, 1), "compile_s": round(c_s, 1),
+                "best_round_wall_s": round(dt, 3),
+                "impl": _impl_label(sim),
+                "n_chains": cfg.n_chains, "block_s": bs, "unroll": unroll,
+                **cost,
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({"label": label, "error": str(e)[:200]}),
+                  flush=True)
+
+
 def profile(out_dir: str) -> None:
     """Capture a jax.profiler trace of steady headline blocks."""
     platform, fallback = _probe_or_fallback()
@@ -624,6 +670,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=range(1, 6))
     ap.add_argument("--scaling", action="store_true")
+    ap.add_argument("--sweep", action="store_true")
     ap.add_argument("--profile", metavar="DIR")
     args = ap.parse_args()
     if args.config:
@@ -631,6 +678,8 @@ def main() -> None:
          5: config_5}[args.config]()
     elif args.scaling:
         scaling()
+    elif args.sweep:
+        sweep()
     elif args.profile:
         profile(args.profile)
     else:

@@ -48,8 +48,8 @@ class TestFunnelProperties:
         """For ANY put order and cap: (1) the pending cache never exceeds
         the cap, (2) every emitted record is complete, (3) the age heap
         always covers the live cache (the lazy-deletion invariant that
-        makes eviction pop-safe), and (4) cache+emitted+evicted accounts
-        for every distinct timestamp."""
+        makes eviction pop-safe), (4) no timestamp is invented, and (5)
+        heap bloat stays under the compaction bound."""
         emitted, f = _drive_funnel(events, max_pending)
         assert len(f._cache) <= max_pending
         assert set(f._cache) <= set(f._age_heap)
