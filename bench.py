@@ -266,16 +266,116 @@ NORTH_STAR = 100_000 * 365.25 * 86400 / 60.0 / 8.0  # site-s/s/chip
 REF_CEILING = 100.0  # simulated s/s/process, reference --no-realtime
 
 
+#: the headline's variant matrix: the headline is the best documented
+#: mode; the others are reported so the artifact shows WHY it won.
+VARIANT_CFGS = {
+    "scan-rbg": dict(prng_impl="rbg", block_impl="auto"),
+    "scan-threefry": dict(prng_impl="threefry2x32", block_impl="auto"),
+    "wide-rbg": dict(prng_impl="rbg", block_impl="wide",
+                     stats_fusion="fused"),
+}
+
+#: deadline for the TPU variants phase; past it the watchdog salvages a
+#: CPU number in a fresh subprocess and hard-exits — covering the
+#: tunnel's HANGING failure mode (the erroring mode is handled in-line)
+TPU_VARIANTS_DEADLINE_S = 900.0
+
+
+def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
+                  note: str = "") -> tuple[dict, dict]:
+    """Measure the variant matrix once; returns (variants, sims)."""
+    from tmhpvsim_tpu.engine import Simulation
+
+    n_total = n_blocks * n_rounds + 1
+    variants, sims = {}, {}
+    for name, kw in VARIANT_CFGS.items():
+        try:
+            sim = Simulation(_make_cfg(n_chains, n_total, **kw))
+            c_s, dt, rate = _timed_reduce_run(sim, n_blocks, n_rounds)
+            variants[name] = {
+                "rate": round(rate, 1), "compile_s": round(c_s, 1),
+                "best_round_wall_s": round(dt, 2),
+                # the RESOLVED topology ('auto' depends on the backend; on
+                # a CPU run a 'scan-*' label would otherwise misdocument a
+                # wide run)
+                "impl": _impl_label(sim),
+            }
+            sims[name] = (sim, dt)
+        except Exception as e:
+            print(f"# variant {name} failed{note}: {e}", file=sys.stderr)
+            variants[name] = {"error": str(e)[:200]}
+    return variants, sims
+
+
+def _salvage_cpu_headline(tpu_errors=None, timeout_s: float = 900.0) -> bool:
+    """Re-run the headline scaled on CPU in a FRESH subprocess and print
+    its JSON (with the TPU failure records attached).
+
+    A fresh process is mandatory: once this process has initialised the
+    TPU backend, jax 0.9 caches the backend registry and
+    ``jax.config.update('jax_platforms', 'cpu')`` no longer switches —
+    an in-process "CPU" rerun would silently re-measure the broken TPU.
+    Returns True if a salvage line was printed."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    lines = [ln for ln in (r.stdout or "").splitlines() if ln.strip()]
+    if r.returncode != 0 or not lines:
+        return False
+    try:
+        doc = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return False
+    doc["platform"] = "cpu-fallback"
+    doc["salvaged_after_tpu_failure"] = True
+    if tpu_errors is not None:
+        doc["tpu_errors"] = tpu_errors
+    print(json.dumps(doc))
+    return True
+
+
 def headline() -> None:
     platform, fallback = _probe_or_fallback()
     import jax
 
-    if fallback:
-        n_chains, n_blocks, n_rounds = CPU_N_CHAINS, CPU_N_BLOCKS, 1
-    else:
+    if platform == "tpu":
         n_chains, n_blocks, n_rounds = N_CHAINS, N_BLOCKS, N_ROUNDS
+        # watchdog for the hanging failure mode: if the variants phase
+        # wedges (block_until_ready on a dead tunnel never returns), a
+        # daemon timer salvages a CPU number and hard-exits with rc=0
+        # instead of the harness recording rc=124 and nothing else
+        import threading
 
-    from tmhpvsim_tpu.engine import Simulation
+        def _wedged():
+            print("# TPU variants phase exceeded deadline; salvaging CPU "
+                  "number", file=sys.stderr)
+            if not _salvage_cpu_headline(
+                    {"error": "TPU variants phase hung past deadline"}):
+                print(json.dumps({
+                    "metric": "simulated site-seconds/sec/chip",
+                    "value": 0.0, "unit": "site-s/s/chip",
+                    "vs_baseline": 0.0, "platform": "tpu-hung",
+                    "error": "TPU hung and CPU salvage failed",
+                }))
+            os._exit(0)
+
+        watchdog = threading.Timer(TPU_VARIANTS_DEADLINE_S, _wedged)
+        watchdog.daemon = True
+        watchdog.start()
+    else:
+        # scaled-down run for ANY non-TPU platform — including an
+        # env-pinned CPU backend where the probe "succeeds" on cpu: a
+        # full-size CPU run would blow the harness timeout and record
+        # nothing at all (the round-1 failure mode)
+        n_chains, n_blocks, n_rounds = CPU_N_CHAINS, CPU_N_BLOCKS, 1
+        watchdog = None
+
     from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
     from tmhpvsim_tpu.parallel.distributed import initialize_from_env
 
@@ -285,34 +385,19 @@ def headline() -> None:
         print(f"# jax.distributed init skipped: {e}", file=sys.stderr)
 
     n_total = n_blocks * n_rounds + 1
-
-    # --- variant matrix: the headline is the best documented mode; the
-    # others are reported so the artifact shows WHY it is the headline.
-    variant_cfgs = {
-        "scan-rbg": dict(prng_impl="rbg", block_impl="auto"),
-        "scan-threefry": dict(prng_impl="threefry2x32", block_impl="auto"),
-        "wide-rbg": dict(prng_impl="rbg", block_impl="wide",
-                         stats_fusion="fused"),
-    }
-    variants, sims = {}, {}
-    for name, kw in variant_cfgs.items():
-        try:
-            sim = Simulation(_make_cfg(n_chains, n_total, **kw))
-            c_s, dt, rate = _timed_reduce_run(sim, n_blocks, n_rounds)
-            variants[name] = {
-                "rate": round(rate, 1), "compile_s": round(c_s, 1),
-                "best_round_wall_s": round(dt, 2),
-                # the RESOLVED topology ('auto' depends on the backend; on
-                # the cpu-fallback a 'scan-*' label would otherwise
-                # misdocument a wide run)
-                "impl": _impl_label(sim),
-            }
-            sims[name] = (sim, dt)
-        except Exception as e:
-            print(f"# variant {name} failed: {e}", file=sys.stderr)
-            variants[name] = {"error": str(e)[:200]}
+    variants, sims = _run_variants(n_chains, n_blocks, n_rounds)
+    if watchdog is not None:
+        watchdog.cancel()
 
     ok = {k: v for k, v in variants.items() if "rate" in v}
+    if not ok and not fallback:
+        # the tunnel passed the probe but then ERRORED during the
+        # variants: salvage a labelled CPU number in a fresh process
+        # (see _salvage_cpu_headline on why in-process won't work)
+        print("# all TPU variants failed; salvaging CPU number",
+              file=sys.stderr)
+        if _salvage_cpu_headline(variants):
+            return
     if not ok:
         print(json.dumps({"metric": "simulated site-seconds/sec/chip",
                           "value": 0.0, "unit": "site-s/s/chip",
@@ -484,7 +569,7 @@ def config_2() -> None:
     """1k chains x 1 site, 1 year @ 1 Hz, single chip."""
     platform, fallback = _probe_or_fallback()
     year = 365 * 86_400
-    if fallback:
+    if platform != "tpu":
         cfg = _make_cfg(1000, 4, block_s=8640)
         note = "cpu-fallback: duration scaled to 4 blocks"
         scaled = "1000 chains x 1 year"
@@ -503,7 +588,7 @@ def config_3() -> None:
     platform, fallback = _probe_or_fallback()
     grid = SiteGrid.regular((45.0, 55.0), (5.0, 15.0), 100, 100)
     year = 365 * 86_400
-    if fallback:
+    if platform != "tpu":
         cfg = _make_cfg(len(grid), 2, block_s=4320, site_grid=grid)
         note = "cpu-fallback: duration scaled to 2 blocks"
         scaled = "10k sites x 1 year"
@@ -520,7 +605,7 @@ def config_3() -> None:
 def config_4() -> None:
     """100k chains, per-second, sharded over the available mesh."""
     platform, fallback = _probe_or_fallback()
-    if fallback:
+    if platform != "tpu":
         cfg = _make_cfg(100_000 // 125, 3, block_s=1080)
         note = "cpu-fallback: 800 chains x 3 blocks"
         scaled = "100k chains x 1 day"
@@ -628,7 +713,7 @@ def sweep() -> None:
         ("scan-rbg-u8-big", 65536, 4320, "rbg", "scan", 8),
         ("scan-rbg-u8-x4chains", 262144, 1080, "rbg", "scan", 8),
     ]
-    n_blocks, n_rounds = (2, 1) if fallback else (4, 3)
+    n_blocks, n_rounds = (4, 3) if platform == "tpu" else (2, 1)
     for label, n, bs, prng, impl, unroll in variants:
         try:
             cfg = _make_cfg(max(n // scale, 8),
@@ -654,7 +739,7 @@ def sweep() -> None:
 def profile(out_dir: str) -> None:
     """Capture a jax.profiler trace of steady headline blocks."""
     platform, fallback = _probe_or_fallback()
-    n_chains = CPU_N_CHAINS if fallback else N_CHAINS
+    n_chains = N_CHAINS if platform == "tpu" else CPU_N_CHAINS
     from tmhpvsim_tpu.engine import Simulation
 
     sim = Simulation(_make_cfg(n_chains, 4))
