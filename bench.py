@@ -205,7 +205,9 @@ def _hot_jit_cost(sim) -> dict:
         sim.state = sim.init_state()
         acc = sim.init_reduce_acc()
         inputs, _ = sim.host_inputs(0)
-        if getattr(sim, "_use_scan", False):
+        if getattr(sim, "_impl", None) == "scan2":
+            jf, args = sim._scan2_acc_jit, (sim.state, inputs, acc)
+        elif getattr(sim, "_use_scan", False):
             jf, args = sim._scan_acc_jit, (sim.state, inputs, acc)
         elif getattr(sim, "_use_fused", False):
             jf, args = sim._fused_acc_jit, (sim.state, inputs, acc)
@@ -259,8 +261,9 @@ def _roofline(cost: dict, block_wall_s: float, n_chains: int,
 def _impl_label(sim) -> str:
     """The block topology a Simulation will actually run (resolved from
     'auto') — echoed into every artifact so labels never lie."""
-    return ("scan" if sim._use_scan
-            else "fused" if sim._use_fused else "split")
+    if sim._impl in ("scan", "scan2"):
+        return sim._impl
+    return "fused" if sim._use_fused else "split"
 
 NORTH_STAR = 100_000 * 365.25 * 86400 / 60.0 / 8.0  # site-s/s/chip
 REF_CEILING = 100.0  # simulated s/s/process, reference --no-realtime
@@ -706,6 +709,7 @@ def sweep() -> None:
     scale = 1 if platform == "tpu" else 256
     variants = [
         ("scan-rbg-u8", 65536, 1080, "rbg", "scan", 8),
+        ("scan2-rbg-u8", 65536, 1080, "rbg", "scan2", 8),
         ("scan-rbg-u4", 65536, 1080, "rbg", "scan", 4),
         ("scan-rbg-u16", 65536, 1080, "rbg", "scan", 16),
         ("scan-threefry-u8", 65536, 1080, "threefry2x32", "scan", 8),

@@ -181,6 +181,18 @@ def test_ensemble_scan_matches_wide(run):
                                    atol=1e-2)
 
 
+def test_scan2_impl_matches_scan(run):
+    """block_impl='scan2' (nested: per-minute RNG tiles drawn inside the
+    outer scan) must reproduce 'scan' — the draws are the same keyed
+    slots, so only compiler reassociation may differ."""
+    scan = Simulation(small_config(block_impl="scan")).run_reduced()
+    scan2 = Simulation(small_config(block_impl="scan2")).run_reduced()
+    np.testing.assert_array_equal(scan2["n_seconds"], scan["n_seconds"])
+    for k in scan:
+        np.testing.assert_allclose(scan2[k], scan[k], rtol=2e-6, atol=1e-3,
+                                   err_msg=k)
+
+
 def test_fused_stats_topology_matches_split(run):
     """SimConfig.stats_fusion='fused' (one producer+stats+merge jit, the
     TPU reduce-mode topology) must produce the same per-chain statistics

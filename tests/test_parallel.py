@@ -138,7 +138,8 @@ class TestShardedReduce:
     @pytest.mark.parametrize("variant", [
         dict(stats_fusion="fused"),
         dict(block_impl="scan"),
-    ], ids=["fused", "scan"])
+        dict(block_impl="scan2"),
+    ], ids=["fused", "scan", "scan2"])
     def test_alt_topologies_match_split(self, variant):
         """The fused and scan-fused reduce topologies under shard_map must
         match the default split/wide one — same statistics, still

@@ -235,8 +235,13 @@ class SimConfig:
     #: vectors — nothing of shape (n_chains, block_s) is materialised
     #: except the three pre-drawn RNG streams, cutting HBM traffic ~20x.
     #: Identical RNG streams, so both produce the same simulation up to
-    #: float reassociation (tested).  'auto': scan on accelerators, wide
-    #: on CPU.  Applies to reduce mode; trace/ensemble modes need the wide
+    #: float reassociation (tested).  'scan2' nests the scan per minute,
+    #: drawing each minute's RNG tile inside the outer body so even the
+    #: pre-drawn streams never materialise at (n_chains, block_s) —
+    #: bit-identical draws, opt-in until validated on TPU hardware
+    #: (benchmarks/PERF_ANALYSIS.md §4a).  'auto': scan on accelerators,
+    #: wide on CPU.  Applies to reduce mode (ensemble uses the scan
+    #: series step for either scan impl); trace mode needs the wide
     #: arrays anyway.
     block_impl: str = "auto"
 

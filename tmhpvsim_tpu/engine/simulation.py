@@ -155,6 +155,8 @@ class Simulation:
         #: the carry — the TPU formulation (the wide one is HBM-bound)
         self._scan_acc_jit = jax.jit(self._block_step_scan_acc,
                                      donate_argnums=(0, 2))
+        self._scan2_acc_jit = jax.jit(self._block_step_scan2_acc,
+                                      donate_argnums=(0, 2))
         self._scan_series_jit = jax.jit(self._block_step_scan_series,
                                         donate_argnums=0)
         if config.stats_fusion == "auto":
@@ -167,14 +169,17 @@ class Simulation:
                 f"got {config.stats_fusion!r}"
             )
         if config.block_impl == "auto":
-            self._use_scan = jax.default_backend() != "cpu"
-        elif config.block_impl in ("wide", "scan"):
-            self._use_scan = config.block_impl == "scan"
+            self._impl = "scan" if jax.default_backend() != "cpu" \
+                else "wide"
+        elif config.block_impl in ("wide", "scan", "scan2"):
+            self._impl = config.block_impl
         else:
             raise ValueError(
-                f"block_impl must be 'auto', 'wide' or 'scan', "
+                f"block_impl must be 'auto', 'wide', 'scan' or 'scan2', "
                 f"got {config.block_impl!r}"
             )
+        #: scan-family impls share the ensemble series path and labels
+        self._use_scan = self._impl in ("scan", "scan2")
         self._series_jit = jax.jit(self._ensemble_series)
         #: memoized jitted initializers keyed by (kind, sharding) — a fresh
         #: jax.jit(closure) per call would never hit the trace cache, which
@@ -566,12 +571,14 @@ class Simulation:
         acc = self._block_stats_acc(meter, pv, inputs["block_idx"]["t"], acc)
         return state, acc
 
-    def _scan_block_setup(self, state, inputs):
+    def _scan_block_setup(self, state, inputs, predraw=True):
         """Shared preamble of the scan-fused paths (traced): windows,
         value-major tables, pre-drawn time-major RNG streams, geometry
         routing.  Returns (xs, step, cc_carry) where ``step(rc, x) ->
         (rc', meter, ac)`` runs one second of the full pipeline on
-        (n_chains,) vectors."""
+        (n_chains,) vectors.  ``predraw=False`` omits the u/z/meter
+        streams from xs — the nested 'scan2' formulation draws them
+        per-minute inside its outer scan instead."""
         cfg = self.config
         dtype = self.dtype
         opts = cfg.options
@@ -585,15 +592,17 @@ class Simulation:
         tables = ci.value_major_tables(arrays, mvals)
         tables["cloudy_pair"] = state["cloudy_pair"].T
 
-        # blocks are minute-aligned by construction (block_s % 60 == 0 and
-        # offsets are whole blocks), so local second s is draw slot s % 60
-        # of group s // 60 — exactly n_groups = block_s // 60 groups
-        g0 = t[0] // 60
-        n_groups = t.shape[0] // 60
-        u_T, z_T = ci.scan_draws_tmajor(state["k_scan"], g0, n_groups, dtype)
-        meter_T = ci.meter_block_tmajor(
-            state["k_meter"], g0, n_groups, cfg.meter_max_w, dtype
-        )
+        if predraw:
+            # blocks are minute-aligned by construction (block_s % 60 == 0
+            # and offsets are whole blocks), so local second s is draw
+            # slot s % 60 of group s // 60 — exactly block_s // 60 groups
+            g0 = t[0] // 60
+            n_groups = t.shape[0] // 60
+            u_T, z_T = ci.scan_draws_tmajor(state["k_scan"], g0, n_groups,
+                                            dtype)
+            meter_T = ci.meter_block_tmajor(
+                state["k_meter"], g0, n_groups, cfg.meter_max_w, dtype
+            )
 
         if shared_geom is None:
             ts = inputs["time_split"]
@@ -616,9 +625,10 @@ class Simulation:
             "h": bi["hour_idx"], "d": bi["day_idx"],
             "m": bi["min_idx"] - inputs["mlo"],
             "hf": bi["hour_frac"], "df": bi["day_frac"], "mf": bi["min_frac"],
-            "u": u_T, "z": z_T, "meter": meter_T,
             "geom": geom_xs,
         }
+        if predraw:
+            xs.update(u=u_T, z=z_T, meter=meter_T)
 
         def step(rc, x):
             rc, csi, _covered = ci.csi_compose_step(
@@ -644,23 +654,12 @@ class Simulation:
 
         return xs, step, cc_carry
 
-    def _block_step_scan_acc(self, state, inputs, acc):
-        """Scan-fused reduce-mode block (SimConfig.block_impl='scan').
-
-        One ``lax.scan`` over the block's seconds; each step runs the FULL
-        pipeline — sampler interpolation, renewal, PV physics, meter,
-        statistics fold — on (n_chains,) vectors, with the running
-        statistics carried alongside the renewal state.  Nothing of shape
-        (n_chains, block_s) is ever materialised except the three
-        pre-drawn RNG streams (whose values are bit-identical to the wide
-        path's, models/clearsky_index.py scan_draws_tmajor), which is what
-        removes the wide formulation's ~20 HBM-round-tripped
-        intermediates (measured bandwidth-bound on TPU v5e;
-        benchmarks/PERF_ANALYSIS.md).
-        """
+    def _make_acc_body(self, step):
+        """The reduce-mode scan body: one second through ``step`` plus the
+        statistics fold into the carried accumulator (shared by the flat
+        'scan' and nested 'scan2' formulations)."""
         cfg = self.config
         dtype = self.dtype
-        xs, step, cc_carry = self._scan_block_setup(state, inputs)
         big = jnp.asarray(jnp.finfo(dtype).max, dtype)
 
         def body(carry, x):
@@ -683,9 +682,80 @@ class Simulation:
             }
             return (rc, st), None
 
+        return body
+
+    def _block_step_scan_acc(self, state, inputs, acc):
+        """Scan-fused reduce-mode block (SimConfig.block_impl='scan').
+
+        One ``lax.scan`` over the block's seconds; each step runs the FULL
+        pipeline — sampler interpolation, renewal, PV physics, meter,
+        statistics fold — on (n_chains,) vectors, with the running
+        statistics carried alongside the renewal state.  Nothing of shape
+        (n_chains, block_s) is ever materialised except the three
+        pre-drawn RNG streams (whose values are bit-identical to the wide
+        path's, models/clearsky_index.py scan_draws_tmajor), which is what
+        removes the wide formulation's ~20 HBM-round-tripped
+        intermediates (measured bandwidth-bound on TPU v5e;
+        benchmarks/PERF_ANALYSIS.md).
+        """
+        cfg = self.config
+        xs, step, cc_carry = self._scan_block_setup(state, inputs)
         (rcarry, acc), _ = jax.lax.scan(
-            body, (state["carry"], acc), xs, unroll=cfg.scan_unroll
+            self._make_acc_body(step), (state["carry"], acc), xs,
+            unroll=cfg.scan_unroll,
         )
+        return dict(state, carry=rcarry, cc_carry=cc_carry), acc
+
+    def _block_step_scan2_acc(self, state, inputs, acc):
+        """Nested scan-fused reduce block (SimConfig.block_impl='scan2').
+
+        Same pipeline and bit-identical draws as 'scan', but the RNG
+        streams are generated per MINUTE inside an outer scan — a
+        (60, n_chains) tile at a time, consumed immediately by an inner
+        unrolled scan over its 60 seconds — so even the pre-drawn streams
+        never materialise at (block_s, n_chains): the last
+        O(n_chains x block_s) HBM term of the flat scan
+        (benchmarks/PERF_ANALYSIS.md §4a).  Opt-in until validated on
+        hardware (nested-scan compile cost is the open risk)."""
+        cfg = self.config
+        dtype = self.dtype
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    predraw=False)
+        n_min = xs["t"].shape[0] // 60
+        g0 = xs["t"][0] // 60
+        # per-second features tiled per minute: (T, ...) -> (n_min, 60, ...)
+        xs_t = jax.tree.map(
+            lambda a: a.reshape((n_min, 60) + a.shape[1:]), xs
+        )
+        k_scan, k_meter = state["k_scan"], state["k_meter"]
+        max_w = cfg.meter_max_w
+        inner_body = self._make_acc_body(step)
+
+        def outer(carry, xm):
+            g = g0 + xm.pop("_mi")
+            # this minute's draw tile, same keyed slots as
+            # scan_draws_tmajor/meter_block_tmajor (bit-identical values)
+
+            def draws(k):
+                kg = jax.random.fold_in(k, g)
+                u = jax.random.uniform(jax.random.fold_in(kg, 0), (60,),
+                                       dtype)
+                z = jax.random.normal(jax.random.fold_in(kg, 1), (60,),
+                                      dtype)
+                return u, z
+
+            u, z = jax.vmap(draws, out_axes=1)(k_scan)       # (60, chains)
+            mu = jax.vmap(
+                lambda k: jax.random.uniform(jax.random.fold_in(k, g),
+                                             (60,), dtype),
+                out_axes=1,
+            )(k_meter)
+            xs_inner = dict(xm, u=u, z=z, meter=max_w * mu)
+            return jax.lax.scan(inner_body, carry, xs_inner,
+                                unroll=cfg.scan_unroll)[0], None
+
+        xs_t["_mi"] = jnp.arange(n_min)
+        (rcarry, acc), _ = jax.lax.scan(outer, (state["carry"], acc), xs_t)
         return dict(state, carry=rcarry, cc_carry=cc_carry), acc
 
     def _block_step_scan_series(self, state, inputs):
@@ -708,7 +778,9 @@ class Simulation:
 
     def step_acc(self, state, inputs, acc):
         """One reduce-mode block folded into the on-device accumulator."""
-        if self._use_scan:
+        if self._impl == "scan2":
+            return self._scan2_acc_jit(state, inputs, acc)
+        if self._impl == "scan":
             return self._scan_acc_jit(state, inputs, acc)
         if self._use_fused:
             return self._fused_acc_jit(state, inputs, acc)

@@ -100,6 +100,9 @@ class ShardedSimulation(Simulation):
         self._stats_acc_jit = self._sharded_stats_acc
         self._fused_acc_jit = self._build_sharded_fused_acc()
         self._scan_acc_jit = self._build_sharded_scan_acc()
+        self._scan2_acc_jit = self._build_sharded_scan_acc(
+            self._block_step_scan2_acc
+        )
         self._scan_series_jit = self._build_sharded_scan_series()
         self._series_jit = self._trace_ensemble
 
@@ -151,13 +154,14 @@ class ShardedSimulation(Simulation):
         )
         return jax.jit(mapped, donate_argnums=(0, 2))
 
-    def _build_sharded_scan_acc(self):
+    def _build_sharded_scan_acc(self, fn=None):
         """Scan-fused reduce topology under shard_map (see
-        SimConfig.block_impl): the whole per-second pipeline per shard,
-        zero collectives, state and accumulator donated."""
+        SimConfig.block_impl; ``fn`` picks the flat or nested variant):
+        the whole per-second pipeline per shard, zero collectives, state
+        and accumulator donated."""
         spec_c, spec_r = P(CHAIN_AXIS), P()
         mapped = shard_map(
-            self._block_step_scan_acc,
+            self._block_step_scan_acc if fn is None else fn,
             mesh=self.mesh,
             in_specs=(spec_c, spec_r, spec_c),
             out_specs=(spec_c, spec_c),
