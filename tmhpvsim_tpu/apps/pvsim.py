@@ -141,7 +141,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               site_grid=None,
               profile_dir: Optional[str] = None,
               output: str = "trace",
-              prng_impl: str = "threefry2x32") -> None:
+              prng_impl: str = "threefry2x32",
+              block_impl: str = "auto") -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -211,6 +212,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         site_grid=site_grid,
         output=output,
         prng_impl=prng_impl,
+        block_impl=block_impl,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation

@@ -170,9 +170,18 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
               help="PRNG: threefry2x32 = fully counter-based (default); "
                    "rbg = TPU hardware bit generator, ~2x faster blocks "
                    "(jax backend; see config.SimConfig.prng_impl)")
+@click.option("--block-impl",
+              type=click.Choice(["auto", "wide", "scan", "scan2"]),
+              default="auto",
+              help="reduce/ensemble block formulation: auto picks "
+                   "scan-fused on accelerators, wide on CPU; scan2 nests "
+                   "per-minute RNG tiles (reduce mode only — ensemble "
+                   "runs it as 'scan'; jax backend, see "
+                   "config.SimConfig.block_impl)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, backend, n_chains, chain, sharded, checkpoint, block_s,
-          site_grid_spec, sites_csv, profile_dir, output, prng_impl):
+          site_grid_spec, sites_csv, profile_dir, output, prng_impl,
+          block_impl):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     if (site_grid_spec or sites_csv) and backend != "jax":
@@ -187,6 +196,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError(f"--output={output} requires --backend=jax")
     if prng_impl != "threefry2x32" and backend != "jax":
         raise click.UsageError("--prng-impl requires --backend=jax")
+    if block_impl != "auto" and backend != "jax":
+        raise click.UsageError("--block-impl requires --backend=jax")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
@@ -220,7 +231,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         pvsim_jax(file, duration_s, n_chains, seed, start, chain,
                   sharded, checkpoint, block_s, realtime=realtime,
                   site_grid=site_grid, profile_dir=profile_dir,
-                  output=output, prng_impl=prng_impl)
+                  output=output, prng_impl=prng_impl,
+                  block_impl=block_impl)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main
