@@ -163,10 +163,13 @@ def _timed_reduce_run(sim, n_blocks: int, n_rounds: int, profile_dir=None):
 
     import jax
 
+    from tmhpvsim_tpu.engine.simulation import InputPrefetcher
+
     sim.state = sim.init_state()
     acc = sim.init_reduce_acc()
+    pf = InputPrefetcher(sim, 0, sim.n_blocks)
     t_c = time.perf_counter()
-    inputs, _ = sim.host_inputs(0)
+    inputs, _ = pf.get(0)
     sim.state, acc = sim.step_acc(sim.state, inputs, acc)
     jax.block_until_ready(acc)
     compile_s = time.perf_counter() - t_c
@@ -179,15 +182,18 @@ def _timed_reduce_run(sim, n_blocks: int, n_rounds: int, profile_dir=None):
 
     best = float("inf")
     bi = 1
-    with trace:
-        for _ in range(n_rounds):
-            t0 = time.perf_counter()
-            for _ in range(n_blocks):
-                inputs, _ = sim.host_inputs(bi)
-                bi += 1
-                sim.state, acc = sim.step_acc(sim.state, inputs, acc)
-            jax.block_until_ready(acc)
-            best = min(best, time.perf_counter() - t0)
+    try:
+        with trace:
+            for _ in range(n_rounds):
+                t0 = time.perf_counter()
+                for _ in range(n_blocks):
+                    inputs, _ = pf.get(bi)
+                    bi += 1
+                    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+                jax.block_until_ready(acc)
+                best = min(best, time.perf_counter() - t0)
+    finally:
+        pf.close()
     n = sim.config.n_chains
     bs = sim.config.block_s
     return compile_s, best, n * bs * n_blocks / best
@@ -278,19 +284,66 @@ VARIANT_CFGS = {
                      stats_fusion="fused"),
 }
 
-#: deadline for the TPU variants phase; past it the watchdog salvages a
-#: CPU number in a fresh subprocess and hard-exits — covering the
-#: tunnel's HANGING failure mode (the erroring mode is handled in-line)
+#: deadline for the TPU variants phase; past it the watchdog emits a
+#: headline from whatever variants already landed (or salvages a CPU
+#: number if none did) and hard-exits — covering the tunnel's HANGING
+#: failure mode (the erroring mode is handled in-line)
 TPU_VARIANTS_DEADLINE_S = 900.0
+
+#: every measured variant/config is appended here the moment it lands, so
+#: a tunnel drop (or SIGKILL) mid-run still leaves TPU numbers on disk —
+#: the round-4 outage zeroed a round for want of exactly this
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "bench_partial.jsonl")
+
+
+def _persist_partial(record: dict) -> None:
+    """Append one result record to the partial-results journal (flushed
+    + fsynced: the record must survive the process dying next instant)."""
+    try:
+        rec = dict(record, ts=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()))
+        with open(PARTIAL_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        print(f"# partial-result persist failed: {e}", file=sys.stderr)
+
+
+def _headline_doc(variants: dict, platform: str, **extra) -> dict:
+    """The headline JSON from whatever variants have landed (shared by
+    the normal path and the watchdog's partial-salvage path)."""
+    ok = {k: v for k, v in variants.items() if "rate" in v}
+    best_name = max(ok, key=lambda k: ok[k]["rate"])
+    rate = ok[best_name]["rate"]
+    return {
+        "metric": "simulated site-seconds/sec/chip",
+        "value": rate,
+        "unit": "site-s/s/chip",
+        "vs_baseline": round(rate / REF_CEILING, 1),
+        "north_star_frac": round(rate / NORTH_STAR, 3),
+        "platform": platform,
+        "tpu": platform == "tpu",
+        "headline_variant": best_name,
+        "variants": variants,
+        **extra,
+    }
 
 
 def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
-                  note: str = "") -> tuple[dict, dict]:
-    """Measure the variant matrix once; returns (variants, sims)."""
+                  note: str = "", variants: dict | None = None
+                  ) -> tuple[dict, dict]:
+    """Measure the variant matrix once; returns (variants, sims).
+
+    ``variants`` may be a caller-shared dict (the watchdog reads it to
+    salvage partial results if the tunnel wedges mid-matrix); every
+    completed entry is also journalled to ``PARTIAL_PATH``."""
     from tmhpvsim_tpu.engine import Simulation
 
     n_total = n_blocks * n_rounds + 1
-    variants, sims = {}, {}
+    variants = {} if variants is None else variants
+    sims = {}
     for name, kw in VARIANT_CFGS.items():
         try:
             sim = Simulation(_make_cfg(n_chains, n_total, **kw))
@@ -304,6 +357,8 @@ def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
                 "impl": _impl_label(sim),
             }
             sims[name] = (sim, dt)
+            _persist_partial({"phase": "headline-variant", "name": name,
+                              "n_chains": n_chains, **variants[name]})
         except Exception as e:
             print(f"# variant {name} failed{note}: {e}", file=sys.stderr)
             variants[name] = {"error": str(e)[:200]}
@@ -347,15 +402,33 @@ def headline() -> None:
     platform, fallback = _probe_or_fallback()
     import jax
 
+    shared_variants: dict = {}
     if platform == "tpu":
         n_chains, n_blocks, n_rounds = N_CHAINS, N_BLOCKS, N_ROUNDS
         # watchdog for the hanging failure mode: if the variants phase
         # wedges (block_until_ready on a dead tunnel never returns), a
-        # daemon timer salvages a CPU number and hard-exits with rc=0
-        # instead of the harness recording rc=124 and nothing else
+        # daemon timer emits a headline from the variants that already
+        # landed — REAL TPU numbers beat a CPU fallback — else salvages a
+        # CPU number, and hard-exits with rc=0 instead of the harness
+        # recording rc=124 and nothing else (the round-4 failure mode)
         import threading
 
         def _wedged():
+            # snapshot first: the main thread mutates this dict
+            snap = dict(shared_variants)
+            done = {k: v for k, v in snap.items() if "rate" in v}
+            if done:
+                print("# TPU variants phase exceeded deadline; emitting "
+                      f"partial headline from {len(done)} completed "
+                      "variant(s)", file=sys.stderr)
+                print(json.dumps(_headline_doc(
+                    snap, "tpu",
+                    partial=True, n_chains=n_chains, block_s=BLOCK_S,
+                    timed_blocks=n_blocks, timed_rounds=n_rounds,
+                    error="tunnel wedged mid-matrix; remaining variants "
+                          "unmeasured",
+                )))
+                os._exit(0)
             print("# TPU variants phase exceeded deadline; salvaging CPU "
                   "number", file=sys.stderr)
             if not _salvage_cpu_headline(
@@ -388,7 +461,8 @@ def headline() -> None:
         print(f"# jax.distributed init skipped: {e}", file=sys.stderr)
 
     n_total = n_blocks * n_rounds + 1
-    variants, sims = _run_variants(n_chains, n_blocks, n_rounds)
+    variants, sims = _run_variants(n_chains, n_blocks, n_rounds,
+                                   variants=shared_variants)
     if watchdog is not None:
         watchdog.cancel()
 
@@ -440,24 +514,14 @@ def headline() -> None:
         print(f"# sharded bench failed: {e}", file=sys.stderr)
         sharded = {"error": str(e)[:200]}
 
-    print(json.dumps({
-        "metric": "simulated site-seconds/sec/chip",
-        "value": rate,
-        "unit": "site-s/s/chip",
-        "vs_baseline": round(rate / REF_CEILING, 1),
-        "north_star_frac": round(rate / NORTH_STAR, 3),
-        "platform": platform,
-        "tpu": platform == "tpu",
-        "device_kind": device_kind,
-        "headline_variant": best_name,
-        "n_chains": n_chains,
-        "block_s": BLOCK_S,
-        "timed_blocks": n_blocks,
-        "timed_rounds": n_rounds,
-        "variants": variants,
-        "roofline": roofline,
-        "sharded": sharded,
-    }))
+    doc = _headline_doc(
+        variants, platform,
+        device_kind=device_kind, n_chains=n_chains, block_s=BLOCK_S,
+        timed_blocks=n_blocks, timed_rounds=n_rounds,
+        roofline=roofline, sharded=sharded,
+    )
+    _persist_partial({"phase": "headline", **doc})
+    print(json.dumps(doc))
 
 
 # ---------------------------------------------------------------------------
@@ -487,7 +551,7 @@ def _reduce_config_run(label: str, cfg, sharded: bool, note: str,
     # measurement protocol (_timed_reduce_run)
     compile_s, steady_s, rate = _timed_reduce_run(sim, sim.n_blocks - 1, 1)
     n_dev = len(jax.local_devices()) if sharded else 1
-    print(json.dumps({
+    doc = {
         "config": label,
         "metric": "simulated site-seconds/sec/chip",
         "value": round(rate / n_dev, 1),
@@ -507,7 +571,37 @@ def _reduce_config_run(label: str, cfg, sharded: bool, note: str,
         "steady_wall_s": round(steady_s, 2),
         "scaled_from": scaled_from,
         "note": note,
-    }))
+    }
+    _persist_partial({"phase": "config", **doc})
+    print(json.dumps(doc))
+
+
+def _reduce_config_run_resilient(label: str, make_cfg_bs, sharded: bool,
+                                 note: str, scaled_from: str | None = None,
+                                 block_s_steps=(8640, 4320, 1080)) -> None:
+    """``_reduce_config_run`` with block_s step-down: the remote-compile
+    service has failed nested/long-block compiles before (round-4
+    PERF_ANALYSIS §4a), so a compile failure at the target block_s retries
+    at successively smaller blocks instead of zeroing the artifact.
+    ``make_cfg_bs(block_s)`` builds the config for one attempt."""
+    last_err = None
+    for bs in block_s_steps:
+        n = note if last_err is None else (
+            note + f" [block_s stepped down to {bs}; prior attempt "
+                   f"failed: {last_err}]"
+        )
+        try:
+            _reduce_config_run(label, make_cfg_bs(bs), sharded=sharded,
+                               note=n, scaled_from=scaled_from)
+            return
+        except Exception as e:
+            last_err = str(e)[:200]
+            print(f"# config {label!r} failed at block_s={bs}: {last_err}",
+                  file=sys.stderr)
+    doc = {"config": label, "error": last_err,
+           "block_s_tried": list(block_s_steps)}
+    _persist_partial({"phase": "config", **doc})
+    print(json.dumps(doc))
 
 
 def config_1() -> None:
@@ -573,15 +667,18 @@ def config_2() -> None:
     platform, fallback = _probe_or_fallback()
     year = 365 * 86_400
     if platform != "tpu":
-        cfg = _make_cfg(1000, 4, block_s=8640)
-        note = "cpu-fallback: duration scaled to 4 blocks"
-        scaled = "1000 chains x 1 year"
-    else:
-        cfg = _make_cfg(1000, year // 8640, block_s=8640)
-        note = "full 1-year run, 1000 chains, single chip"
-        scaled = None
-    _reduce_config_run("2: 1k chains x 1 year, single chip", cfg,
-                       sharded=False, note=note, scaled_from=scaled)
+        _reduce_config_run(
+            "2: 1k chains x 1 year, single chip",
+            _make_cfg(1000, 4, block_s=8640),
+            sharded=False, note="cpu-fallback: duration scaled to 4 blocks",
+            scaled_from="1000 chains x 1 year",
+        )
+        return
+    _reduce_config_run_resilient(
+        "2: 1k chains x 1 year, single chip",
+        lambda bs: _make_cfg(1000, year // bs, block_s=bs),
+        sharded=False, note="full 1-year run, 1000 chains, single chip",
+    )
 
 
 def config_3() -> None:
@@ -592,35 +689,43 @@ def config_3() -> None:
     grid = SiteGrid.regular((45.0, 55.0), (5.0, 15.0), 100, 100)
     year = 365 * 86_400
     if platform != "tpu":
-        cfg = _make_cfg(len(grid), 2, block_s=4320, site_grid=grid)
-        note = "cpu-fallback: duration scaled to 2 blocks"
-        scaled = "10k sites x 1 year"
-    else:
-        cfg = _make_cfg(len(grid), year // 8640, block_s=8640,
-                        site_grid=grid)
-        note = ("full 1-year run, 100x100 lat/lon grid over central "
-                "Europe, solar geometry evaluated per site on device")
-        scaled = None
-    _reduce_config_run("3: 10k-site grid x 1 year", cfg, sharded=False,
-                       note=note, scaled_from=scaled)
+        _reduce_config_run(
+            "3: 10k-site grid x 1 year",
+            _make_cfg(len(grid), 2, block_s=4320, site_grid=grid),
+            sharded=False, note="cpu-fallback: duration scaled to 2 blocks",
+            scaled_from="10k sites x 1 year",
+        )
+        return
+    _reduce_config_run_resilient(
+        "3: 10k-site grid x 1 year",
+        lambda bs: _make_cfg(len(grid), year // bs, block_s=bs,
+                             site_grid=grid),
+        sharded=False,
+        note=("full 1-year run, 100x100 lat/lon grid over central "
+              "Europe, solar geometry evaluated per site on device"),
+    )
 
 
 def config_4() -> None:
     """100k chains, per-second, sharded over the available mesh."""
     platform, fallback = _probe_or_fallback()
     if platform != "tpu":
-        cfg = _make_cfg(100_000 // 125, 3, block_s=1080)
-        note = "cpu-fallback: 800 chains x 3 blocks"
-        scaled = "100k chains x 1 day"
-    else:
-        cfg = _make_cfg(100_000, 86_400 // 8640, block_s=8640)
-        note = ("100k chains x 1 day, sharded over all local devices "
-                "(a 1-device mesh on the single available chip; the "
-                "BASELINE target hardware is v5e-8 — per-chip rate is "
-                "the comparable number)")
-        scaled = None
-    _reduce_config_run("4: 100k chains per-second, sharded", cfg,
-                       sharded=True, note=note, scaled_from=scaled)
+        _reduce_config_run(
+            "4: 100k chains per-second, sharded",
+            _make_cfg(100_000 // 125, 3, block_s=1080),
+            sharded=True, note="cpu-fallback: 800 chains x 3 blocks",
+            scaled_from="100k chains x 1 day",
+        )
+        return
+    _reduce_config_run_resilient(
+        "4: 100k chains per-second, sharded",
+        lambda bs: _make_cfg(100_000, 86_400 // bs, block_s=bs),
+        sharded=True,
+        note=("100k chains x 1 day, sharded over all local devices "
+              "(a 1-device mesh on the single available chip; the "
+              "BASELINE target hardware is v5e-8 — per-chip rate is "
+              "the comparable number)"),
+    )
 
 
 def config_5() -> None:
@@ -727,14 +832,16 @@ def sweep() -> None:
             sim = Simulation(cfg)
             c_s, dt, rate = _timed_reduce_run(sim, n_blocks, n_rounds)
             cost = _hot_jit_cost(sim)
-            print(json.dumps({
+            doc = {
                 "label": label, "platform": platform,
                 "rate": round(rate, 1), "compile_s": round(c_s, 1),
                 "best_round_wall_s": round(dt, 3),
                 "impl": _impl_label(sim),
                 "n_chains": cfg.n_chains, "block_s": bs, "unroll": unroll,
                 **cost,
-            }), flush=True)
+            }
+            _persist_partial({"phase": "sweep", **doc})
+            print(json.dumps(doc), flush=True)
         except Exception as e:
             print(json.dumps({"label": label, "error": str(e)[:200]}),
                   flush=True)

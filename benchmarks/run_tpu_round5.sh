@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-5 TPU benchmark battery. Run (once) when the tunnel answers:
+#   nohup benchmarks/run_tpu_round5.sh >/dev/null 2>&1 &
+# Sequential single processes, no timeouts (see tpu_probe.sh header on
+# why), most-important-first so a mid-battery tunnel drop costs the least:
+# headline -> sweep -> configs 4,2,3 -> scaling -> profile.
+# Config artifacts are only replaced when the new run measured real TPU
+# (a cpu-fallback result must never overwrite a TPU artifact).
+set -u
+cd /root/repo
+LOG=benchmarks/tpu_round5.log
+echo "=== battery start $(date -u +%FT%TZ)" >> "$LOG"
+
+run_json () {  # run_json <dest.json> <label> <args...>
+  local dest="$1" label="$2"; shift 2
+  echo "--- $label start $(date -u +%FT%TZ)" >> "$LOG"
+  python bench.py "$@" > "$dest.tmp" 2>> "$LOG"
+  local rc=$?
+  echo "--- $label rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+  if [ $rc -eq 0 ] && grep -q '"platform": "tpu"' "$dest.tmp"; then
+    mv "$dest.tmp" "$dest"
+    echo "--- $label: TPU artifact written to $dest" >> "$LOG"
+  else
+    mv "$dest.tmp" "$dest.nontpu" 2>/dev/null
+    echo "--- $label: NOT a TPU result; kept as $dest.nontpu" >> "$LOG"
+  fi
+}
+
+run_json benchmarks/HEADLINE_r05.json  headline
+run_json benchmarks/SWEEP_r05.jsonl    sweep     --sweep
+run_json benchmarks/BENCH_config4.json config4   --config 4
+run_json benchmarks/BENCH_config2.json config2   --config 2
+run_json benchmarks/BENCH_config3.json config3   --config 3
+# --scaling is the virtual-CPU-mesh mechanics artifact (CPU by design,
+# no TPU gate): regenerate it alongside the TPU numbers per the round-4
+# verdict, replacing only on success.
+echo "--- scaling start $(date -u +%FT%TZ)" >> "$LOG"
+if python bench.py --scaling > benchmarks/SCALING.json.tmp 2>> "$LOG"; then
+  mv benchmarks/SCALING.json.tmp benchmarks/SCALING.json
+fi
+echo "--- profile start $(date -u +%FT%TZ)" >> "$LOG"
+python bench.py --profile benchmarks/profile_r05 >> "$LOG" 2>&1
+echo "=== battery done $(date -u +%FT%TZ)" >> "$LOG"
+touch benchmarks/BATTERY_DONE

@@ -1,0 +1,24 @@
+#!/bin/bash
+# ONE unbounded TPU tunnel probe. No `timeout`: SIGTERM/SIGKILLing a
+# dialing axon process leaves a stale tunnel grant that blocks the NEXT
+# process for 10+ minutes (observed round 4; .claude/skills/verify).
+# The process parks while the tunnel is down and completes the moment it
+# answers, writing TPU_UP to benchmarks/tpu_status.txt.
+STATUS=/root/repo/benchmarks/tpu_status.txt
+LOG=/root/repo/benchmarks/tpu_probe.log
+echo "parked waiting for tunnel since $(date -u +%FT%TZ)" > "$STATUS"
+python - >> "$LOG" 2>&1 <<'EOF'
+import time
+t0 = time.time()
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((128, 128))
+(x @ x).block_until_ready()
+print(f"OK platform={d.platform} kind={d.device_kind} "
+      f"init+compile={time.time()-t0:.1f}s", flush=True)
+EOF
+if [ $? -eq 0 ]; then
+  echo "TPU_UP $(date -u +%FT%TZ)" > "$STATUS"
+else
+  echo "probe exited nonzero $(date -u +%FT%TZ)" > "$STATUS"
+fi

@@ -192,3 +192,56 @@ class TestAbsoluteWattFixture:
             mod["Impo"] * mod["Vmpo"], rel=1e-12
         )
         assert 240.0 <= mod["Impo"] * mod["Vmpo"] <= 260.0
+
+
+class TestModuleSTCAnchors:
+    """STC anchors on the coefficient TABLE (data/parameters.py): every
+    relation here must hold for ANY valid SAM row of the reference's
+    hardware class (Hanwha HSL60P6-PA-4-250T, 60-cell 250 W poly-Si;
+    pvmodel.py:13-14), so they pin the vendored nominal set AND
+    re-validate an exact row swapped in via data/sam.py — the
+    MIGRATION.md "verified no-op path".  Bounds are the class's datasheet
+    envelope: Pmp 250 W (0/+3%), Voc ~37-38 V, Isc ~8.6-9.0 A, fill
+    factor 0.70-0.78, negative voltage / small positive current
+    temperature coefficients."""
+
+    def _mod(self):
+        from tmhpvsim_tpu.data import SAPM_MODULE
+
+        return SAPM_MODULE
+
+    def test_pmp_within_nameplate_binning(self):
+        mod = self._mod()
+        pmp = mod["Impo"] * mod["Vmpo"]
+        # 250 W nameplate, 0/+3% binning tolerance, plus 1% fitting slack
+        assert 247.5 <= pmp <= 258.0
+
+    def test_voc_isc_class_ranges(self):
+        mod = self._mod()
+        assert mod["Cells_in_Series"] == 60
+        assert 36.0 <= mod["Voco"] <= 39.0      # 60-cell poly Voc at STC
+        assert 8.4 <= mod["Isco"] <= 9.2        # 250 W-class Isc at STC
+
+    def test_iv_curve_consistency(self):
+        """MPP sits inside the IV envelope with a plausible fill factor."""
+        mod = self._mod()
+        assert mod["Vmpo"] < mod["Voco"]
+        assert mod["Impo"] < mod["Isco"]
+        ff = (mod["Impo"] * mod["Vmpo"]) / (mod["Isco"] * mod["Voco"])
+        assert 0.70 <= ff <= 0.78
+
+    def test_temperature_coefficient_signs(self):
+        """Poly-Si signature: voltage falls, current creeps up with T."""
+        mod = self._mod()
+        assert -0.20 <= mod["Bvoco"] < -0.08    # V/C, 60-cell class
+        assert -0.20 <= mod["Bvmpo"] < -0.08
+        assert 0.0 <= mod["Aisc"] <= 0.001      # 1/C
+        assert -0.0005 <= mod["Aimp"] <= 0.001
+
+    def test_inverter_rated_point_class(self):
+        from tmhpvsim_tpu.data import SANDIA_INVERTER as inv
+
+        assert inv["Paco"] == pytest.approx(250.0, rel=0.02)
+        eff_rated = inv["Paco"] / inv["Pdco"]
+        assert 0.92 <= eff_rated <= 0.99        # micro-inverter CEC class
+        assert 0.0 < inv["Pso"] < 5.0

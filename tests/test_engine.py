@@ -193,6 +193,20 @@ def test_scan2_impl_matches_scan(run):
                                    err_msg=k)
 
 
+def test_ensemble_scan2_matches_scan(run):
+    """Ensemble mode's nested (scan2) series step must reproduce the flat
+    scan series — same keyed draw slots, so only compiler reassociation
+    may differ (no coercion: scan2 has its own series jit)."""
+    scan = list(Simulation(small_config(block_impl="scan")).run_ensemble())
+    scan2 = list(Simulation(small_config(block_impl="scan2")).run_ensemble())
+    assert len(scan) == len(scan2)
+    for s, s2 in zip(scan, scan2):
+        assert s2.meter.shape == s.meter.shape
+        np.testing.assert_array_equal(s2.epoch, s.epoch)
+        np.testing.assert_allclose(s2.meter, s.meter, rtol=2e-6, atol=1e-3)
+        np.testing.assert_allclose(s2.pv, s.pv, rtol=2e-6, atol=1e-3)
+
+
 def test_fused_stats_topology_matches_split(run):
     """SimConfig.stats_fusion='fused' (one producer+stats+merge jit, the
     TPU reduce-mode topology) must produce the same per-chain statistics

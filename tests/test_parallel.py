@@ -158,12 +158,13 @@ class TestShardedReduce:
                 r_alt[k], r_split[k], rtol=2e-5, atol=1e-2
             )
 
-    def test_ensemble_scan_matches_wide_sharded(self):
-        """Sharded ensemble mode: the scan-fused series step (local sums
-        + one psum pair per block) must match the wide producer+psum
-        path."""
+    @pytest.mark.parametrize("impl", ["scan", "scan2"])
+    def test_ensemble_scan_matches_wide_sharded(self, impl):
+        """Sharded ensemble mode: the scan-fused series steps (local sums
+        + one psum pair per block; flat and nested) must match the wide
+        producer+psum path."""
         wide = list(ShardedSimulation(cfg(block_impl="wide")).run_ensemble())
-        scan = list(ShardedSimulation(cfg(block_impl="scan")).run_ensemble())
+        scan = list(ShardedSimulation(cfg(block_impl=impl)).run_ensemble())
         assert len(wide) == len(scan)
         for w, s in zip(wide, scan):
             np.testing.assert_allclose(s.meter, w.meter, rtol=2e-5,

@@ -57,6 +57,40 @@ class TestFanoutSemantics:
         assert [v for _, v in r1] == [100.0, 101.0, 102.0]
         assert r1[0][0] == dt.datetime(2019, 9, 5, 12, 0, 0)
 
+    def test_subsecond_timestamps_roundtrip_exactly(self):
+        """The wire encodes integer epoch microseconds: a sub-second
+        datetime must come back EXACTLY (the funnel joins on datetime
+        equality; a float64-seconds encoding can perturb the microsecond
+        field through json)."""
+        times = [
+            dt.datetime(2019, 9, 5, 12, 0, 0, 1),
+            dt.datetime(2019, 9, 5, 12, 0, 0, 333333),
+            dt.datetime(2038, 1, 19, 3, 14, 7, 999999),
+            dt.datetime(1969, 12, 31, 23, 59, 59, 7),   # negative epoch
+        ]
+
+        async def main():
+            async with TcpFanoutBroker(port=0) as broker:
+                url = f"tcp://127.0.0.1:{broker.port}"
+
+                async def consume(n):
+                    out = []
+                    async with TcpTransport(url, "meter") as t:
+                        async for time, value in t.subscribe():
+                            out.append((time, value))
+                            if len(out) == n:
+                                return out
+
+                c = asyncio.create_task(consume(len(times)))
+                await asyncio.sleep(0.1)
+                async with TcpTransport(url, "meter") as pub:
+                    for i, t in enumerate(times):
+                        await pub.publish(float(i), t)
+                return await c
+
+        got = _run(main())
+        assert [t for t, _ in got] == times
+
     def test_exchanges_are_isolated(self):
         """A subscriber on exchange A never sees exchange B's messages."""
 

@@ -175,8 +175,7 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
               default="auto",
               help="reduce/ensemble block formulation: auto picks "
                    "scan-fused on accelerators, wide on CPU; scan2 nests "
-                   "per-minute RNG tiles (reduce mode only — ensemble "
-                   "runs it as 'scan'; jax backend, see "
+                   "per-minute RNG tiles (jax backend, see "
                    "config.SimConfig.block_impl)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, backend, n_chains, chain, sharded, checkpoint, block_s,

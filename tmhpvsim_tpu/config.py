@@ -238,11 +238,10 @@ class SimConfig:
     #: float reassociation (tested).  'scan2' nests the scan per minute,
     #: drawing each minute's RNG tile inside the outer body so even the
     #: pre-drawn streams never materialise at (n_chains, block_s) —
-    #: bit-identical draws, opt-in until validated on TPU hardware
-    #: (benchmarks/PERF_ANALYSIS.md §4a).  'auto': scan on accelerators,
-    #: wide on CPU.  Applies to reduce mode (ensemble uses the scan
-    #: series step for either scan impl); trace mode needs the wide
-    #: arrays anyway.
+    #: bit-identical draws (benchmarks/PERF_ANALYSIS.md §4a).  'auto':
+    #: scan on accelerators, wide on CPU.  Applies to reduce AND ensemble
+    #: mode (each impl has its own series step); trace mode needs the
+    #: wide arrays anyway.
     block_impl: str = "auto"
 
     #: lax.scan unroll factor for the per-second scan (both impls): keeps

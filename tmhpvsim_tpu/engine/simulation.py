@@ -78,6 +78,52 @@ def _round_up(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+class InputPrefetcher:
+    """Overlap host-side block precompute with device compute.
+
+    ``host_inputs`` is ~3 ms of float64 calendar + solar geometry per
+    1080 s block on a 1-core host (benchmarks/PERF_ANALYSIS.md §4b) —
+    negligible against a 50 ms wide block, co-limiting against a 4-6 ms
+    scan-fused block, and fully serialised in trace mode where the
+    per-block result gather blocks the main thread.  This one-slot
+    prefetcher computes block bi+1's inputs in a worker thread while
+    block bi's device work (and any host gather) is in flight.
+
+    All computation runs in ONE worker thread, so ``host_inputs``'s
+    internal state (the first-block ``_n_minute_vals`` latch) is accessed
+    sequentially; the main thread only consumes finished results."""
+
+    def __init__(self, sim: "Simulation", start_block: int, n_blocks: int):
+        import concurrent.futures
+
+        self._sim = sim
+        self._n_blocks = n_blocks
+        self._ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="host-inputs"
+        )
+        # a resumed run may have zero blocks left: nothing to prefetch
+        self._slot = None if start_block >= n_blocks else (
+            start_block, self._ex.submit(sim.host_inputs, start_block)
+        )
+
+    def get(self, block_i: int):
+        """Inputs for ``block_i`` (prefetched if it was the expected next
+        block), with block_i+1's prefetch kicked off before returning."""
+        bi, fut = self._slot if self._slot is not None else (None, None)
+        if bi != block_i:  # out-of-order consumer: compute directly
+            fut = self._ex.submit(self._sim.host_inputs, block_i)
+        if block_i + 1 < self._n_blocks:
+            self._slot = (block_i + 1,
+                          self._ex.submit(self._sim.host_inputs,
+                                          block_i + 1))
+        else:
+            self._slot = None
+        return fut.result()
+
+    def close(self):
+        self._ex.shutdown(wait=False, cancel_futures=True)
+
+
 #: Reduce-mode statistics: one entry drives the accumulator init, the
 #: per-block merge, both ensemble reductions and the summary-CSV columns —
 #: add a statistic HERE and every consumer picks it up.
@@ -159,6 +205,8 @@ class Simulation:
                                       donate_argnums=(0, 2))
         self._scan_series_jit = jax.jit(self._block_step_scan_series,
                                         donate_argnums=0)
+        self._scan2_series_jit = jax.jit(self._block_step_scan2_series,
+                                         donate_argnums=0)
         if config.stats_fusion == "auto":
             self._use_fused = jax.default_backend() != "cpu"
         elif config.stats_fusion in ("fused", "split"):
@@ -306,11 +354,25 @@ class Simulation:
         day_lo = db
         cd_lo = hour_lo + day_lo  # rebased pair index (h-hour_lo)+(d-day_lo)
         hour_hi_need = max(he + 1, int(h_idx.max()) + 1)  # interp upper
-        assert hour_hi_need - hour_lo + 1 <= self._w_hours, (
-            hour_lo, hour_hi_need, self._w_hours
-        )
-        assert de + 1 - day_lo + 1 <= self._w_days, (day_lo, de)
-        assert he + de + 1 - cd_lo + 1 <= self._w_cd, (cd_lo, he + de)
+        # Real exceptions, not asserts: under ``python -O`` an assert
+        # vanishes and an out-of-window index would be silently CLAMPED by
+        # JAX's gather semantics on device — wrong sampler values instead
+        # of a loud failure (e.g. an unusual DST/calendar layout).
+        if hour_hi_need - hour_lo + 1 > self._w_hours:
+            raise RuntimeError(
+                f"hour sampler window overflow in block {block_i}: need "
+                f"[{hour_lo}, {hour_hi_need}] > {self._w_hours} slots"
+            )
+        if de + 1 - day_lo + 1 > self._w_days:
+            raise RuntimeError(
+                f"day sampler window overflow in block {block_i}: need "
+                f"[{day_lo}, {de + 1}] > {self._w_days} slots"
+            )
+        if he + de + 1 - cd_lo + 1 > self._w_cd:
+            raise RuntimeError(
+                f"clear-day sampler window overflow in block {block_i}: "
+                f"need [{cd_lo}, {he + de + 1}] > {self._w_cd} slots"
+            )
         if block_i + 1 < self.n_blocks:
             nxt = self.spec.block((block_i + 1) * cfg.block_s, 1)
             hour_next_lo = max(int(nxt.hour_idx[0]) - 1, 0)
@@ -497,13 +559,20 @@ class Simulation:
         this scales to the 100k-1M chain configs like reduce mode while
         still producing the reference's row-per-second CSV shape.
 
-        Two formulations, like reduce mode: the wide producer + psum
-        consumer, or (``block_impl='scan'``, the accelerator default) the
+        Three formulations, like reduce mode: the wide producer + psum
+        consumer; (``block_impl='scan'``, the accelerator default) the
         scan-fused series step that sums across chains inside the scan
-        body and never materialises (n_chains, block_s) arrays.
+        body and never materialises (n_chains, block_s) arrays; or
+        (``'scan2'``) its nested variant with per-minute RNG tiles.
         """
         inv_n = 1.0 / self.config.n_chains
         use_scan = self._use_scan
+        if self._impl == "scan2":
+            series_jit = self._scan2_series_jit
+        elif use_scan:
+            series_jit = self._scan_series_jit
+        else:
+            series_jit = None
 
         def make(off, epoch, a, b, n_valid):
             # wide path: (a, b) are the (n_chains, block_s) meter/pv
@@ -515,10 +584,8 @@ class Simulation:
             return BlockResult(offset=off, epoch=epoch, meter=m, pv=p,
                                residual=m - p)
 
-        return self._iter_blocks(
-            state, start_block, make,
-            block_jit=self._scan_series_jit if use_scan else None,
-        )
+        return self._iter_blocks(state, start_block, make,
+                                 block_jit=series_jit)
 
     @staticmethod
     def _repl_view(arr) -> np.ndarray:
@@ -706,35 +773,28 @@ class Simulation:
         )
         return dict(state, carry=rcarry, cc_carry=cc_carry), acc
 
-    def _block_step_scan2_acc(self, state, inputs, acc):
-        """Nested scan-fused reduce block (SimConfig.block_impl='scan2').
-
-        Same pipeline and bit-identical draws as 'scan', but the RNG
-        streams are generated per MINUTE inside an outer scan — a
-        (60, n_chains) tile at a time, consumed immediately by an inner
-        unrolled scan over its 60 seconds — so even the pre-drawn streams
-        never materialise at (block_s, n_chains): the last
-        O(n_chains x block_s) HBM term of the flat scan
-        (benchmarks/PERF_ANALYSIS.md §4a).  Opt-in until validated on
-        hardware (nested-scan compile cost is the open risk)."""
+    def _scan2_outer(self, state, xs, inner, carry0):
+        """The nested ('scan2') outer scan, shared by the reduce and
+        ensemble formulations: per-second features are tiled per minute
+        ((T, ...) -> (n_min, 60, ...)), and each outer step draws that
+        minute's (60, n_chains) RNG tile — same keyed slots as
+        scan_draws_tmajor/meter_block_tmajor, so values are bit-identical
+        to the flat scan's pre-drawn streams — then hands the tile to the
+        ``inner(carry, xs_inner) -> (carry, ys)`` 60-second scan.  Returns
+        ``lax.scan(outer, carry0, xs_t)``'s (carry, ys) with ys stacked
+        per minute."""
         cfg = self.config
         dtype = self.dtype
-        xs, step, cc_carry = self._scan_block_setup(state, inputs,
-                                                    predraw=False)
         n_min = xs["t"].shape[0] // 60
         g0 = xs["t"][0] // 60
-        # per-second features tiled per minute: (T, ...) -> (n_min, 60, ...)
         xs_t = jax.tree.map(
             lambda a: a.reshape((n_min, 60) + a.shape[1:]), xs
         )
         k_scan, k_meter = state["k_scan"], state["k_meter"]
         max_w = cfg.meter_max_w
-        inner_body = self._make_acc_body(step)
 
         def outer(carry, xm):
             g = g0 + xm.pop("_mi")
-            # this minute's draw tile, same keyed slots as
-            # scan_draws_tmajor/meter_block_tmajor (bit-identical values)
 
             def draws(k):
                 kg = jax.random.fold_in(k, g)
@@ -751,12 +811,59 @@ class Simulation:
                 out_axes=1,
             )(k_meter)
             xs_inner = dict(xm, u=u, z=z, meter=max_w * mu)
+            return inner(carry, xs_inner)
+
+        xs_t["_mi"] = jnp.arange(n_min)
+        return jax.lax.scan(outer, carry0, xs_t)
+
+    def _block_step_scan2_acc(self, state, inputs, acc):
+        """Nested scan-fused reduce block (SimConfig.block_impl='scan2').
+
+        Same pipeline and bit-identical draws as 'scan', but the RNG
+        streams are generated per MINUTE inside an outer scan — a
+        (60, n_chains) tile at a time, consumed immediately by an inner
+        unrolled scan over its 60 seconds — so even the pre-drawn streams
+        never materialise at (block_s, n_chains): the last
+        O(n_chains x block_s) HBM term of the flat scan
+        (benchmarks/PERF_ANALYSIS.md §4a)."""
+        cfg = self.config
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    predraw=False)
+        inner_body = self._make_acc_body(step)
+
+        def inner(carry, xs_inner):
             return jax.lax.scan(inner_body, carry, xs_inner,
                                 unroll=cfg.scan_unroll)[0], None
 
-        xs_t["_mi"] = jnp.arange(n_min)
-        (rcarry, acc), _ = jax.lax.scan(outer, (state["carry"], acc), xs_t)
+        (rcarry, acc), _ = self._scan2_outer(
+            state, xs, inner, (state["carry"], acc)
+        )
         return dict(state, carry=rcarry, cc_carry=cc_carry), acc
+
+    def _block_step_scan2_series(self, state, inputs):
+        """Nested scan-fused ensemble block: the 'scan2' counterpart of
+        ``_block_step_scan_series`` — per-minute RNG tiles, inner scan
+        emitting the local cross-chain (meter_sum, pv_sum) per second.
+        Returns (state', meter_sum, pv_sum), each (block_s,); bit-identical
+        values to the flat scan series step (same keyed draw slots), so
+        ensemble mode accepts ``block_impl='scan2'`` without coercion."""
+        cfg = self.config
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    predraw=False)
+
+        def body(rc, x):
+            rc, meter, ac = step(rc, x)
+            return rc, (meter.sum(), ac.sum())
+
+        def inner(carry, xs_inner):
+            return jax.lax.scan(body, carry, xs_inner,
+                                unroll=cfg.scan_unroll)
+
+        rcarry, (m_sum, p_sum) = self._scan2_outer(
+            state, xs, inner, state["carry"]
+        )
+        return (dict(state, carry=rcarry, cc_carry=cc_carry),
+                m_sum.reshape(-1), p_sum.reshape(-1))
 
     def _block_step_scan_series(self, state, inputs):
         """Scan-fused ensemble-mode block: same pipeline as
@@ -805,13 +912,17 @@ class Simulation:
         state = self.init_state() if state is None \
             else self._place_resume(state)
         self.state = state
-        for bi in range(start_block, self.n_blocks):
-            inputs, epoch = self.host_inputs(bi)
-            self.state, a, b = jit(self.state, inputs)
-            off = bi * cfg.block_s
-            n_valid = min(cfg.block_s, cfg.duration_s - off)
-            yield make_result(off, np.asarray(epoch[:n_valid]),
-                              a, b, n_valid)
+        pf = InputPrefetcher(self, start_block, self.n_blocks)
+        try:
+            for bi in range(start_block, self.n_blocks):
+                inputs, epoch = pf.get(bi)
+                self.state, a, b = jit(self.state, inputs)
+                off = bi * cfg.block_s
+                n_valid = min(cfg.block_s, cfg.duration_s - off)
+                yield make_result(off, np.asarray(epoch[:n_valid]),
+                                  a, b, n_valid)
+        finally:
+            pf.close()
 
     def _trace_result(self, off, epoch, meter, pv, n_valid) -> BlockResult:
         """Per-chain gather: the trace-mode ``make_result``."""
@@ -858,12 +969,16 @@ class Simulation:
         acc = self.init_reduce_acc() if acc is None \
             else self._place_resume(acc)
         self._last_acc = acc  # device-side, for ensemble_stats()
-        for bi in range(start_block, self.n_blocks):
-            inputs, _ = self.host_inputs(bi)
-            self.state, acc = self.step_acc(self.state, inputs, acc)
-            self._last_acc = acc
-            if on_block is not None:
-                on_block(bi, self.state, acc)
+        pf = InputPrefetcher(self, start_block, self.n_blocks)
+        try:
+            for bi in range(start_block, self.n_blocks):
+                inputs, _ = pf.get(bi)
+                self.state, acc = self.step_acc(self.state, inputs, acc)
+                self._last_acc = acc
+                if on_block is not None:
+                    on_block(bi, self.state, acc)
+        finally:
+            pf.close()
         return {k: self._host_view(v) for k, v in acc.items()}
 
     def _place_resume(self, tree):

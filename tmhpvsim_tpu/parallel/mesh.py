@@ -104,6 +104,9 @@ class ShardedSimulation(Simulation):
             self._block_step_scan2_acc
         )
         self._scan_series_jit = self._build_sharded_scan_series()
+        self._scan2_series_jit = self._build_sharded_scan_series(
+            self._block_step_scan2_series
+        )
         self._series_jit = self._trace_ensemble
 
     def init_state(self):
@@ -169,13 +172,17 @@ class ShardedSimulation(Simulation):
         )
         return jax.jit(mapped, donate_argnums=(0, 2))
 
-    def _build_sharded_scan_series(self):
-        """Ensemble mode's scan-fused step under shard_map: each shard
-        scans its chains and emits LOCAL per-second sums; one psum pair
-        per block replicates the fleet totals — the same single
-        collective per block as the wide ensemble path."""
+    def _build_sharded_scan_series(self, series_fn=None):
+        """Ensemble mode's scan-fused step under shard_map (``series_fn``
+        picks the flat or nested variant): each shard scans its chains and
+        emits LOCAL per-second sums; one psum pair per block replicates
+        the fleet totals — the same single collective per block as the
+        wide ensemble path."""
+        series = (self._block_step_scan_series if series_fn is None
+                  else series_fn)
+
         def fn(state, inputs):
-            state, m_sum, p_sum = self._block_step_scan_series(state, inputs)
+            state, m_sum, p_sum = series(state, inputs)
             return (state, jax.lax.psum(m_sum, CHAIN_AXIS),
                     jax.lax.psum(p_sum, CHAIN_AXIS))
 

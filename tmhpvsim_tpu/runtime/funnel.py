@@ -207,8 +207,15 @@ class SynchronizingFunnel:
             return
         # pop stale heap entries (records that completed and left the cache)
         # until the top is a live pending time — amortised O(log n) vs the
-        # O(n) min(self._cache) scan this replaces
+        # O(n) min(self._cache) scan this replaces.  Guarded: every cached
+        # time is heappushed in put(), but if that invariant is ever broken
+        # (a future direct _cache insert, an exception between the two
+        # writes) the heap runs dry — rebuild it from the cache instead of
+        # letting heappop raise an uncaught IndexError mid-funnel.
         while True:
+            if not self._age_heap:
+                self._age_heap = list(self._cache)
+                heapq.heapify(self._age_heap)
             oldest = heapq.heappop(self._age_heap)
             if oldest in self._cache:
                 break

@@ -24,15 +24,18 @@ a deliberate improvement over the unbounded queues RabbitMQ would grow.
 
 Wire protocol (one JSON object per line, UTF-8):
 
-    {"op": "sub", "exchange": E}                  client -> broker
-    {"op": "pub", "exchange": E, "v": f, "ts": t} client -> broker
-    {"v": f, "ts": t}                             broker -> subscriber
+    {"op": "sub", "exchange": E}                      client -> broker
+    {"op": "pub", "exchange": E, "v": f, "ts_us": n}  client -> broker
+    {"v": f, "ts_us": n}                              broker -> subscriber
 
-``ts`` is the measurement's NAIVE wall time encoded as seconds since the
-epoch *as if UTC*: the apps join on naive fixedclock datetimes, and
-pinning the wire encoding to UTC makes producer and consumer agree even
-when their hosts run different timezones (a naive ``.timestamp()``
-round-trip would skew by the TZ difference).
+``ts_us`` is the measurement's NAIVE wall time encoded as INTEGER
+microseconds since the epoch *as if UTC*: the apps join on naive
+fixedclock datetimes, and pinning the wire encoding to UTC makes
+producer and consumer agree even when their hosts run different
+timezones (a naive ``.timestamp()`` round-trip would skew by the TZ
+difference).  Integer microseconds — not float seconds — because the
+funnel joins on exact datetime equality and a float64 epoch can perturb
+the microsecond field of sub-second times through the json round-trip.
 """
 
 from __future__ import annotations
@@ -44,6 +47,9 @@ import json
 import logging
 from typing import AsyncIterator, Dict, Optional, Set, Tuple
 from urllib.parse import urlparse
+
+#: wire-protocol epoch for the integer-microsecond "ts_us" field
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
 logger = logging.getLogger(__name__)
 
@@ -143,7 +149,7 @@ class TcpFanoutBroker:
                                    line[:100])
                     continue
                 if op == "pub":
-                    v, ts = frame.get("v"), frame.get("ts")
+                    v, ts = frame.get("v"), frame.get("ts_us")
                     exchange = frame.get("exchange")
                     # validate here: forwarding a malformed frame would
                     # crash EVERY subscriber's decode loop, not just the
@@ -157,7 +163,7 @@ class TcpFanoutBroker:
                             line[:100],
                         )
                         continue
-                    out = json.dumps({"v": v, "ts": ts}).encode() + b"\n"
+                    out = json.dumps({"v": v, "ts_us": ts}).encode() + b"\n"
                     for s in self._exchanges.get(exchange, ()):  # fanout
                         s.offer(out)
                 elif op == "sub" and sub is None:
@@ -230,16 +236,18 @@ class TcpTransport:
     async def publish(self, value: float, time: _dt.datetime) -> None:
         # naive wall time -> as-if-UTC epoch (see module docstring: makes
         # the join timezone-independent across hosts); aware datetimes
-        # keep their real instant
+        # keep their real instant.  Wire encoding is INTEGER microseconds
+        # ("ts_us"): the funnel joins on exact datetime equality, and a
+        # float64-epoch round-trip through json can perturb the
+        # microsecond field of sub-second times — integers cannot.
         if time.tzinfo is None:
-            ts = time.replace(tzinfo=_dt.timezone.utc).timestamp()
-        else:
-            ts = time.timestamp()
+            time = time.replace(tzinfo=_dt.timezone.utc)
+        ts_us = round((time - _EPOCH) / _dt.timedelta(microseconds=1))
         # shielded like the AMQP path (metersim.py:43-45): a cancellation
         # mid-publish must not truncate the frame on the wire
         await asyncio.shield(self._send({
             "op": "pub", "exchange": self._exchange,
-            "v": value, "ts": ts,
+            "v": value, "ts_us": ts_us,
         }))
 
     async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
@@ -249,6 +257,6 @@ class TcpTransport:
             if not line:
                 raise ConnectionError("tcp broker closed the connection")
             frame = json.loads(line)
-            # inverse of publish: as-if-UTC epoch -> naive wall time
-            t = _dt.datetime.fromtimestamp(frame["ts"], _dt.timezone.utc)
+            # inverse of publish: integer-us as-if-UTC epoch -> naive wall
+            t = _EPOCH + _dt.timedelta(microseconds=frame["ts_us"])
             yield (t.replace(tzinfo=None), frame["v"])
