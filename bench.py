@@ -463,13 +463,37 @@ def headline() -> None:
     n_total = n_blocks * n_rounds + 1
     variants, sims = _run_variants(n_chains, n_blocks, n_rounds,
                                    variants=shared_variants)
+    ok = {k: v for k, v in variants.items() if "rate" in v}
+    if not ok and platform == "tpu":
+        # every variant ERRORED at the full shape (e.g. remote-compile
+        # failures): step the chain count down before abandoning the TPU —
+        # a small TPU number beats any CPU fallback
+        for smaller in (n_chains // 4, n_chains // 16):
+            print(f"# all variants failed at n_chains={n_chains}; "
+                  f"retrying at {smaller}", file=sys.stderr)
+            n_chains = smaller
+            shared_variants.clear()
+            if watchdog is not None:
+                # re-arm per retry: the full-shape phase may have burned
+                # most of the deadline erroring slowly, and firing the
+                # stale timer mid-retry would os._exit a healthy
+                # smaller-shape run — the exact loss this loop prevents
+                watchdog.cancel()
+                watchdog = threading.Timer(TPU_VARIANTS_DEADLINE_S,
+                                           _wedged)
+                watchdog.daemon = True
+                watchdog.start()
+            variants, sims = _run_variants(n_chains, n_blocks, n_rounds,
+                                           variants=shared_variants)
+            ok = {k: v for k, v in variants.items() if "rate" in v}
+            if ok:
+                break
     if watchdog is not None:
         watchdog.cancel()
 
-    ok = {k: v for k, v in variants.items() if "rate" in v}
     if not ok and not fallback:
-        # the tunnel passed the probe but then ERRORED during the
-        # variants: salvage a labelled CPU number in a fresh process
+        # the tunnel passed the probe but then ERRORED through every
+        # shape: salvage a labelled CPU number in a fresh process
         # (see _salvage_cpu_headline on why in-process won't work)
         print("# all TPU variants failed; salvaging CPU number",
               file=sys.stderr)
@@ -815,12 +839,17 @@ def sweep() -> None:
     variants = [
         ("scan-rbg-u8", 65536, 1080, "rbg", "scan", 8),
         ("scan2-rbg-u8", 65536, 1080, "rbg", "scan2", 8),
+        ("scan2-rbg-u4", 65536, 1080, "rbg", "scan2", 4),
+        ("scan2-rbg-u20", 65536, 1080, "rbg", "scan2", 20),
+        ("scan2-threefry-u8", 65536, 1080, "threefry2x32", "scan2", 8),
         ("scan-rbg-u4", 65536, 1080, "rbg", "scan", 4),
         ("scan-rbg-u16", 65536, 1080, "rbg", "scan", 16),
         ("scan-threefry-u8", 65536, 1080, "threefry2x32", "scan", 8),
         ("wide-rbg", 65536, 1080, "rbg", "wide", 8),
         ("scan-rbg-u8-big", 65536, 4320, "rbg", "scan", 8),
+        ("scan2-rbg-u8-big", 65536, 4320, "rbg", "scan2", 8),
         ("scan-rbg-u8-x4chains", 262144, 1080, "rbg", "scan", 8),
+        ("scan2-rbg-u8-x4chains", 262144, 1080, "rbg", "scan2", 8),
     ]
     n_blocks, n_rounds = (4, 3) if platform == "tpu" else (2, 1)
     for label, n, bs, prng, impl, unroll in variants:

@@ -193,6 +193,77 @@ def test_scan2_impl_matches_scan(run):
                                    err_msg=k)
 
 
+@pytest.mark.parametrize("impl", ["wide", "scan", "scan2"])
+def test_impl_smoke_fast_lane(impl):
+    """FAST-LANE smoke of every block formulation at a tiny shape (the
+    full-shape equivalence tests live in the slow lane): each impl must
+    run reduce AND ensemble mode and agree with itself across modes on
+    the per-second fleet totals.  Keeps a scan/scan2 compile in the
+    default test run so a formulation regression cannot ship through a
+    green fast lane."""
+    cfg = small_config(n_chains=2, duration_s=240, block_s=120,
+                       block_impl=impl)
+    reduced = Simulation(cfg).run_reduced()
+    blocks = list(Simulation(cfg).run_ensemble())
+    assert (reduced["n_seconds"] == 240).all()
+    ens_pv = sum(float(b.pv.sum()) for b in blocks) * cfg.n_chains
+    np.testing.assert_allclose(ens_pv, float(reduced["pv_sum"].sum()),
+                               rtol=1e-4, atol=1e-2)
+    ens_meter = sum(float(b.meter.sum()) for b in blocks) * cfg.n_chains
+    np.testing.assert_allclose(ens_meter,
+                               float(reduced["meter_sum"].sum()),
+                               rtol=1e-4, atol=1e-2)
+
+
+class TestInputPrefetcher:
+    """The host-input prefetcher (worker-thread double-buffering of
+    host_inputs) must be semantically invisible: same pytrees as direct
+    calls, in any access order, including the zero-blocks-left resume."""
+
+    def test_matches_direct_calls(self):
+        from tmhpvsim_tpu.engine.simulation import InputPrefetcher
+
+        a, b = Simulation(small_config()), Simulation(small_config())
+        pf = InputPrefetcher(a, 0, a.n_blocks)
+        try:
+            for bi in range(a.n_blocks):
+                (pi, pe), (di, de) = pf.get(bi), b.host_inputs(bi)
+                np.testing.assert_array_equal(pe, de)
+                ptree, dtree = (dict(pi), dict(di))
+                for leaves in ("block_idx", "win", "geom"):
+                    for k in dtree[leaves]:
+                        np.testing.assert_array_equal(
+                            np.asarray(ptree[leaves][k]),
+                            np.asarray(dtree[leaves][k]), err_msg=k,
+                        )
+        finally:
+            pf.close()
+
+    def test_out_of_order_access(self):
+        from tmhpvsim_tpu.engine.simulation import InputPrefetcher
+
+        a, b = Simulation(small_config()), Simulation(small_config())
+        pf = InputPrefetcher(a, 0, a.n_blocks)
+        try:
+            # consume the LAST block first: the prefetched slot (block 0)
+            # must be bypassed, not returned
+            pi, _ = pf.get(a.n_blocks - 1)
+            di, _ = b.host_inputs(a.n_blocks - 1)
+            np.testing.assert_array_equal(
+                np.asarray(pi["block_idx"]["t"]),
+                np.asarray(di["block_idx"]["t"]),
+            )
+        finally:
+            pf.close()
+
+    def test_zero_blocks_left_resume(self):
+        from tmhpvsim_tpu.engine.simulation import InputPrefetcher
+
+        sim = Simulation(small_config())
+        pf = InputPrefetcher(sim, sim.n_blocks, sim.n_blocks)
+        pf.close()  # nothing was prefetched; nothing should raise
+
+
 def test_ensemble_scan2_matches_scan(run):
     """Ensemble mode's nested (scan2) series step must reproduce the flat
     scan series — same keyed draw slots, so only compiler reassociation
