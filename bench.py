@@ -284,11 +284,17 @@ VARIANT_CFGS = {
                      stats_fusion="fused"),
 }
 
-#: deadline for the TPU variants phase; past it the watchdog emits a
-#: headline from whatever variants already landed (or salvages a CPU
-#: number if none did) and hard-exits — covering the tunnel's HANGING
-#: failure mode (the erroring mode is handled in-line)
+#: no-progress deadline for the TPU variants phase: the watchdog fires
+#: only when NO variant attempt has finished (landed or errored) for this
+#: long — i.e. the tunnel's HANGING mode.  Slow-but-erroring progress
+#: (the other observed mode) keeps resetting the clock so the chain-count
+#: step-down retries get their chance.  On firing it emits a headline
+#: from whatever variants already landed, else salvages a CPU number.
 TPU_VARIANTS_DEADLINE_S = 900.0
+
+#: absolute cap on the whole TPU headline phase, hangs and retries
+#: included — past it the watchdog fires regardless of progress
+TPU_HEADLINE_TOTAL_S = 3600.0
 
 #: every measured variant/config is appended here the moment it lands, so
 #: a tunnel drop (or SIGKILL) mid-run still leaves TPU numbers on disk —
@@ -332,13 +338,16 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
 
 
 def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
-                  note: str = "", variants: dict | None = None
-                  ) -> tuple[dict, dict]:
+                  note: str = "", variants: dict | None = None,
+                  on_progress=None) -> tuple[dict, dict]:
     """Measure the variant matrix once; returns (variants, sims).
 
     ``variants`` may be a caller-shared dict (the watchdog reads it to
     salvage partial results if the tunnel wedges mid-matrix); every
-    completed entry is also journalled to ``PARTIAL_PATH``."""
+    completed entry is also journalled to ``PARTIAL_PATH``.
+    ``on_progress()`` is called after every attempt — landed OR errored —
+    so the hang watchdog can distinguish a slow-but-erroring tunnel
+    (progress: let the step-down retries run) from a wedged one."""
     from tmhpvsim_tpu.engine import Simulation
 
     n_total = n_blocks * n_rounds + 1
@@ -362,6 +371,8 @@ def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
         except Exception as e:
             print(f"# variant {name} failed{note}: {e}", file=sys.stderr)
             variants[name] = {"error": str(e)[:200]}
+        if on_progress is not None:
+            on_progress()
     return variants, sims
 
 
@@ -403,14 +414,21 @@ def headline() -> None:
     import jax
 
     shared_variants: dict = {}
+    monitor_state = {"last_progress": time.monotonic(),
+                     "t0": time.monotonic(), "done": False}
     if platform == "tpu":
         n_chains, n_blocks, n_rounds = N_CHAINS, N_BLOCKS, N_ROUNDS
-        # watchdog for the hanging failure mode: if the variants phase
-        # wedges (block_until_ready on a dead tunnel never returns), a
-        # daemon timer emits a headline from the variants that already
-        # landed — REAL TPU numbers beat a CPU fallback — else salvages a
-        # CPU number, and hard-exits with rc=0 instead of the harness
-        # recording rc=124 and nothing else (the round-4 failure mode)
+        # watchdog for the HANGING failure mode only: a monitor thread
+        # fires when no variant attempt has finished (landed or errored)
+        # for TPU_VARIANTS_DEADLINE_S — block_until_ready on a dead tunnel
+        # never returns — or when the whole phase exceeds
+        # TPU_HEADLINE_TOTAL_S.  A slow-but-ERRORING tunnel keeps making
+        # progress, so the chain-count step-down retries below get their
+        # chance instead of being os._exit'd mid-flight.  On firing it
+        # emits a headline from the variants that already landed — REAL
+        # TPU numbers beat a CPU fallback — else salvages a CPU number,
+        # and hard-exits with rc=0 instead of the harness recording
+        # rc=124 and nothing else (the round-4 failure mode).
         import threading
 
         def _wedged():
@@ -441,8 +459,18 @@ def headline() -> None:
                 }))
             os._exit(0)
 
-        watchdog = threading.Timer(TPU_VARIANTS_DEADLINE_S, _wedged)
-        watchdog.daemon = True
+        def _monitor():
+            while not monitor_state["done"]:
+                time.sleep(5)
+                now = time.monotonic()
+                if monitor_state["done"]:
+                    return
+                if (now - monitor_state["last_progress"]
+                        > TPU_VARIANTS_DEADLINE_S or
+                        now - monitor_state["t0"] > TPU_HEADLINE_TOTAL_S):
+                    _wedged()
+
+        watchdog = threading.Thread(target=_monitor, daemon=True)
         watchdog.start()
     else:
         # scaled-down run for ANY non-TPU platform — including an
@@ -460,36 +488,32 @@ def headline() -> None:
     except Exception as e:  # single-process bench must not die on this
         print(f"# jax.distributed init skipped: {e}", file=sys.stderr)
 
+    def _progress():
+        monitor_state["last_progress"] = time.monotonic()
+
     n_total = n_blocks * n_rounds + 1
     variants, sims = _run_variants(n_chains, n_blocks, n_rounds,
-                                   variants=shared_variants)
+                                   variants=shared_variants,
+                                   on_progress=_progress)
     ok = {k: v for k, v in variants.items() if "rate" in v}
     if not ok and platform == "tpu":
         # every variant ERRORED at the full shape (e.g. remote-compile
         # failures): step the chain count down before abandoning the TPU —
-        # a small TPU number beats any CPU fallback
+        # a small TPU number beats any CPU fallback.  The monitor only
+        # fires on NO-PROGRESS, so these retries run as long as attempts
+        # keep finishing (hang mid-retry still trips it).
         for smaller in (n_chains // 4, n_chains // 16):
             print(f"# all variants failed at n_chains={n_chains}; "
                   f"retrying at {smaller}", file=sys.stderr)
             n_chains = smaller
             shared_variants.clear()
-            if watchdog is not None:
-                # re-arm per retry: the full-shape phase may have burned
-                # most of the deadline erroring slowly, and firing the
-                # stale timer mid-retry would os._exit a healthy
-                # smaller-shape run — the exact loss this loop prevents
-                watchdog.cancel()
-                watchdog = threading.Timer(TPU_VARIANTS_DEADLINE_S,
-                                           _wedged)
-                watchdog.daemon = True
-                watchdog.start()
             variants, sims = _run_variants(n_chains, n_blocks, n_rounds,
-                                           variants=shared_variants)
+                                           variants=shared_variants,
+                                           on_progress=_progress)
             ok = {k: v for k, v in variants.items() if "rate" in v}
             if ok:
                 break
-    if watchdog is not None:
-        watchdog.cancel()
+    monitor_state["done"] = True
 
     if not ok and not fallback:
         # the tunnel passed the probe but then ERRORED through every

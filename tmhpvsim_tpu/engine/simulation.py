@@ -913,6 +913,10 @@ class Simulation:
             else self._place_resume(state)
         self.state = state
         pf = InputPrefetcher(self, start_block, self.n_blocks)
+        # No dispatch-ahead here: consumers checkpoint ``self.state`` after
+        # processing the yielded block (apps/pvsim.py), so the state must
+        # always correspond to the LAST YIELDED block.  Host/device overlap
+        # comes from the input prefetcher + async jax dispatch instead.
         try:
             for bi in range(start_block, self.n_blocks):
                 inputs, epoch = pf.get(bi)
