@@ -1,0 +1,71 @@
+"""Unit tests for bench.py's outage-resilience logic (pure host logic —
+no JAX device work): the partial-results journal, the headline-document
+builder the watchdog shares with the normal path, and the config
+block_s step-down.  These paths only fire during tunnel failures, so
+without tests they would only ever be exercised mid-outage."""
+
+import json
+
+import bench
+
+
+def test_headline_doc_picks_best_rate():
+    variants = {
+        "scan-rbg": {"rate": 100.0, "compile_s": 1.0},
+        "scan2-rbg": {"rate": 250.0, "compile_s": 2.0},
+        "wide-rbg": {"error": "compile failed"},
+    }
+    doc = bench._headline_doc(variants, "tpu", n_chains=64)
+    assert doc["headline_variant"] == "scan2-rbg"
+    assert doc["value"] == 250.0
+    assert doc["tpu"] is True
+    assert doc["n_chains"] == 64
+    assert doc["variants"]["wide-rbg"] == {"error": "compile failed"}
+    assert doc["vs_baseline"] == round(250.0 / bench.REF_CEILING, 1)
+    assert doc["north_star_frac"] == round(250.0 / bench.NORTH_STAR, 3)
+
+
+def test_persist_partial_appends_json_lines(tmp_path, monkeypatch):
+    p = tmp_path / "journal.jsonl"
+    monkeypatch.setattr(bench, "PARTIAL_PATH", str(p))
+    bench._persist_partial({"phase": "headline-variant", "rate": 1.0})
+    bench._persist_partial({"phase": "config", "value": 2.0})
+    lines = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert [ln["phase"] for ln in lines] == ["headline-variant", "config"]
+    assert all("ts" in ln for ln in lines)  # landing time recorded
+
+
+def test_config_stepdown_retries_smaller_blocks(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "PARTIAL_PATH", str(tmp_path / "j.jsonl"))
+    attempts = []
+
+    def fake_run(label, cfg, sharded, note, scaled_from=None):
+        attempts.append((cfg, note))
+        if cfg < 4320:  # "cfg" is the block_s passed through make_cfg_bs
+            return
+        raise RuntimeError(f"remote compile failed at block_s={cfg}")
+
+    monkeypatch.setattr(bench, "_reduce_config_run", fake_run)
+    bench._reduce_config_run_resilient(
+        "t", lambda bs: bs, sharded=False, note="base note"
+    )
+    assert [a[0] for a in attempts] == [8640, 4320, 1080]
+    assert "stepped down to 1080" in attempts[-1][1]
+    assert "remote compile failed" in attempts[-1][1]
+
+
+def test_config_stepdown_exhaustion_emits_error_doc(tmp_path, monkeypatch,
+                                                    capsys):
+    monkeypatch.setattr(bench, "PARTIAL_PATH", str(tmp_path / "j.jsonl"))
+
+    def always_fail(label, cfg, sharded, note, scaled_from=None):
+        raise RuntimeError("tunnel dead")
+
+    monkeypatch.setattr(bench, "_reduce_config_run", always_fail)
+    bench._reduce_config_run_resilient(
+        "t", lambda bs: bs, sharded=False, note="n"
+    )
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["config"] == "t"
+    assert doc["error"] == "tunnel dead"
+    assert doc["block_s_tried"] == [8640, 4320, 1080]
