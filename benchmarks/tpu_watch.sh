@@ -1,0 +1,15 @@
+#!/bin/bash
+# Watches tpu_status.txt; the moment the probe reports TPU_UP, launches
+# the round-5 benchmark battery (once). Separate from tpu_probe.sh so the
+# running probe loop's script file is never edited in place.
+STATUS=/root/repo/benchmarks/tpu_status.txt
+SENTINEL=/root/repo/benchmarks/BATTERY_LAUNCHED
+while true; do
+  if grep -q '^TPU_UP' "$STATUS" 2>/dev/null && [ ! -e "$SENTINEL" ]; then
+    touch "$SENTINEL"
+    echo "launching battery $(date -u +%FT%TZ)" >> "$SENTINEL"
+    /root/repo/benchmarks/run_tpu_round5.sh
+    exit 0
+  fi
+  sleep 30
+done
