@@ -318,6 +318,38 @@ def _persist_partial(record: dict) -> None:
         print(f"# partial-result persist failed: {e}", file=sys.stderr)
 
 
+def _last_tpu_evidence() -> dict | None:
+    """Most recent REAL-TPU headline this checkout has produced, for
+    attachment to a cpu-fallback artifact — so a tunnel that was up
+    mid-round but down at harvest time still shows its numbers in the
+    final JSON instead of only in git history.  The journal is consulted
+    FIRST: every in-process headline (battery runs included) lands
+    there, so it is always at least as fresh as the committed
+    HEADLINE_r05.json, which only matters on a fresh clone where the
+    gitignored journal does not exist."""
+    try:
+        with open(PARTIAL_PATH) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        lines = []
+    for ln in reversed(lines):
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("phase") == "headline" and rec.get("platform") == "tpu":
+            return rec
+    bench_dir = os.path.dirname(PARTIAL_PATH)
+    try:
+        with open(os.path.join(bench_dir, "HEADLINE_r05.json")) as f:
+            doc = json.loads(f.read().strip().splitlines()[-1])
+        if doc.get("platform") == "tpu":
+            return doc
+    except (OSError, json.JSONDecodeError, IndexError):
+        pass
+    return None
+
+
 def _headline_doc(variants: dict, platform: str, **extra) -> dict:
     """The headline JSON from whatever variants have landed (shared by
     the normal path and the watchdog's partial-salvage path)."""
@@ -526,11 +558,16 @@ def headline() -> None:
         if _salvage_cpu_headline(variants):
             return
     if not ok:
-        print(json.dumps({"metric": "simulated site-seconds/sec/chip",
-                          "value": 0.0, "unit": "site-s/s/chip",
-                          "vs_baseline": 0.0, "platform": platform,
-                          "error": "all variants failed",
-                          "variants": variants}))
+        err_doc = {"metric": "simulated site-seconds/sec/chip",
+                   "value": 0.0, "unit": "site-s/s/chip",
+                   "vs_baseline": 0.0, "platform": platform,
+                   "error": "all variants failed",
+                   "variants": variants}
+        if platform != "tpu":
+            evidence = _last_tpu_evidence()
+            if evidence is not None:
+                err_doc["last_tpu_headline"] = evidence
+        print(json.dumps(err_doc))
         return
     best_name = max(ok, key=lambda k: ok[k]["rate"])
     rate = ok[best_name]["rate"]
@@ -571,6 +608,10 @@ def headline() -> None:
         roofline=roofline, sharded=sharded,
     )
     _persist_partial({"phase": "headline", **doc})
+    if platform != "tpu":
+        evidence = _last_tpu_evidence()
+        if evidence is not None:
+            doc["last_tpu_headline"] = evidence
     print(json.dumps(doc))
     monitor_state["done"] = True  # headline printed; stand the monitor down
 

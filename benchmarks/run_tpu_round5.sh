@@ -11,13 +11,34 @@ cd /root/repo
 LOG=benchmarks/tpu_round5.log
 echo "=== battery start $(date -u +%FT%TZ)" >> "$LOG"
 
+# Top-level platform check (NOT grep: a cpu-fallback doc can embed a
+# previous TPU headline under "last_tpu_headline", whose nested
+# '"platform": "tpu"' must not count).
+is_tpu_artifact () {
+  python - "$1" <<'EOF'
+import json, sys
+ok = False
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        doc = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if doc.get("platform") == "tpu":
+        ok = True
+sys.exit(0 if ok else 1)
+EOF
+}
+
 run_json () {  # run_json <dest.json> <label> <args...>
   local dest="$1" label="$2"; shift 2
   echo "--- $label start $(date -u +%FT%TZ)" >> "$LOG"
   python bench.py "$@" > "$dest.tmp" 2>> "$LOG"
   local rc=$?
   echo "--- $label rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
-  if [ $rc -eq 0 ] && grep -q '"platform": "tpu"' "$dest.tmp"; then
+  if [ $rc -eq 0 ] && is_tpu_artifact "$dest.tmp"; then
     mv "$dest.tmp" "$dest"
     echo "--- $label: TPU artifact written to $dest" >> "$LOG"
   else

@@ -3,13 +3,16 @@
 # the round-5 benchmark battery (once). Separate from tpu_probe.sh so the
 # running probe loop's script file is never edited in place.
 STATUS=/root/repo/benchmarks/tpu_status.txt
-SENTINEL=/root/repo/benchmarks/BATTERY_LAUNCHED
+DONE=/root/repo/benchmarks/BATTERY_DONE
+LAUNCH_LOG=/root/repo/benchmarks/BATTERY_LAUNCHED
+# Completion — not launch — is the skip condition: a watcher restarted
+# after a mid-battery crash must relaunch (BATTERY_DONE is only written
+# by the battery's last line).  Within one watcher process the `exec`
+# below prevents double-launch.
 while true; do
-  if grep -q '^TPU_UP' "$STATUS" 2>/dev/null && [ ! -e "$SENTINEL" ]; then
-    touch "$SENTINEL"
-    echo "launching battery $(date -u +%FT%TZ)" >> "$SENTINEL"
-    /root/repo/benchmarks/run_tpu_round5.sh
-    exit 0
+  if grep -q '^TPU_UP' "$STATUS" 2>/dev/null && [ ! -e "$DONE" ]; then
+    echo "launching battery $(date -u +%FT%TZ)" >> "$LAUNCH_LOG"
+    exec /root/repo/benchmarks/run_tpu_round5.sh
   fi
   sleep 30
 done
