@@ -151,6 +151,16 @@ class TestIrradiance:
         assert cap[0] == pytest.approx(1.08, abs=0.02)
         assert cap[1] > 2.0
 
+    def test_csi_cap_finite_in_float32_at_night(self):
+        """Below the horizon the raw enhancement fit reaches ~1e39, which
+        overflowed the device float32 cast (min(csi, cap) makes any large
+        ceiling equivalent, so the cap is clamped).  Night zenith here is
+        142 deg — the deepest the default site reaches."""
+        cap = solar.csi_zenith_cap(np.array([2.48, np.pi]), xp=np)
+        cap32 = cap.astype(np.float32)
+        assert np.isfinite(cap32).all()
+        assert (cap32 > 100.0).all()  # still far above any physical csi
+
     def test_linke_turbidity_interpolation(self):
         monthly = Site().linke_turbidity_monthly
         tl = solar.linke_turbidity(np.arange(1.0, 366.0), monthly, xp=np)

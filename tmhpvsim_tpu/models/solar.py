@@ -312,7 +312,13 @@ def csi_zenith_cap(zenith, xp=jnp):
     while low sun admits large cloud-enhancement spikes.
     """
     cos_z = xp.cos(zenith)
-    return 27.21 * xp.exp(-114.0 * cos_z) + 1.665 * xp.exp(-4.494 * cos_z) + 1.08
+    cap = (27.21 * xp.exp(-114.0 * cos_z)
+           + 1.665 * xp.exp(-4.494 * cos_z) + 1.08)
+    # Below the horizon the fit explodes (exp(90) ~ 1e39 at night), which
+    # overflows the float32 cast on device.  The cap's only consumer is
+    # ``minimum(csi, cap)`` and csi stays O(1), so any ceiling >> the
+    # physical enhancement limit is equivalent — clamp to keep it finite.
+    return xp.minimum(cap, 1e6)
 
 
 def disc_dni(ghi, zenith, doy, xp=jnp):
