@@ -1,0 +1,43 @@
+"""Calendar edge-case soaks (slow lane): multi-day scan-fused reduce
+runs across every hazardous calendar transition the windowed sampler
+arrays must survive — DST in both directions (the local time grid
+repeats/skips an hour, stressing the hour-index window bounds,
+engine/simulation.py host_inputs), the year boundary (day-of-year wrap
+feeding the turbidity interpolation and Spencer extraterrestrial
+radiation), and a leap day.  The October fall-back soak is the case
+that surfaced the float32 csi-cap overflow (models/solar.py)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine import Simulation
+
+CASES = {
+    "fall-dst": ("2019-10-26 00:00:00", 3 * 86400),   # CEST->CET repeat
+    "spring-dst": ("2019-03-30 00:00:00", 3 * 86400),  # CET->CEST skip
+    "year-wrap": ("2019-12-30 00:00:00", 3 * 86400),   # doy 365 -> 1
+    "leap-day": ("2020-02-28 00:00:00", 2 * 86400),    # Feb 29 exists
+}
+
+
+# slow lane via the conftest registry (_SLOW_LANE), not a decorator
+@pytest.mark.parametrize("case", list(CASES), ids=list(CASES))
+def test_calendar_edge_soak(case):
+    start, dur = CASES[case]
+    cfg = SimConfig(start=start, duration_s=dur, n_chains=4, seed=5,
+                    block_s=8640, dtype="float32", block_impl="scan")
+    # warnings filters (unlike np.errstate) are process-global, so an
+    # overflow warning raised in the InputPrefetcher worker thread
+    # becomes an exception there and surfaces through fut.result() —
+    # this is exactly how the csi-cap overflow was caught
+    with warnings.catch_warnings():
+        warnings.filterwarnings("error", message=".*overflow.*")
+        stats = Simulation(cfg).run_reduced()
+    assert (stats["n_seconds"] == dur).all()
+    for k, v in stats.items():
+        assert np.isfinite(v).all(), k
+    assert (stats["pv_max"] >= 0).all()
+    assert (stats["pv_max"] <= 260.0).all()  # <= inverter-class ceiling
