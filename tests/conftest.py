@@ -119,9 +119,10 @@ _SLOW_LANE = {
     "test_identical_grid_matches_shared_site",
     "test_checkpoint_echo_catches_grid_change",
     "test_end_to_end_block",
-    # multi-day calendar-transition soaks (DST both ways, year wrap,
-    # leap day) — tests/test_calendar_edges.py
+    # multi-day calendar-transition + latitude-extreme soaks
+    # (tests/test_calendar_edges.py)
     "test_calendar_edge_soak",
+    "test_latitude_extreme_soak",
 }
 
 

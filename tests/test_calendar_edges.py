@@ -1,11 +1,14 @@
-"""Calendar edge-case soaks (slow lane): multi-day scan-fused reduce
-runs across every hazardous calendar transition the windowed sampler
-arrays must survive — DST in both directions (the local time grid
-repeats/skips an hour, stressing the hour-index window bounds,
-engine/simulation.py host_inputs), the year boundary (day-of-year wrap
-feeding the turbidity interpolation and Spencer extraterrestrial
-radiation), and a leap day.  The October fall-back soak is the case
-that surfaced the float32 csi-cap overflow (models/solar.py)."""
+"""Calendar and latitude edge-case soaks (slow lane): multi-day
+scan-fused reduce runs across every hazardous calendar transition the
+windowed sampler arrays must survive — DST in both directions (the
+local time grid repeats/skips an hour, stressing the hour-index window
+bounds, engine/simulation.py host_inputs), the year boundary
+(day-of-year wrap feeding the turbidity interpolation and Spencer
+extraterrestrial radiation), a leap day — plus the solar-geometry
+extremes (polar night, midnight sun, southern hemisphere, equator)
+where the device-side per-site geometry's twilight guards do the most
+work.  The October fall-back soak is the case that surfaced the
+float32 csi-cap overflow (models/solar.py)."""
 
 import warnings
 
@@ -41,3 +44,34 @@ def test_calendar_edge_soak(case):
         assert np.isfinite(v).all(), k
     assert (stats["pv_max"] >= 0).all()
     assert (stats["pv_max"] <= 260.0).all()  # <= inverter-class ceiling
+
+
+LAT_CASES = {
+    # polar night: the sun never rises -> exactly zero output
+    "polar-night-68N": ((67.5, 68.5), "2019-12-20 00:00:00", "zero"),
+    # midnight sun: the sun never sets -> output through local midnight
+    "midnight-sun-68N": ((67.5, 68.5), "2019-06-20 00:00:00", "power"),
+    "southern-35S-summer": ((-35.5, -34.5), "2019-12-20 00:00:00", "power"),
+    "equator-equinox": ((-0.5, 0.5), "2019-03-20 00:00:00", "power"),
+}
+
+
+@pytest.mark.parametrize("case", list(LAT_CASES), ids=list(LAT_CASES))
+def test_latitude_extreme_soak(case):
+    from tmhpvsim_tpu.config import SiteGrid
+
+    (la0, la1), start, expect = LAT_CASES[case]
+    grid = SiteGrid.regular((la0, la1), (10.0, 11.0), 2, 2)
+    cfg = SimConfig(start=start, duration_s=86400, n_chains=4, seed=9,
+                    block_s=8640, dtype="float32", block_impl="scan",
+                    site_grid=grid)
+    with warnings.catch_warnings():
+        warnings.filterwarnings("error", message=".*overflow.*")
+        stats = Simulation(cfg).run_reduced()
+    for k, v in stats.items():
+        assert np.isfinite(v).all(), k
+    if expect == "zero":
+        assert (stats["pv_max"] == 0.0).all()
+    else:
+        assert (stats["pv_max"] > 50.0).all()
+        assert (stats["pv_max"] <= 260.0).all()
