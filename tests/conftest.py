@@ -114,6 +114,7 @@ _SLOW_LANE = {
     "test_resume_bit_exact",
     "test_reduce_resume_bit_exact",
     "test_resume_bit_exact_rbg_keys",
+    "test_resume_bit_exact_across_dst_boundary",
     "test_resume_equals_straight_run",
     # site-grid engine at full shapes
     "test_identical_grid_matches_shared_site",

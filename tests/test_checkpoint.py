@@ -90,6 +90,39 @@ def test_reduce_resume_bit_exact(tmp_path):
         np.testing.assert_array_equal(resumed[k], straight[k])
 
 
+def test_resume_bit_exact_across_dst_boundary(tmp_path):
+    """Checkpoint INSIDE the CEST->CET fall-back night and resume: the
+    windowed sampler regeneration must reproduce the straight run bit
+    for bit even when the resume point's local-time hour grid repeats an
+    hour (the hour-window rebasing in host_inputs is keyed by global
+    index, so a resume re-derives identical windows)."""
+    dst_cfg = dict(start="2019-10-26 22:00:00", duration_s=4 * 3600,
+                   block_s=3600, block_impl="scan")
+    straight = Simulation(cfg(**dst_cfg)).run_reduced()
+
+    path = str(tmp_path / "dst.npz")
+    a = Simulation(cfg(**dst_cfg))
+
+    class Stop(Exception):
+        pass
+
+    def save_then_crash(bi, state, acc):
+        ckpt.save(path, {"state": state, "acc": acc}, bi + 1, a.config)
+        if bi == 1:  # stop mid-run, two blocks before the repeated hour
+            raise Stop
+
+    with pytest.raises(Stop):
+        a.run_reduced(on_block=save_then_crash)
+
+    b = Simulation(cfg(**dst_cfg))
+    tree, nb = ckpt.load(path, b.config)
+    assert nb == 2
+    resumed = b.run_reduced(state=tree["state"], acc=tree["acc"],
+                            start_block=nb)
+    for k in straight:
+        np.testing.assert_array_equal(resumed[k], straight[k])
+
+
 def test_resume_bit_exact_rbg_keys(tmp_path):
     """Checkpoint round-trip with prng_impl='rbg': key_data is 4 words
     instead of threefry's 2, so the impl must ride the checkpoint metadata
