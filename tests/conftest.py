@@ -124,6 +124,14 @@ _SLOW_LANE = {
     # (tests/test_calendar_edges.py)
     "test_calendar_edge_soak",
     "test_latitude_extreme_soak",
+    # mid-weight tier moved to keep the default lane ~2 min on this
+    # 1-core host; each has a cheaper fast-lane sibling
+    "test_sensitivity_rejects_swapped_branches",
+    "test_sharded_reduce_resume_with_zero_blocks_left",
+    "test_counts_only_valid_seconds",
+    "test_sites_actually_differ",
+    "test_rbg_keys_survive_configless_save",
+    "test_cli_pvsim_site_grid",
 }
 
 
