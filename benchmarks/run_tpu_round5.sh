@@ -51,7 +51,6 @@ run_json benchmarks/HEADLINE_r05.json  headline
 run_json benchmarks/SWEEP_r05.jsonl    sweep     --sweep
 run_json benchmarks/BENCH_config4.json config4   --config 4
 run_json benchmarks/BENCH_config2.json config2   --config 2
-run_json benchmarks/BENCH_config3.json config3   --config 3
 # --scaling is the virtual-CPU-mesh mechanics artifact (CPU by design,
 # no TPU gate): regenerate it alongside the TPU numbers per the round-4
 # verdict, replacing only on success.
@@ -61,5 +60,13 @@ if python bench.py --scaling > benchmarks/SCALING.json.tmp 2>> "$LOG"; then
 fi
 echo "--- profile start $(date -u +%FT%TZ)" >> "$LOG"
 python bench.py --profile benchmarks/profile_r05 >> "$LOG" 2>&1
+# config 3 LAST: its full-year 10k-site run is by far the longest step
+# (hours at realistic rates); everything shorter must land first.  The
+# quick 30-day slice (own artifact, own invocation) lands before the
+# full-year attempt, so even a mid-run drop leaves a TPU number for
+# the 10k-site shape; BENCH_config3.json is only ever replaced by a
+# genuine full-year TPU doc.
+run_json benchmarks/BENCH_config3a.json config3a --config 3a
+run_json benchmarks/BENCH_config3.json  config3  --config 3
 echo "=== battery done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE
