@@ -773,34 +773,37 @@ def config_2() -> None:
     )
 
 
-def _grid_10k():
+def _config_3_like(label: str, duration_s: int, note: str,
+                   scaled_from: str | None) -> None:
+    """Shared body of configs 3/3a: the 10k-site lat/lon grid with
+    per-site device geometry, at the given duration."""
     from tmhpvsim_tpu.config import SiteGrid
 
-    return SiteGrid.regular((45.0, 55.0), (5.0, 15.0), 100, 100)
+    platform, fallback = _probe_or_fallback()
+    grid = SiteGrid.regular((45.0, 55.0), (5.0, 15.0), 100, 100)
+    if platform != "tpu":
+        _reduce_config_run(
+            label, _make_cfg(len(grid), 2, block_s=4320, site_grid=grid),
+            sharded=False, note="cpu-fallback: duration scaled to 2 blocks",
+            scaled_from="10k sites x 1 year",
+        )
+        return
+    _reduce_config_run_resilient(
+        label,
+        lambda bs: _make_cfg(len(grid), duration_s // bs, block_s=bs,
+                             site_grid=grid),
+        sharded=False, note=note, scaled_from=scaled_from,
+    )
 
 
 def config_3a() -> None:
     """Quick 30-day slice of config 3, its own artifact: the full year at
     10k sites is the longest config (~3.15e12 site-seconds with
     per-site device geometry), and a short tunnel window must not leave
-    config 3 empty-handed — this lands in minutes, disclosed as
+    the 10k-site shape unmeasured — this lands in minutes, disclosed as
     scaled."""
-    platform, fallback = _probe_or_fallback()
-    grid = _grid_10k()
-    month = 30 * 86_400
-    if platform != "tpu":
-        _reduce_config_run(
-            "3a: 10k-site grid x 30 days",
-            _make_cfg(len(grid), 2, block_s=4320, site_grid=grid),
-            sharded=False, note="cpu-fallback: duration scaled to 2 blocks",
-            scaled_from="10k sites x 1 year",
-        )
-        return
-    _reduce_config_run_resilient(
-        "3a: 10k-site grid x 30 days",
-        lambda bs: _make_cfg(len(grid), month // bs, block_s=bs,
-                             site_grid=grid),
-        sharded=False,
+    _config_3_like(
+        "3a: 10k-site grid x 30 days", 30 * 86_400,
         note=("30-day run, 100x100 lat/lon grid over central Europe, "
               "solar geometry evaluated per site on device"),
         scaled_from="10k sites x 1 year",
@@ -809,24 +812,11 @@ def config_3a() -> None:
 
 def config_3() -> None:
     """10k-site lat/lon grid, 1 year, device-side per-site geometry."""
-    platform, fallback = _probe_or_fallback()
-    grid = _grid_10k()
-    year = 365 * 86_400
-    if platform != "tpu":
-        _reduce_config_run(
-            "3: 10k-site grid x 1 year",
-            _make_cfg(len(grid), 2, block_s=4320, site_grid=grid),
-            sharded=False, note="cpu-fallback: duration scaled to 2 blocks",
-            scaled_from="10k sites x 1 year",
-        )
-        return
-    _reduce_config_run_resilient(
-        "3: 10k-site grid x 1 year",
-        lambda bs: _make_cfg(len(grid), year // bs, block_s=bs,
-                             site_grid=grid),
-        sharded=False,
+    _config_3_like(
+        "3: 10k-site grid x 1 year", 365 * 86_400,
         note=("full 1-year run, 100x100 lat/lon grid over central "
               "Europe, solar geometry evaluated per site on device"),
+        scaled_from=None,
     )
 
 

@@ -3,7 +3,9 @@
 #   nohup benchmarks/run_tpu_round5.sh >/dev/null 2>&1 &
 # Sequential single processes, no timeouts (see tpu_probe.sh header on
 # why), most-important-first so a mid-battery tunnel drop costs the least:
-# headline -> sweep -> configs 4,2,3 -> scaling -> profile.
+# headline -> sweep -> configs 4,2 -> scaling -> profile -> config 3a
+# (quick 30-day slice) -> config 3 (full year; by far the longest, so
+# it runs last).
 # Config artifacts are only replaced when the new run measured real TPU
 # (a cpu-fallback result must never overwrite a TPU artifact).
 set -u

@@ -109,6 +109,27 @@ class TestFunnel:
         assert size == 100
         assert evicted == 400
 
+    def test_eviction_survives_broken_heap_invariant(self):
+        """White-box guard: every cached time is normally heappushed in
+        put(), but if that invariant is ever broken (a future direct
+        _cache insert), eviction must rebuild the age heap from the
+        cache instead of raising IndexError from an empty heap — and
+        must still evict oldest-first."""
+
+        async def go():
+            out = asyncio.Queue()
+            funnel = SynchronizingFunnel(Data, out, max_pending=3)
+            for t in range(3):
+                await funnel.put(t, meter=float(t))
+            # violate the invariant: cached entries with no heap records
+            funnel._age_heap.clear()
+            await funnel.put(3, meter=3.0)  # must evict t=0, not raise
+            return sorted(funnel._cache), funnel.n_evicted
+
+        cached, evicted = run(go())
+        assert evicted == 1
+        assert cached == [1, 2, 3]  # oldest evicted even with a dry heap
+
     def test_backpressure_bounds_lookahead(self):
         """A producer must block once it is max_lookahead past the slowest
         other stream, and resume when that stream advances — the guard

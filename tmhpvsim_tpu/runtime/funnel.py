@@ -208,12 +208,18 @@ class SynchronizingFunnel:
         # pop stale heap entries (records that completed and left the cache)
         # until the top is a live pending time — amortised O(log n) vs the
         # O(n) min(self._cache) scan this replaces.  Guarded: every cached
-        # time is heappushed in put(), but if that invariant is ever broken
-        # (a future direct _cache insert, an exception between the two
-        # writes) the heap runs dry — rebuild it from the cache instead of
-        # letting heappop raise an uncaught IndexError mid-funnel.
+        # time is heappushed in put(), so the heap always holds a superset
+        # of the cached times and this loop cannot run dry.  If that
+        # invariant is ever broken by future code (a direct _cache insert,
+        # an exception between the two writes), the cheap length check
+        # below catches it BEST-EFFORT (stale heap entries can mask
+        # missing ones) and rebuilds the heap from the cache — restoring
+        # oldest-first eviction in the detected cases and, above all,
+        # guaranteeing heappop never raises IndexError mid-funnel.  An
+        # exact set-comparison guard would detect every break but cost
+        # O(n) per eviction, which is the scan this heap exists to avoid.
         while True:
-            if not self._age_heap:
+            if len(self._age_heap) < len(self._cache):
                 self._age_heap = list(self._cache)
                 heapq.heapify(self._age_heap)
             oldest = heapq.heappop(self._age_heap)
