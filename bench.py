@@ -926,20 +926,34 @@ def sweep() -> None:
     # scale down on anything that is not real TPU hardware (including an
     # env-pinned CPU backend, where the probe "succeeds" on CPU)
     scale = 1 if platform == "tpu" else 256
+    # Matrix rewritten live in round 5 after the headline landed: rbg
+    # inside the scan formulations measured ~76x slower than threefry on
+    # the tunnel TPU (scan-rbg 8.8e6 vs scan-threefry 6.7e8 site-s/s/chip
+    # — the vmapped per-chain RngBitGenerator draws serialize), so the
+    # rbg x {unroll, block_s} half of the old matrix answers a dead
+    # question.  What we need now: (a) does per-step scan overhead
+    # dominate (rate should rise ~linearly with n_chains if so), (b) the
+    # best unroll for scan-threefry, (c) whether scan2 — whose O(1)
+    # state admits 1M+ chains — wins once chains amortise the overhead,
+    # (d) wide at 4x chains / 4x block_s as the bandwidth-bound control.
     variants = [
-        ("scan-rbg-u8", 65536, 1080, "rbg", "scan", 8),
-        ("scan2-rbg-u8", 65536, 1080, "rbg", "scan2", 8),
-        ("scan2-rbg-u4", 65536, 1080, "rbg", "scan2", 4),
-        ("scan2-rbg-u20", 65536, 1080, "rbg", "scan2", 20),
-        ("scan2-threefry-u8", 65536, 1080, "threefry2x32", "scan2", 8),
-        ("scan-rbg-u4", 65536, 1080, "rbg", "scan", 4),
-        ("scan-rbg-u16", 65536, 1080, "rbg", "scan", 16),
         ("scan-threefry-u8", 65536, 1080, "threefry2x32", "scan", 8),
+        ("scan-threefry-u4", 65536, 1080, "threefry2x32", "scan", 4),
+        ("scan-threefry-u16", 65536, 1080, "threefry2x32", "scan", 16),
+        ("scan-threefry-u32", 65536, 1080, "threefry2x32", "scan", 32),
+        ("scan-threefry-u8-x4chains", 262144, 1080, "threefry2x32",
+         "scan", 8),
+        ("scan-threefry-u8-big", 65536, 4320, "threefry2x32", "scan", 8),
+        ("scan2-threefry-u8", 65536, 1080, "threefry2x32", "scan2", 8),
+        ("scan2-threefry-u20", 65536, 1080, "threefry2x32", "scan2", 20),
+        ("scan2-threefry-u8-x4chains", 262144, 1080, "threefry2x32",
+         "scan2", 8),
+        ("scan2-threefry-u8-x16chains", 1048576, 1080, "threefry2x32",
+         "scan2", 8),
+        ("wide-threefry", 65536, 1080, "threefry2x32", "wide", 8),
         ("wide-rbg", 65536, 1080, "rbg", "wide", 8),
-        ("scan-rbg-u8-big", 65536, 4320, "rbg", "scan", 8),
-        ("scan2-rbg-u8-big", 65536, 4320, "rbg", "scan2", 8),
-        ("scan-rbg-u8-x4chains", 262144, 1080, "rbg", "scan", 8),
-        ("scan2-rbg-u8-x4chains", 262144, 1080, "rbg", "scan2", 8),
+        ("wide-rbg-x4chains", 262144, 1080, "rbg", "wide", 8),
+        ("wide-rbg-big", 65536, 4320, "rbg", "wide", 8),
     ]
     n_blocks, n_rounds = (4, 3) if platform == "tpu" else (2, 1)
     for label, n, bs, prng, impl, unroll in variants:
