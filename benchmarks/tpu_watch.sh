@@ -12,7 +12,7 @@ LAUNCH_LOG=/root/repo/benchmarks/BATTERY_LAUNCHED
 while true; do
   if grep -q '^TPU_UP' "$STATUS" 2>/dev/null && [ ! -e "$DONE" ]; then
     echo "launching battery $(date -u +%FT%TZ)" >> "$LAUNCH_LOG"
-    exec /root/repo/benchmarks/run_tpu_round5.sh
+    exec /root/repo/benchmarks/run_tpu_round5b.sh
   fi
   sleep 30
 done

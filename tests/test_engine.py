@@ -363,3 +363,34 @@ def test_csv_format(tmp_path, run):
     # residual computed on device in float32; 0.01 W agreement suffices
     assert float(m) - float(p) == pytest.approx(float(r), abs=1e-2)
     assert t0.startswith("2019-09-0")
+
+
+class TestChainSlabs:
+    """SimConfig.n_chains_total / chain_offset: a partitioned run must be
+    bit-identical to the unslabbed one (slab keys are the total-run
+    split's slice, engine/simulation.py init_state)."""
+
+    def test_slab_concat_bit_identical(self):
+        full = Simulation(small_config(n_chains=6)).run_reduced()
+        parts = [
+            Simulation(small_config(n_chains=n, n_chains_total=6,
+                                    chain_offset=off)).run_reduced()
+            for off, n in ((0, 2), (2, 4))
+        ]
+        for name, arr in full.items():
+            got = np.concatenate([p[name] for p in parts])
+            np.testing.assert_array_equal(got, arr, err_msg=name)
+
+    def test_degenerate_slab_is_noop(self):
+        a = Simulation(small_config(n_chains=3)).run_reduced()
+        b = Simulation(small_config(n_chains=3, n_chains_total=3,
+                                    chain_offset=0)).run_reduced()
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name], err_msg=name)
+
+    def test_bad_slab_rejected(self):
+        with pytest.raises(ValueError, match="slab"):
+            Simulation(small_config(n_chains=4, n_chains_total=5,
+                                    chain_offset=2))
+        with pytest.raises(ValueError, match="chain_offset"):
+            Simulation(small_config(n_chains=2, chain_offset=1))

@@ -158,6 +158,20 @@ class Simulation:
             config = dataclasses.replace(
                 config, n_chains=len(config.site_grid)
             )
+        # slab bounds AFTER the site-grid override: the grid rewrites
+        # n_chains, and a slab validated against the pre-override value
+        # could silently slice short
+        if config.n_chains_total is not None:
+            if (config.chain_offset < 0 or
+                    config.chain_offset + config.n_chains
+                    > config.n_chains_total):
+                raise ValueError(
+                    f"chain slab [{config.chain_offset}, "
+                    f"{config.chain_offset + config.n_chains}) outside "
+                    f"n_chains_total={config.n_chains_total}"
+                )
+        elif config.chain_offset:
+            raise ValueError("chain_offset requires n_chains_total")
         self.config = config
         tz = (config.site_grid.timezone if config.site_grid is not None
               else config.site.timezone)
@@ -284,7 +298,17 @@ class Simulation:
             }
 
         def build():
-            keys = jax.random.split(self._k_chains, self.config.n_chains)
+            cfg = self.config
+            # Chain slabs: keys come from the NOTIONAL total-run split,
+            # sliced at the slab offset — threefry split is counter-based,
+            # so split(k, total)[off:off+n] gives the slab the exact keys
+            # those chains would get in the unslabbed run, making slab
+            # concatenation bit-identical to it (SimConfig.n_chains_total).
+            total = cfg.n_chains_total or cfg.n_chains
+            keys = jax.random.split(self._k_chains, total)
+            if total != cfg.n_chains or cfg.chain_offset:
+                keys = keys[cfg.chain_offset:cfg.chain_offset
+                            + cfg.n_chains]
             state = jax.vmap(one)(keys)
             if grid is not None:
                 # per-chain site parameters live in the state pytree: they
