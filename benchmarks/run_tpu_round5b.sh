@@ -54,6 +54,7 @@ run_json benchmarks/REPRO_r05.jsonl    repro     --repro 6
 run_json benchmarks/BENCH_config4.json config4   --config 4
 run_json benchmarks/BENCH_config2.json config2   --config 2
 run_json benchmarks/BENCH_config3a.json config3a --config 3a
+run_json benchmarks/BENCH_config5.json config5   --config 5
 echo "--- scaling start $(date -u +%FT%TZ)" >> "$LOG"
 if python bench.py --scaling > benchmarks/SCALING.json.tmp 2>> "$LOG"; then
   mv benchmarks/SCALING.json.tmp benchmarks/SCALING.json

@@ -111,3 +111,73 @@ def test_config_stepdown_exhaustion_emits_error_doc(tmp_path, monkeypatch,
     assert doc["config"] == "t"
     assert doc["error"] == "tunnel dead"
     assert doc["block_s_tried"] == [8640, 4320, 1080]
+
+
+def test_repro_aborts_after_two_consecutive_non_tpu(tmp_path, monkeypatch,
+                                                    capsys):
+    """A down tunnel must not burn all K trials on 4.5-min probe
+    timeouts: two successive non-TPU trials end the loop, and the abort
+    doc reports how many trials actually ran."""
+    monkeypatch.setattr(bench, "PARTIAL_PATH", str(tmp_path / "j.jsonl"))
+    calls = []
+
+    class FakeCompleted:
+        stdout = json.dumps({"variant": "scan-threefry",
+                             "platform": "cpu-fallback", "rate": 3e6})
+        stderr = ""
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        return FakeCompleted()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench.repro(6)
+    assert len(calls) == 2
+    lines = [json.loads(ln)
+             for ln in capsys.readouterr().out.strip().splitlines()]
+    abort = [d for d in lines if d.get("phase") == "repro-abort"]
+    assert abort and abort[0]["completed"] == 2
+    assert abort[0]["requested"] == 6
+    # no TPU trial landed -> no summary doc
+    assert not any(d.get("phase") == "repro-summary" for d in lines)
+
+
+def test_repro_counter_resets_on_tpu_trial(tmp_path, monkeypatch, capsys):
+    """cpu, tpu, cpu, cpu -> abort after trial 4, summary over the one
+    TPU rate with the true trial count."""
+    monkeypatch.setattr(bench, "PARTIAL_PATH", str(tmp_path / "j.jsonl"))
+    seq = iter(["cpu-fallback", "tpu", "cpu-fallback", "cpu-fallback",
+                "tpu", "tpu"])
+
+    def fake_run(*a, **kw):
+        class C:
+            stdout = json.dumps({"variant": "scan-threefry",
+                                 "platform": next(seq), "rate": 2.06e10})
+            stderr = ""
+        return C()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench.repro(6)
+    lines = [json.loads(ln)
+             for ln in capsys.readouterr().out.strip().splitlines()]
+    abort = [d for d in lines if d.get("phase") == "repro-abort"]
+    assert abort and abort[0]["completed"] == 4
+    summary = [d for d in lines if d.get("phase") == "repro-summary"]
+    assert summary and summary[0]["landed"] == 1
+    assert summary[0]["trials"] == 4 and summary[0]["requested"] == 6
+
+
+def test_slab_cfgs_cover_total_exactly():
+    cfgs = bench._slab_cfgs(1_000_000, 4, 1080)
+    assert len(cfgs) == 16
+    assert sum(c.n_chains for c in cfgs) == 1_000_000
+    assert all(c.n_chains <= bench.SLAB_CHAINS for c in cfgs)
+    assert [c.chain_offset for c in cfgs] == [
+        i * bench.SLAB_CHAINS for i in range(16)]
+    assert all(c.n_chains_total == 1_000_000 for c in cfgs)
+    # contiguous, non-overlapping cover
+    end = 0
+    for c in cfgs:
+        assert c.chain_offset == end
+        end += c.n_chains
+    assert end == 1_000_000
