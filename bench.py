@@ -1132,6 +1132,14 @@ def sweep() -> None:
     variants = [
         ("scan-threefry-u8", 65536, 1080, "threefry2x32", "scan", 8),
         ("scan-threefry-u4", 65536, 1080, "threefry2x32", "scan", 4),
+        # the take-1 sweep only bracketed the VMEM cliff coarsely (u8 =
+        # 3.5 ms fast, u16 = 60 ms spilled, 4320 = 187 ms spilled):
+        # u12@1080 and u4/u8@2160 probe the space between the measured
+        # fast point and the cliff — 2160 also halves the per-block
+        # fixed host cost if it holds
+        ("scan-threefry-u12", 65536, 1080, "threefry2x32", "scan", 12),
+        ("scan-threefry-u8-bs2160", 65536, 2160, "threefry2x32", "scan", 8),
+        ("scan-threefry-u4-bs2160", 65536, 2160, "threefry2x32", "scan", 4),
         ("scan-threefry-u16", 65536, 1080, "threefry2x32", "scan", 16),
         ("scan-threefry-u32", 65536, 1080, "threefry2x32", "scan", 32),
         ("scan-threefry-u8-x4chains", 262144, 1080, "threefry2x32",

@@ -43,6 +43,12 @@ run_json () {  # run_json <dest.json> <label> <args...>
   if [ $rc -eq 0 ] && is_tpu_artifact "$dest.tmp"; then
     mv "$dest.tmp" "$dest"
     echo "--- $label: TPU artifact written to $dest" >> "$LOG"
+  elif is_tpu_artifact "$dest.tmp"; then
+    # failed/killed mid-phase but REAL TPU lines landed first: promote
+    # to a committed partial artifact (.tmp/.nontpu are gitignored —
+    # take 1's 13 TPU sweep entries died with the checkout this way)
+    mv "$dest.tmp" "$dest.partial"
+    echo "--- $label: rc=$rc but TPU lines landed; kept as $dest.partial" >> "$LOG"
   else
     mv "$dest.tmp" "$dest.nontpu" 2>/dev/null
     echo "--- $label: NOT a TPU result; kept as $dest.nontpu" >> "$LOG"
@@ -61,6 +67,14 @@ if python bench.py --scaling > benchmarks/SCALING.json.tmp 2>> "$LOG"; then
 fi
 echo "--- profile start $(date -u +%FT%TZ)" >> "$LOG"
 python bench.py --profile benchmarks/profile_r05 >> "$LOG" 2>&1
+# sweep late: the tuning matrix is the committed evidence for the
+# fast-regime point (take 1's 13 TPU entries lived only in the
+# gitignored journal and died with the checkout) and now includes the
+# u12/bs2160 cliff-bracketing entries — but take 1 also WEDGED
+# mid-sweep, and a wedged phase cannot be timeout-killed (stale tunnel
+# grant), so it runs after everything except config 3: a recurrence
+# costs only the full-year config whose 30-day slice already landed
+run_json benchmarks/SWEEP_r05.jsonl    sweep     --sweep
 # config 3 LAST (full-year 10k sites, the longest step)
 run_json benchmarks/BENCH_config3.json  config3  --config 3
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
