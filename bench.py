@@ -11,8 +11,10 @@ Baseline: the reference caps at ~100 simulated seconds/sec/process under
 ``--no-realtime`` (the 10 ms sleep floor in fixedclock, utils.py:36;
 SURVEY.md §6) — vs_baseline is the speedup over that ceiling per chip.
 The headline config is the fastest documented mode: scan-fused block
-(SimConfig.block_impl='scan'), hardware PRNG (prng_impl='rbg'); the
-threefry and wide variants are measured and reported alongside it.
+(SimConfig.block_impl='scan') with the default threefry PRNG at
+scan_unroll=8 (the hardware PRNG 'rbg' serializes ~76x inside the scan
+on the current TPU backend — PERF_ANALYSIS.md §7a — and is demoted to a
+1-block probe); scan2 and wide variants are measured alongside it.
 
 Roofline fields: analytic+compiled accounting of the hot jit — flops and
 HBM bytes from XLA's own cost model (``compiled.cost_analysis()``), wall
@@ -23,8 +25,13 @@ the provenance of the peak numbers).
 Subcommands (artifact producers, run during the build, committed under
 benchmarks/):
 
-    bench.py --config N    one of the five BASELINE.md configs (1-5)
+    bench.py --config N    a BASELINE.md config (1-5; 3a = 30-day slice
+                           of 3); on TPU, 4 and 5 run their full chain
+                           counts as sequential <=65536-chain slabs
     bench.py --scaling     1->8 device scaling on the virtual CPU mesh
+    bench.py --sweep       impl/PRNG/unroll/shape tuning matrix
+    bench.py --repro K     K fresh-process compiles of the headline
+                           variant (compile-variance probe)
     bench.py --profile DIR jax.profiler trace of steady headline blocks
 
 Resilience: the environment pins ``JAX_PLATFORMS`` to a remote TPU tunnel

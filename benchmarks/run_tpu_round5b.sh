@@ -16,21 +16,24 @@ cd /root/repo
 LOG=benchmarks/tpu_round5.log
 echo "=== battery-2 start $(date -u +%FT%TZ)" >> "$LOG"
 
-is_tpu_artifact () {
+tpu_lines () {  # prints the number of top-level platform=="tpu" lines
   python - "$1" <<'EOF'
 import json, sys
-ok = False
-for ln in open(sys.argv[1]):
-    ln = ln.strip()
-    if not ln:
-        continue
-    try:
-        doc = json.loads(ln)
-    except json.JSONDecodeError:
-        continue
-    if doc.get("platform") == "tpu":
-        ok = True
-sys.exit(0 if ok else 1)
+n = 0
+try:
+    for ln in open(sys.argv[1]):
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            doc = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if doc.get("platform") == "tpu":
+            n += 1
+except OSError:
+    pass
+print(n)
 EOF
 }
 
@@ -39,16 +42,29 @@ run_json () {  # run_json <dest.json> <label> <args...>
   echo "--- $label start $(date -u +%FT%TZ)" >> "$LOG"
   python bench.py "$@" > "$dest.tmp" 2>> "$LOG"
   local rc=$?
-  echo "--- $label rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
-  if [ $rc -eq 0 ] && is_tpu_artifact "$dest.tmp"; then
+  local new_tpu
+  new_tpu=$(tpu_lines "$dest.tmp")
+  echo "--- $label rc=$rc tpu_lines=$new_tpu $(date -u +%FT%TZ)" >> "$LOG"
+  if [ $rc -eq 0 ] && [ "$new_tpu" -gt 0 ]; then
     mv "$dest.tmp" "$dest"
+    # a .partial left by an earlier failed take is now superseded
+    rm -f "$dest.partial"
     echo "--- $label: TPU artifact written to $dest" >> "$LOG"
-  elif is_tpu_artifact "$dest.tmp"; then
+  elif [ "$new_tpu" -gt 0 ]; then
     # failed/killed mid-phase but REAL TPU lines landed first: promote
     # to a committed partial artifact (.tmp/.nontpu are gitignored —
-    # take 1's 13 TPU sweep entries died with the checkout this way)
-    mv "$dest.tmp" "$dest.partial"
-    echo "--- $label: rc=$rc but TPU lines landed; kept as $dest.partial" >> "$LOG"
+    # take 1's 13 TPU sweep entries died with the checkout this way).
+    # Never clobber a RICHER partial from a previous take with a
+    # poorer one (watcher relaunches after mid-battery crashes).
+    local old_tpu
+    old_tpu=$(tpu_lines "$dest.partial")
+    if [ "$new_tpu" -gt "$old_tpu" ]; then
+      mv "$dest.tmp" "$dest.partial"
+      echo "--- $label: rc=$rc, $new_tpu TPU line(s); kept as $dest.partial" >> "$LOG"
+    else
+      mv "$dest.tmp" "$dest.nontpu" 2>/dev/null
+      echo "--- $label: rc=$rc, $new_tpu TPU line(s) <= existing $dest.partial ($old_tpu); kept as $dest.nontpu" >> "$LOG"
+    fi
   else
     mv "$dest.tmp" "$dest.nontpu" 2>/dev/null
     echo "--- $label: NOT a TPU result; kept as $dest.nontpu" >> "$LOG"

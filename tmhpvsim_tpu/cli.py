@@ -167,9 +167,11 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "100k+ chains (jax backend)")
 @click.option("--prng-impl", type=click.Choice(["threefry2x32", "rbg"]),
               default="threefry2x32",
-              help="PRNG: threefry2x32 = fully counter-based (default); "
-                   "rbg = TPU hardware bit generator, ~2x faster blocks "
-                   "(jax backend; see config.SimConfig.prng_impl)")
+              help="PRNG: threefry2x32 = fully counter-based (default, "
+                   "and the fast mode on current TPU backends — rbg's "
+                   "vmapped per-chain draws serialize there); rbg = TPU "
+                   "hardware bit generator (jax backend; see "
+                   "config.SimConfig.prng_impl)")
 @click.option("--block-impl",
               type=click.Choice(["auto", "wide", "scan", "scan2"]),
               default="auto",

@@ -280,7 +280,12 @@ class SimConfig:
     #: 'rbg' keeps threefry for key derivation (split/fold_in — here only
     #: per chain and per minute) but generates the bits with the TPU's
     #: hardware RngBitGenerator, trading the strict cross-backend
-    #: reproducibility guarantee for ~2x block throughput.  Statistical
+    #: reproducibility guarantee for hardware-generated bits.  Measured
+    #: history: in the round-4 wide formulation rbg cut compiled flops
+    #: 2.26x (rate +<3%, HBM-bound); on the CURRENT TPU backend its
+    #: vmapped per-chain draws serialize (~8 s vs 3.5 ms per 65536x1080
+    #: scan-fused block, round 5 — benchmarks/PERF_ANALYSIS.md §7a), so
+    #: threefry is both the default and the fast mode.  Statistical
     #: quality is equivalent for Monte-Carlo use; all parity/KS tests pass
     #: under either (the golden model is seeded numpy, not stream-matched).
     prng_impl: str = "threefry2x32"
