@@ -47,8 +47,12 @@ run_json () {  # run_json <dest.json> <label> <args...>
   echo "--- $label rc=$rc tpu_lines=$new_tpu $(date -u +%FT%TZ)" >> "$LOG"
   if [ $rc -eq 0 ] && [ "$new_tpu" -gt 0 ]; then
     mv "$dest.tmp" "$dest"
-    # a .partial left by an earlier failed take is now superseded
-    rm -f "$dest.partial"
+    # an earlier failed take's .partial is superseded — but only when
+    # this artifact is at least as rich (a CPU-fallback exit is rc=0
+    # with few TPU lines; never erase a richer partial with that)
+    if [ "$new_tpu" -ge "$(tpu_lines "$dest.partial")" ]; then
+      rm -f "$dest.partial"
+    fi
     echo "--- $label: TPU artifact written to $dest" >> "$LOG"
   elif [ "$new_tpu" -gt 0 ]; then
     # failed/killed mid-phase but REAL TPU lines landed first: promote

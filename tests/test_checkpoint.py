@@ -344,3 +344,62 @@ def test_cli_resume_missing_csv_rejected(tmp_path):
     r = _cli_jax(str(part), "--checkpoint", str(ck))
     assert r.exit_code != 0
     assert "restore the CSV" in str(r.exception)
+
+
+def test_foreign_state_layout_named_in_error(tmp_path):
+    """A state whose leaf set does not match this build (e.g. an edited
+    npz, or a pre-windowed layout past a bypassed version gate) must be
+    refused with the offending leaf NAMES, not an opaque tree-structure
+    error deep in jit (round-4 ADVICE)."""
+    sim = Simulation(cfg())
+    it = sim.run_blocks()
+    next(it)
+    path = str(tmp_path / "state.npz")
+    ckpt.save(path, sim.state, 1, sim.config)
+    state, nb = ckpt.load(path, sim.config)
+    state["arrays"] = state.pop("cc_carry")  # simulate a foreign layout
+    sim2 = Simulation(cfg())
+    with pytest.raises(ValueError, match="arrays.*|cc_carry.*"):
+        list(sim2.run_blocks(state=state, start_block=nb))
+
+
+def test_matching_layout_passes_check(tmp_path):
+    """The layout check is a no-op for a genuine checkpoint."""
+    sim = Simulation(cfg())
+    it = sim.run_blocks()
+    next(it)
+    path = str(tmp_path / "state.npz")
+    ckpt.save(path, sim.state, 1, sim.config)
+    state, nb = ckpt.load(path, sim.config)
+    sim2 = Simulation(cfg())
+    assert sim2._check_resume_layout(state) is state
+
+
+def test_foreign_acc_layout_named_in_error(tmp_path):
+    """The reduce accumulator half of a resume gets the same named-leaf
+    guard as the state half."""
+    sim = Simulation(cfg(output="reduce"))
+    sim.run_reduced()
+    acc = {k: np.asarray(v) for k, v in sim._last_acc.items()}
+    acc["bogus_stat"] = acc.pop("pv_sum")
+    state = {k: np.asarray(v) for k, v in ckpt._flatten(sim.state).items()}
+    sim2 = Simulation(cfg(output="reduce"))
+    loaded_state = ckpt._unflatten(
+        {k: v for k, v in state.items()}, sim2.config.prng_impl)
+    with pytest.raises(ValueError, match="bogus_stat"):
+        sim2.run_reduced(state=loaded_state, acc=acc, start_block=1)
+
+
+def test_wrong_dtype_leaf_named_in_error(tmp_path):
+    """Right names but a wrong-dtype leaf (hand-edited npz) is refused
+    with the leaf named, not an in-jit shape error."""
+    sim = Simulation(cfg())
+    it = sim.run_blocks()
+    next(it)
+    path = str(tmp_path / "state.npz")
+    ckpt.save(path, sim.state, 1, sim.config)
+    state, nb = ckpt.load(path, sim.config)
+    state["cc_carry"] = state["cc_carry"].astype(np.float64)
+    sim2 = Simulation(cfg())
+    with pytest.raises(ValueError, match="cc_carry"):
+        list(sim2.run_blocks(state=state, start_block=nb))

@@ -934,7 +934,7 @@ class Simulation:
         cfg = self.config
         jit = self._block_jit if block_jit is None else block_jit
         state = self.init_state() if state is None \
-            else self._place_resume(state)
+            else self._place_resume(self._check_resume_layout(state))
         self.state = state
         pf = InputPrefetcher(self, start_block, self.n_blocks)
         # No dispatch-ahead here: consumers checkpoint ``self.state`` after
@@ -992,10 +992,11 @@ class Simulation:
                 "pass acc= alongside state=/start_block="
             )
         state = self.init_state() if state is None \
-            else self._place_resume(state)
+            else self._place_resume(self._check_resume_layout(state))
         self.state = state
         acc = self.init_reduce_acc() if acc is None \
-            else self._place_resume(acc)
+            else self._place_resume(self._check_resume_layout(
+                acc, self.init_reduce_acc, "acc"))
         self._last_acc = acc  # device-side, for ensemble_stats()
         pf = InputPrefetcher(self, start_block, self.n_blocks)
         try:
@@ -1014,6 +1015,40 @@ class Simulation:
         onto device.  The base class lets jit place them; the sharded
         subclass applies the chain sharding so a resumed run (including one
         with zero remaining blocks) has real device arrays."""
+        return tree
+
+    def _check_resume_layout(self, tree, init_fn=None, what="state"):
+        """A resumed state/acc pytree must have this build's leaf set,
+        dtypes, and trailing dims.  The rng_stream/config gate in
+        checkpoint.load is the real guard; if a foreign layout ever slips
+        past it (e.g. a hand-edited npz or a pre-windowed
+        'arrays'-bearing v2 state), fail here with the leaf names instead
+        of an opaque tree-structure error deep in jit (round-4 ADVICE).
+        eval_shape traces the initializer without allocating, so the
+        comparison is O(ms) at any chain count.  Axis 0 (chains) is
+        deliberately NOT compared: a pod-slice checkpoint stores each
+        host's local slice (host_local_tree), whose chain count is the
+        per-host share of the global value eval_shape reports."""
+        ku = jax.tree_util
+
+        def sig(t):
+            return {ku.keystr(p): (str(v.dtype), tuple(jnp.shape(v)[1:]))
+                    for p, v in ku.tree_flatten_with_path(t)[0]}
+
+        want = sig(jax.eval_shape(init_fn or self.init_state))
+        got = sig(tree)
+        if want != got:
+            changed = sorted(f"{k}: expected {want[k]}, got {got[k]}"
+                             for k in set(want) & set(got)
+                             if want[k] != got[k])
+            raise ValueError(
+                f"resume {what} does not match this build's layout: "
+                f"missing leaves {sorted(set(want) - set(got)) or '{}'}, "
+                f"unexpected leaves {sorted(set(got) - set(want)) or '{}'}, "
+                f"dtype/shape mismatches {changed or '{}'} — the "
+                "checkpoint was written by an incompatible build or "
+                "edited by hand"
+            )
         return tree
 
     def host_local_tree(self, tree):
