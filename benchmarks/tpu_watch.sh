@@ -9,8 +9,17 @@ LAUNCH_LOG=/root/repo/benchmarks/BATTERY_LAUNCHED
 # after a mid-battery crash must relaunch (BATTERY_DONE is only written
 # by the battery's last line).  Within one watcher process the `exec`
 # below prevents double-launch.
+# The status file is CONSUMED (renamed) at launch, so one TPU_UP fires
+# exactly one battery: a leftover TPU_UP from an earlier probe run once
+# fired a second battery against a dead tunnel (2026-07-31 04:42; the
+# whole take ran cpu-fallback).  An unconsumed TPU_UP of any age is
+# trustworthy — the battery re-probes per phase and quarantines non-TPU
+# results.  Crash recovery (battery died, no BATTERY_DONE): restart
+# tpu_probe.sh — it re-verifies the tunnel (hang-dialing until any
+# stale grant from the crash clears) and writes a fresh TPU_UP.
 while true; do
   if grep -q '^TPU_UP' "$STATUS" 2>/dev/null && [ ! -e "$DONE" ]; then
+    mv "$STATUS" "$STATUS.consumed" 2>/dev/null
     echo "launching battery $(date -u +%FT%TZ)" >> "$LAUNCH_LOG"
     exec /root/repo/benchmarks/run_tpu_round5b.sh
   fi

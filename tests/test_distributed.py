@@ -101,7 +101,7 @@ def _run_workers(src: str, timeout: float = 360.0, args=()):
     return outs
 
 
-def _run_workers_once(src: str, timeout: float, args=()):
+def _run_workers_once(src: str, timeout: float, args):
     port = _free_port()
     procs = []
     for pid in range(2):
