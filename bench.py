@@ -529,13 +529,18 @@ def headline() -> None:
                 print("# TPU variants phase exceeded deadline; emitting "
                       f"partial headline from {len(done)} completed "
                       "variant(s)", file=sys.stderr)
-                print(json.dumps(_headline_doc(
+                doc = _headline_doc(
                     snap, "tpu",
                     partial=True, n_chains=n_chains, block_s=BLOCK_S,
                     timed_blocks=n_blocks, timed_rounds=n_rounds,
                     error="tunnel wedged mid-matrix; remaining variants "
                           "unmeasured",
-                )))
+                )
+                # journal it like the normal-completion path does: the
+                # salvaged partial is exactly the record a later
+                # cpu-fallback run's _last_tpu_evidence must find
+                _persist_partial({"phase": "headline", **doc})
+                print(json.dumps(doc))
                 os._exit(0)
             print("# TPU variants phase exceeded deadline; salvaging CPU "
                   "number", file=sys.stderr)
