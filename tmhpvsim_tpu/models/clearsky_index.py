@@ -486,19 +486,25 @@ def csi_compose_step(tables, x, carry, options: ModelOptions,
 
 def host_block_index(spec: TimeGridSpec, offset: int, length: int,
                      dtype=jnp.float32, blk=None):
-    """Shared (chain-independent) scan inputs for one block, as device
-    arrays.  ``blk`` reuses an already-computed ``spec.block(offset,
-    length)`` — the O(block_s) float64 calendar precompute is the per-block
-    host cost, so callers that need the TimeBlock anyway (engine
-    host_inputs) pass it in instead of paying it twice."""
+    """Shared (chain-independent) scan inputs for one block, as HOST
+    (numpy) arrays: the jit call transfers them at dispatch, which skips
+    ~26 eager per-leaf jnp.asarray dispatches per block (~70% of the
+    measured host_inputs cost — the host side co-limits the pipeline at
+    scan-fused device rates, PERF_ANALYSIS §4b).  numpy leaves have the
+    same avals as the previous device arrays, so no jit recompiles and
+    bit-identical values.  ``blk`` reuses an already-computed
+    ``spec.block(offset, length)`` — the O(block_s) float64 calendar
+    precompute is the per-block host cost, so callers that need the
+    TimeBlock anyway (engine host_inputs) pass it in instead of paying
+    it twice."""
     if blk is None:
         blk = spec.block(offset, length)
     return {
-        "t": jnp.asarray(blk.offset + np.arange(len(blk.epoch)), dtype=jnp.int32),
-        "hour_idx": jnp.asarray(blk.hour_idx, dtype=jnp.int32),
-        "day_idx": jnp.asarray(blk.day_idx, dtype=jnp.int32),
-        "min_idx": jnp.asarray(blk.min_idx, dtype=jnp.int32),
-        "hour_frac": jnp.asarray(blk.hour_fraction, dtype=dtype),
-        "day_frac": jnp.asarray(blk.day_fraction, dtype=dtype),
-        "min_frac": jnp.asarray(blk.min_fraction, dtype=dtype),
+        "t": np.asarray(blk.offset + np.arange(len(blk.epoch)), np.int32),
+        "hour_idx": np.asarray(blk.hour_idx, np.int32),
+        "day_idx": np.asarray(blk.day_idx, np.int32),
+        "min_idx": np.asarray(blk.min_idx, np.int32),
+        "hour_frac": np.asarray(blk.hour_fraction, dtype),
+        "day_frac": np.asarray(blk.day_fraction, dtype),
+        "min_frac": np.asarray(blk.min_fraction, dtype),
     }, (int(blk.min_idx[0]), int(blk.min_idx[-1]) + 2)
