@@ -1,0 +1,159 @@
+"""The battery's artifact gate (benchmarks/run_tpu_round5b.sh run_json)
+decides which hardware measurements survive as committed files — a
+regression silently loses TPU data (it already did once: take 1's 13
+sweep entries died in a gitignored journal).  These tests drive the
+shell functions directly with a stubbed ``python bench.py``.
+
+Extraction safety: only function DEFINITIONS are sourced (anchored on
+``name () {``), and the extracted text is asserted to contain no
+battery phase invocations before it is executed — sourcing the
+script's tail would RUN the battery against the stub (it did once,
+2026-07-31 09:10; the repo survived because the stub broke the gate's
+integer comparison, but SCALING.json and BATTERY_DONE had to be
+restored)."""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+SCRIPT = Path(__file__).resolve().parent.parent / "benchmarks" / \
+    "run_tpu_round5b.sh"
+
+
+def _extract_function(name: str) -> str:
+    """The definition of one top-level shell function, nothing else."""
+    text = SCRIPT.read_text()
+    m = re.search(rf"^{re.escape(name)} \(\) \{{.*?^\}}$", text,
+                  re.M | re.S)
+    assert m, f"function {name} not found in {SCRIPT}"
+    body = m.group(0)
+    # belt and braces: the sourced text must define, never invoke
+    for ln in body.splitlines():
+        assert not re.match(r"^(run_json|tpu_lines)\s+[^()]", ln), \
+            f"extraction picked up an invocation line: {ln!r}"
+    return body
+
+
+def _gate(tmp_path: Path, *, rc: int, new_lines, existing_partial=None,
+          existing_dest=None):
+    """Run run_json against a stubbed `python bench.py` and return the
+    resulting (dest, dest.partial, dest.nontpu) parsed contents."""
+    dest = tmp_path / "ART.jsonl"
+    fake_out = tmp_path / "fake_bench_output.txt"
+    fake_out.write_text(
+        "\n".join(json.dumps(d) for d in new_lines) + "\n")
+    # fake ONLY `python bench.py`; tpu_lines' `python - <file>` and any
+    # other python must reach the real interpreter
+    stub = tmp_path / "python"
+    stub.write_text(
+        "#!/bin/bash\n"
+        'case "$1" in\n'
+        f'  *bench.py) cat "{fake_out}"; exit {rc};;\n'
+        f'  *) exec "{sys.executable}" "$@";;\n'
+        "esac\n"
+    )
+    stub.chmod(0o755)
+    if existing_partial is not None:
+        (tmp_path / "ART.jsonl.partial").write_text(
+            "\n".join(json.dumps(d) for d in existing_partial) + "\n")
+    if existing_dest is not None:
+        dest.write_text(
+            "\n".join(json.dumps(d) for d in existing_dest) + "\n")
+    funcs = tmp_path / "funcs.sh"
+    funcs.write_text(_extract_function("tpu_lines") + "\n" +
+                     _extract_function("run_json") + "\n")
+    driver = (
+        "set -u\n"
+        f'cd "{tmp_path}"\n'
+        f'LOG="{tmp_path}/gate.log"\n'
+        'touch "$LOG"\n'
+        f'PATH="{tmp_path}":$PATH\n'
+        f'source "{funcs}"\n'
+        f'run_json "{dest}" testphase --whatever\n'
+    )
+    subprocess.run(["bash", "-c", driver], check=True,
+                   capture_output=True, text=True, cwd=tmp_path)
+
+    def read(p):
+        f = tmp_path / p
+        if not f.exists():
+            return None
+        return [json.loads(ln) for ln in f.read_text().splitlines()
+                if ln.strip()]
+    return (read("ART.jsonl"), read("ART.jsonl.partial"),
+            read("ART.jsonl.nontpu"))
+
+
+TPU = {"platform": "tpu", "rate": 1.0}
+CPU = {"platform": "cpu-fallback", "rate": 2.0}
+
+
+def test_success_with_tpu_lines_promotes_to_dest(tmp_path):
+    dest, partial, nontpu = _gate(tmp_path, rc=0, new_lines=[TPU, TPU])
+    assert len(dest) == 2 and partial is None and nontpu is None
+
+
+def test_failure_with_tpu_lines_keeps_partial(tmp_path):
+    dest, partial, nontpu = _gate(tmp_path, rc=1, new_lines=[TPU, CPU])
+    assert dest is None and len(partial) == 2 and nontpu is None
+
+
+def test_non_tpu_output_is_quarantined(tmp_path):
+    dest, partial, nontpu = _gate(tmp_path, rc=0, new_lines=[CPU])
+    assert dest is None and partial is None and len(nontpu) == 1
+
+
+def test_poorer_retry_never_clobbers_richer_partial(tmp_path):
+    """The take-1 loss mode: a wedged retry with 1 TPU line must not
+    replace a 13-line partial from the previous take."""
+    rich = [dict(TPU, i=i) for i in range(13)]
+    dest, partial, nontpu = _gate(tmp_path, rc=1, new_lines=[TPU],
+                                  existing_partial=rich)
+    assert dest is None
+    assert len(partial) == 13 and partial[0]["i"] == 0
+    assert len(nontpu) == 1
+
+
+def test_richer_retry_supersedes_partial(tmp_path):
+    dest, partial, nontpu = _gate(tmp_path, rc=1,
+                                  new_lines=[TPU, TPU, TPU],
+                                  existing_partial=[TPU])
+    assert dest is None and len(partial) == 3
+
+
+def test_cpu_fallback_success_keeps_richer_partial(tmp_path):
+    """rc=0 with few TPU lines (early tunnel drop, CPU tail) must not
+    erase a richer partial — only a >= artifact supersedes it."""
+    rich = [dict(TPU, i=i) for i in range(5)]
+    dest, partial, nontpu = _gate(tmp_path, rc=0, new_lines=[TPU, CPU],
+                                  existing_partial=rich)
+    assert len(dest) == 2      # the successful artifact is still written
+    assert len(partial) == 5   # but the richer partial survives
+
+
+def test_failed_retry_leaves_prior_success_untouched(tmp_path):
+    """A failed rerun after a prior full success must not touch the
+    committed artifact (regression guard for any mv-target slip in the
+    rc!=0 branches)."""
+    prior = [dict(TPU, committed=True), dict(TPU, committed=True)]
+    dest, partial, nontpu = _gate(tmp_path, rc=1, new_lines=[CPU],
+                                  existing_dest=prior)
+    assert len(dest) == 2 and all(d.get("committed") for d in dest)
+    assert partial is None and len(nontpu) == 1
+
+
+def test_full_success_removes_superseded_partial(tmp_path):
+    dest, partial, nontpu = _gate(tmp_path, rc=0,
+                                  new_lines=[TPU, TPU],
+                                  existing_partial=[TPU])
+    assert len(dest) == 2 and partial is None
+
+
+def test_gate_script_parses_and_extraction_is_definition_only():
+    subprocess.run(["bash", "-n", str(SCRIPT)], check=True)
+    _extract_function("tpu_lines")
+    _extract_function("run_json")
