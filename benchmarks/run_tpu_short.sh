@@ -1,0 +1,28 @@
+#!/bin/bash
+# END-OF-ROUND short battery: the driver's harvest (~15:14 UTC) runs
+# `python bench.py` against the single-tenant tunnel, so any battery
+# still running then would starve it.  This variant runs only the
+# highest-value phases — headline, a 3-trial repro, config 4 — and
+# finishes in ~25-35 min, leaving the tunnel free for the harvest.
+# Identical gate semantics to run_tpu_round5b.sh (functions sourced
+# from it so they cannot drift).  Repro writes to its OWN artifact so
+# a 3-trial short run can never replace a richer 6-trial
+# REPRO_r05.jsonl a full battery may have committed.
+set -u
+cd /root/repo
+LOG=benchmarks/tpu_round5.log
+echo "=== short-battery start $(date -u +%FT%TZ)" >> "$LOG"
+source <(sed -n '/^tpu_lines () {/,/^}$/p' benchmarks/run_tpu_round5b.sh)
+source <(sed -n '/^run_json () {/,/^}$/p' benchmarks/run_tpu_round5b.sh)
+# a failed extraction must not silently "complete" the battery: the
+# watcher has already consumed TPU_UP, and BATTERY_DONE would block
+# any relaunch with zero artifacts to show for the window
+if ! declare -F tpu_lines >/dev/null || ! declare -F run_json >/dev/null; then
+  echo "=== short-battery ABORT: gate function extraction failed $(date -u +%FT%TZ)" >> "$LOG"
+  exit 1
+fi
+run_json benchmarks/HEADLINE_r05.json      headline-short
+run_json benchmarks/REPRO_r05_short.jsonl  repro-short   --repro 3
+run_json benchmarks/BENCH_config4.json     config4-short --config 4
+echo "=== short-battery done $(date -u +%FT%TZ)" >> "$LOG"
+touch benchmarks/BATTERY_DONE

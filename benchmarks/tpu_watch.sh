@@ -1,7 +1,11 @@
 #!/bin/bash
 # Watches tpu_status.txt; the moment the probe reports TPU_UP, launches
-# the round-5 benchmark battery (once). Separate from tpu_probe.sh so the
-# running probe loop's script file is never edited in place.
+# the battery (once).  Which battery is the optional first argument
+# (default: the full round-5 battery; pass run_tpu_short.sh near the
+# round's end so the launched work finishes before the driver's
+# harvest needs the single-tenant tunnel).  Separate from tpu_probe.sh
+# so the running probe loop's script file is never edited in place.
+BATTERY=${1:-/root/repo/benchmarks/run_tpu_round5b.sh}
 STATUS=/root/repo/benchmarks/tpu_status.txt
 DONE=/root/repo/benchmarks/BATTERY_DONE
 LAUNCH_LOG=/root/repo/benchmarks/BATTERY_LAUNCHED
@@ -20,8 +24,8 @@ LAUNCH_LOG=/root/repo/benchmarks/BATTERY_LAUNCHED
 while true; do
   if grep -q '^TPU_UP' "$STATUS" 2>/dev/null && [ ! -e "$DONE" ]; then
     mv "$STATUS" "$STATUS.consumed" 2>/dev/null
-    echo "launching battery $(date -u +%FT%TZ)" >> "$LAUNCH_LOG"
-    exec /root/repo/benchmarks/run_tpu_round5b.sh
+    echo "launching battery $BATTERY $(date -u +%FT%TZ)" >> "$LAUNCH_LOG"
+    exec "$BATTERY"
   fi
   sleep 30
 done
