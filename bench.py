@@ -169,45 +169,15 @@ def _timed_reduce_run(sim, n_blocks: int, n_rounds: int, profile_dir=None):
     """(compile_s, best_steady_s, rate): one warm-up block, then n_rounds x
     n_blocks timed reduce-mode blocks through the public step_acc path,
     best round kept (the tunnel TPU's throughput varies ~2x between
-    otherwise identical runs)."""
-    import contextlib
+    otherwise identical runs).
 
-    import jax
+    The timing loop itself lives in engine/autotune.py — the variant
+    sweep and ``tune='auto'`` plan probes share one measurement path,
+    so a bench rate and a probe rate are directly comparable."""
+    from tmhpvsim_tpu.engine.autotune import time_reduce_blocks
 
-    from tmhpvsim_tpu.engine.simulation import InputPrefetcher
-
-    sim.state = sim.init_state()
-    acc = sim.init_reduce_acc()
-    pf = InputPrefetcher(sim, 0, sim.n_blocks)
-    t_c = time.perf_counter()
-    inputs, _ = pf.get(0)
-    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
-    jax.block_until_ready(acc)
-    compile_s = time.perf_counter() - t_c
-
-    trace = contextlib.nullcontext()
-    if profile_dir:
-        from tmhpvsim_tpu.engine.profiling import device_trace
-
-        trace = device_trace(profile_dir)
-
-    best = float("inf")
-    bi = 1
-    try:
-        with trace:
-            for _ in range(n_rounds):
-                t0 = time.perf_counter()
-                for _ in range(n_blocks):
-                    inputs, _ = pf.get(bi)
-                    bi += 1
-                    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
-                jax.block_until_ready(acc)
-                best = min(best, time.perf_counter() - t0)
-    finally:
-        pf.close()
-    n = sim.config.n_chains
-    bs = sim.config.block_s
-    return compile_s, best, n * bs * n_blocks / best
+    return time_reduce_blocks(sim, n_blocks, n_rounds=n_rounds,
+                              profile_dir=profile_dir)
 
 
 def _hot_jit_cost(sim) -> dict:
@@ -372,6 +342,13 @@ def _last_tpu_evidence() -> dict | None:
     return None
 
 
+def _plan_doc(plan) -> dict:
+    """Resolved execution plan as a JSON-able echo (config.Plan fields)."""
+    return {"block_impl": plan.block_impl, "scan_unroll": plan.scan_unroll,
+            "stats_fusion": plan.stats_fusion,
+            "slab_chains": plan.slab_chains, "source": plan.source}
+
+
 def _headline_doc(variants: dict, platform: str, **extra) -> dict:
     """The headline JSON from whatever variants have landed (shared by
     the normal path and the watchdog's partial-salvage path)."""
@@ -383,7 +360,7 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
     pick = full or ok
     best_name = max(pick, key=lambda k: pick[k]["rate"])
     rate = ok[best_name]["rate"]
-    return {
+    doc = {
         "metric": "simulated site-seconds/sec/chip",
         "value": rate,
         "unit": "site-s/s/chip",
@@ -395,6 +372,12 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
         "variants": variants,
         **extra,
     }
+    # the winning variant's resolved plan, when the sweep recorded one
+    # (pre-autotuner partials journalled by older runs have no "plan")
+    plan = ok[best_name].get("plan")
+    if plan is not None:
+        doc["tuned_plan"] = plan
+    return doc
 
 
 def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
@@ -439,6 +422,7 @@ def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
                 # a CPU run a 'scan-*' label would otherwise misdocument a
                 # wide run)
                 "impl": _impl_label(sim),
+                "plan": _plan_doc(sim.plan),
             }
             if probe:
                 variants[name]["probe"] = True  # 1x1 blocks, see VARIANT_CFGS
@@ -491,7 +475,10 @@ def _salvage_cpu_headline(tpu_errors=None, timeout_s: float = 900.0) -> bool:
     doc["salvaged_after_tpu_failure"] = True
     if tpu_errors is not None:
         doc["tpu_errors"] = tpu_errors
-    print(json.dumps(doc))
+    # flush: callers os._exit() right after salvage, which skips the
+    # interpreter's atexit stdio flush — under the battery gate's
+    # block-buffered redirect an unflushed doc is lost entirely
+    print(json.dumps(doc), flush=True)
     return True
 
 
@@ -540,8 +527,13 @@ def headline() -> None:
                 # salvaged partial is exactly the record a later
                 # cpu-fallback run's _last_tpu_evidence must find
                 _persist_partial({"phase": "headline", **doc})
-                print(json.dumps(doc))
-                os._exit(0)
+                # flush + NONZERO exit: os._exit skips the atexit stdio
+                # flush (block-buffered redirects would lose the doc), and
+                # rc=0 here let run_tpu_round5b.sh promote a partial doc
+                # over a previously committed complete artifact — rc!=0
+                # routes it to $dest.partial instead
+                print(json.dumps(doc), flush=True)
+                os._exit(3)
             print("# TPU variants phase exceeded deadline; salvaging CPU "
                   "number", file=sys.stderr)
             if not _salvage_cpu_headline(
@@ -551,8 +543,8 @@ def headline() -> None:
                     "value": 0.0, "unit": "site-s/s/chip",
                     "vs_baseline": 0.0, "platform": "tpu-hung",
                     "error": "TPU hung and CPU salvage failed",
-                }))
-            os._exit(0)
+                }), flush=True)
+            os._exit(3)
 
         def _monitor():
             while not monitor_state["done"]:

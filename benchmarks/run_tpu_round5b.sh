@@ -37,6 +37,53 @@ print(n)
 EOF
 }
 
+doc_richness () {  # landed variant + slab entries summed over tpu docs:
+                   # the tie-break when two takes have EQUAL tpu_lines (a
+                   # wedged take's partial headline may carry fewer
+                   # measured variants than the take it would replace)
+  python - "$1" <<'EOF'
+import json, sys
+r = 0
+try:
+    for ln in open(sys.argv[1]):
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            doc = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if doc.get("platform") != "tpu":
+            continue
+        r += sum(1 for v in doc.get("variants", {}).values()
+                 if isinstance(v, dict) and "rate" in v)
+        r += len(doc.get("echo", {}).get("slabs", doc.get("slabs", [])))
+except OSError:
+    pass
+print(r)
+EOF
+}
+
+has_partial_doc () {  # rc 0 iff any line carries "partial": true
+  python - "$1" <<'EOF'
+import json, sys
+try:
+    for ln in open(sys.argv[1]):
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            doc = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if doc.get("partial"):
+            sys.exit(0)
+except OSError:
+    pass
+sys.exit(1)
+EOF
+}
+
 run_json () {  # run_json <dest.json> <label> <args...>
   local dest="$1" label="$2"; shift 2
   echo "--- $label start $(date -u +%FT%TZ)" >> "$LOG"
@@ -45,7 +92,7 @@ run_json () {  # run_json <dest.json> <label> <args...>
   local new_tpu
   new_tpu=$(tpu_lines "$dest.tmp")
   echo "--- $label rc=$rc tpu_lines=$new_tpu $(date -u +%FT%TZ)" >> "$LOG"
-  if [ $rc -eq 0 ] && [ "$new_tpu" -gt 0 ]; then
+  if [ $rc -eq 0 ] && [ "$new_tpu" -gt 0 ] && ! has_partial_doc "$dest.tmp"; then
     mv "$dest.tmp" "$dest"
     # an earlier failed take's .partial is superseded — but only when
     # this artifact is at least as rich (a CPU-fallback exit is rc=0
@@ -55,14 +102,21 @@ run_json () {  # run_json <dest.json> <label> <args...>
     fi
     echo "--- $label: TPU artifact written to $dest" >> "$LOG"
   elif [ "$new_tpu" -gt 0 ]; then
-    # failed/killed mid-phase but REAL TPU lines landed first: promote
-    # to a committed partial artifact (.tmp/.nontpu are gitignored —
-    # take 1's 13 TPU sweep entries died with the checkout this way).
+    # failed/killed mid-phase (bench's wedged watchdog now exits rc=3)
+    # or a partial:true doc slipped out under rc=0: REAL TPU lines
+    # landed, so promote to a committed PARTIAL artifact — never to
+    # $dest itself, so a wedged take cannot overwrite a previously
+    # committed complete artifact (.tmp/.nontpu are gitignored — take
+    # 1's 13 TPU sweep entries died with the checkout this way).
     # Never clobber a RICHER partial from a previous take with a
-    # poorer one (watcher relaunches after mid-battery crashes).
+    # poorer one (watcher relaunches after mid-battery crashes); on
+    # EQUAL line counts, compare single-doc richness (landed variant +
+    # slab entries) and prefer the newer take when at least as rich.
     local old_tpu
     old_tpu=$(tpu_lines "$dest.partial")
-    if [ "$new_tpu" -gt "$old_tpu" ]; then
+    if [ "$new_tpu" -gt "$old_tpu" ] ||
+       { [ "$new_tpu" -eq "$old_tpu" ] &&
+         [ "$(doc_richness "$dest.tmp")" -ge "$(doc_richness "$dest.partial")" ]; }; then
       mv "$dest.tmp" "$dest.partial"
       echo "--- $label: rc=$rc, $new_tpu TPU line(s); kept as $dest.partial" >> "$LOG"
     else

@@ -1,0 +1,297 @@
+"""Runtime autotuner + chain-slab scheduler (engine/autotune.py, slab.py).
+
+The cache tests monkeypatch ``autotune.probe_plan`` with a deterministic
+fake rater — ``probe_grid`` still walks the real candidate grid and bumps
+``PROBE_COUNT`` per candidate, so cache-hit assertions ("zero probes on
+the second run") exercise the real resolution path without timing real
+blocks.  Real-block probing is covered by the ``slow``-marked test at the
+acceptance shape (256 chains x 1080 s, narrowed grid).
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import Plan, SimConfig
+from tmhpvsim_tpu.engine import Simulation, SlabScheduler
+from tmhpvsim_tpu.engine import autotune
+
+
+def small_config(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=7200,
+        n_chains=3,
+        seed=7,
+        block_s=3600,
+        dtype="float32",
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+@pytest.fixture
+def tmp_cache(tmp_path, monkeypatch):
+    """Point the plan cache at a per-test file; returns its path."""
+    path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv("TMHPVSIM_AUTOTUNE_CACHE", path)
+    return path
+
+
+@pytest.fixture
+def fake_prober(monkeypatch):
+    """Replace the real-block probe with a deterministic rater: the
+    wide/unroll=4/unslabbed candidate wins (any fixed winner works —
+    the tests assert the CACHED plan equals the PROBED winner)."""
+    def fake(config, plan, n_timed=autotune.PROBE_TIMED_BLOCKS):
+        if (plan.block_impl == "wide" and plan.scan_unroll == 4
+                and plan.slab_chains == config.n_chains):
+            return 1000.0
+        return 10.0 + plan.scan_unroll
+
+    monkeypatch.setattr(autotune, "probe_plan", fake)
+    return fake
+
+
+def probes_during(fn):
+    """(result, number of candidate probes performed by fn())."""
+    before = autotune.PROBE_COUNT
+    out = fn()
+    return out, autotune.PROBE_COUNT - before
+
+
+WINNER = dict(block_impl="wide", scan_unroll=4)
+
+
+class TestPlanCache:
+    def test_auto_probes_once_then_hits(self, tmp_cache, fake_prober):
+        cfg = small_config(tune="auto")
+        plan, n1 = probes_during(lambda: autotune.resolve_plan(cfg))
+        assert n1 == len(autotune.candidate_plans(cfg))
+        assert plan.source == "probe"
+        assert plan.block_impl == WINNER["block_impl"]
+        assert plan.scan_unroll == WINNER["scan_unroll"]
+        assert plan.slab_chains == cfg.n_chains
+        # second resolution at the same key: zero probes, same plan
+        again, n2 = probes_during(lambda: autotune.resolve_plan(cfg))
+        assert n2 == 0
+        assert again.source == "cache"
+        assert dataclasses.replace(again, source=plan.source) == plan
+
+    def test_cache_round_trips_through_json(self, tmp_cache, fake_prober):
+        cfg = small_config(tune="auto")
+        autotune.resolve_plan(cfg)
+        doc = json.load(open(tmp_cache))
+        entry = doc[autotune.plan_key(cfg)]
+        assert entry["plan"]["block_impl"] == WINNER["block_impl"]
+        assert entry["plan"]["scan_unroll"] == WINNER["scan_unroll"]
+        # candidate records persist WITH their measured rates
+        cands = autotune.cached_candidates(cfg)
+        assert len(cands) == len(autotune.candidate_plans(cfg))
+        assert all("rate" in c for c in cands)
+
+    def test_key_mismatch_reprobes(self, tmp_cache, fake_prober):
+        autotune.resolve_plan(small_config(tune="auto"))
+        other = small_config(tune="auto", n_chains=5)
+        plan, n = probes_during(lambda: autotune.resolve_plan(other))
+        assert n == len(autotune.candidate_plans(other))
+        assert plan.source == "probe"
+        # both keys now live in one cache file
+        assert len(json.load(open(tmp_cache))) == 2
+
+    def test_off_is_static_and_free(self, tmp_cache, fake_prober):
+        cfg = small_config(tune="off")
+        plan, n = probes_during(lambda: autotune.resolve_plan(cfg))
+        assert n == 0
+        assert plan.source == "static"
+        assert plan.slab_chains == cfg.n_chains  # no slabbing
+        assert not os.path.exists(tmp_cache)     # no cache IO at all
+
+    def test_force_reprobes_on_a_hit(self, tmp_cache, fake_prober):
+        autotune.resolve_plan(small_config(tune="auto"))
+        cfg = small_config(tune="force")
+        plan, n = probes_during(lambda: autotune.resolve_plan(cfg))
+        assert n == len(autotune.candidate_plans(cfg))
+        assert plan.source == "probe"
+
+    def test_corrupt_cache_file_tolerated(self, tmp_cache, fake_prober):
+        with open(tmp_cache, "w") as f:
+            f.write("{not json")
+        cfg = small_config(tune="auto")
+        plan, n = probes_during(lambda: autotune.resolve_plan(cfg))
+        assert n > 0 and plan.source == "probe"
+        # the re-probe REPLACES the corrupt file with a valid one
+        assert autotune.plan_key(cfg) in json.load(open(tmp_cache))
+
+    def test_malformed_entry_reprobed(self, tmp_cache, fake_prober):
+        cfg = small_config(tune="auto")
+        with open(tmp_cache, "w") as f:
+            json.dump({autotune.plan_key(cfg): {"plan": {
+                "block_impl": "warp", "scan_unroll": 8,
+                "stats_fusion": "split", "slab_chains": 3}}}, f)
+        plan, n = probes_during(lambda: autotune.resolve_plan(cfg))
+        assert n > 0 and plan.source == "probe"
+
+    def test_bad_tune_value_raises(self, tmp_cache, fake_prober):
+        with pytest.raises(ValueError, match="tune"):
+            autotune.resolve_plan(small_config(tune="always"))
+
+    def test_all_candidates_failing_falls_back_static(self, tmp_cache,
+                                                      monkeypatch):
+        def boom(config, plan, n_timed=2):
+            raise RuntimeError("no device")
+
+        monkeypatch.setattr(autotune, "probe_plan", boom)
+        cfg = small_config(tune="auto")
+        plan = autotune.resolve_plan(cfg)
+        assert plan.source == "static"
+        assert not os.path.exists(tmp_cache)  # the fallback is not cached
+
+
+class TestSlabScheduler:
+    def test_run_reduced_bit_identical_to_unslabbed(self):
+        cfg = small_config(n_chains=6)
+        full = Simulation(cfg).run_reduced()
+        plan = dataclasses.replace(autotune.static_plan(cfg), slab_chains=2)
+        slabbed = SlabScheduler(cfg, plan).run_reduced()
+        assert set(slabbed) == set(full)
+        for name, arr in full.items():
+            np.testing.assert_array_equal(slabbed[name], arr, err_msg=name)
+
+    def test_uneven_slabs_bit_identical(self):
+        cfg = small_config(n_chains=5)
+        full = Simulation(cfg).run_reduced()
+        plan = dataclasses.replace(autotune.static_plan(cfg), slab_chains=2)
+        sched = SlabScheduler(cfg, plan)  # slabs of 2, 2, 1
+        assert len(sched) == 3
+        slabbed = sched.run_reduced()
+        for name, arr in full.items():
+            np.testing.assert_array_equal(slabbed[name], arr, err_msg=name)
+
+    def test_simulation_delegates_via_plan(self):
+        cfg = small_config(n_chains=6)
+        full = Simulation(cfg).run_reduced()
+        plan = dataclasses.replace(autotune.static_plan(cfg), slab_chains=2)
+        seen = []
+        got = Simulation(cfg, plan=plan).run_reduced(
+            on_block=lambda bi, state, acc: seen.append(bi))
+        for name, arr in full.items():
+            np.testing.assert_array_equal(got[name], arr, err_msg=name)
+        # on_block sees a GLOBAL slab-major block counter: 3 slabs x 2
+        # blocks each -> 0..5 monotonically
+        assert seen == list(range(6))
+
+    def test_run_ensemble_matches_unslabbed(self):
+        cfg = small_config(n_chains=6)
+        full = list(Simulation(cfg).run_ensemble())
+        plan = dataclasses.replace(autotune.static_plan(cfg), slab_chains=2)
+        slabbed = list(Simulation(cfg, plan=plan).run_ensemble())
+        assert [b.offset for b in slabbed] == [b.offset for b in full]
+        for s, f in zip(slabbed, full):
+            np.testing.assert_array_equal(s.epoch, f.epoch)
+            # weighted recombination of slab means reassociates the sum
+            # over chains -> allclose, not bitwise
+            np.testing.assert_allclose(s.meter, f.meter, rtol=1e-5)
+            np.testing.assert_allclose(s.pv, f.pv, rtol=1e-5)
+            np.testing.assert_allclose(s.residual, s.meter - s.pv)
+
+    def test_explicit_slab_configs_never_reslabbed(self):
+        cfg = small_config(n_chains=2, n_chains_total=6, chain_offset=2)
+        plan = dataclasses.replace(autotune.static_plan(cfg), slab_chains=1)
+        with pytest.raises(ValueError, match="n_chains_total"):
+            SlabScheduler(cfg, plan)
+        # and the Simulation guard (allow_slabs/_slab_scheduler) skips
+        # slabbing for such configs instead of raising
+        assert Simulation(cfg, plan=plan)._slab_scheduler() is None
+
+    def test_degenerate_slab_size_rejected(self):
+        cfg = small_config(n_chains=3)
+        plan = dataclasses.replace(autotune.static_plan(cfg), slab_chains=3)
+        with pytest.raises(ValueError, match="slab_chains"):
+            SlabScheduler(cfg, plan)
+
+
+class TestPlanParity:
+    """Plan choice is a performance decision, never a results decision:
+    within one block_impl every candidate (unroll, slab size) is BITWISE
+    identical; across impls the reduction order differs (float
+    reassociation) but n_seconds is exact everywhere."""
+
+    def test_unroll_and_slab_bitwise_within_impl(self):
+        cfg = small_config(n_chains=4, block_impl="scan")
+        base = None
+        for unroll, slab in ((1, 4), (8, 4), (8, 2)):
+            plan = dataclasses.replace(
+                autotune.static_plan(cfg), scan_unroll=unroll,
+                slab_chains=slab)
+            out = Simulation(cfg, plan=plan).run_reduced()
+            if base is None:
+                base = out
+                continue
+            for name, arr in base.items():
+                np.testing.assert_array_equal(out[name], arr,
+                                              err_msg=f"u{unroll}/s{slab}: "
+                                                      f"{name}")
+
+    def test_impls_agree_to_float_tolerance(self):
+        cfg = small_config(n_chains=3)
+        outs = {}
+        for impl in ("wide", "scan", "scan2"):
+            plan = dataclasses.replace(autotune.static_plan(cfg),
+                                       block_impl=impl)
+            outs[impl] = Simulation(cfg, plan=plan).run_reduced()
+        for impl in ("scan", "scan2"):
+            np.testing.assert_array_equal(
+                outs[impl]["n_seconds"], outs["wide"]["n_seconds"])
+            for name, arr in outs["wide"].items():
+                np.testing.assert_allclose(outs[impl][name], arr, rtol=1e-4,
+                                           err_msg=f"{impl}: {name}")
+
+
+class TestMeshPlan:
+    def test_mesh_plan_pins_slabbing_off(self, tmp_cache, fake_prober):
+        cfg = small_config(n_chains=8, tune="auto")
+        plan = autotune.resolve_plan_for_mesh(cfg, n_dev=4)
+        # probed at the per-device shape, but the returned plan never
+        # slabs the sharded loop
+        assert plan.slab_chains == cfg.n_chains
+
+    def test_mesh_plan_off_is_static(self, tmp_cache, fake_prober):
+        cfg = small_config(n_chains=8, tune="off")
+        plan, n = probes_during(
+            lambda: autotune.resolve_plan_for_mesh(cfg, n_dev=4))
+        assert n == 0 and plan.source == "static"
+
+
+@pytest.mark.slow
+def test_real_probe_beats_or_matches_static(tmp_path, monkeypatch):
+    """Acceptance: on CPU at 256 chains x 1080 s, tune='auto' picks a plan
+    whose MEASURED rate is >= the static default candidate's, and the
+    second resolution is a pure cache hit (zero probes).  Real blocks are
+    timed -> slow lane; the candidate grid is narrowed to keep it
+    minutes, not hours."""
+    monkeypatch.setenv("TMHPVSIM_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    monkeypatch.setattr(autotune, "CANDIDATE_UNROLLS", (1, 8))
+    monkeypatch.setattr(autotune, "CANDIDATE_SLAB_CHAINS", (None,))
+    cfg = SimConfig(start="2019-09-05 00:00:00", duration_s=1080 * 3,
+                    n_chains=256, seed=0, block_s=1080, dtype="float32",
+                    tune="auto")
+    plan, n = probes_during(lambda: autotune.resolve_plan(cfg))
+    assert n == len(autotune.candidate_plans(cfg)) > 0
+    assert plan.source == "probe"
+
+    static = autotune.static_plan(cfg)
+    cands = autotune.cached_candidates(cfg)
+    rated = {(c["block_impl"], c["scan_unroll"]): c["rate"]
+             for c in cands if "rate" in c}
+    best_rate = max(rated.values())
+    static_rate = rated[(static.block_impl, static.scan_unroll)]
+    assert best_rate >= static_rate
+    assert rated[(plan.block_impl, plan.scan_unroll)] == best_rate
+
+    _, n2 = probes_during(lambda: autotune.resolve_plan(cfg))
+    assert n2 == 0

@@ -25,7 +25,16 @@ import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # jax < 0.5 spells it as an XLA flag
+    import os as _os
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=4")
+try:  # jax < 0.5: cross-process CPU collectives need the gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass  # newer jax: gloo is the default
 
 from tmhpvsim_tpu.parallel.distributed import (
     initialize_from_env, local_chain_slice,
@@ -37,8 +46,9 @@ assert jax.local_device_count() == 4
 assert jax.device_count() == 8
 
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 from jax.experimental import multihost_utils
+
+from tmhpvsim_tpu.parallel import shard_map  # version-compat shim
 
 from tmhpvsim_tpu.parallel import make_mesh
 from tmhpvsim_tpu.parallel.mesh import CHAIN_AXIS
@@ -148,7 +158,16 @@ import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # jax < 0.5 spells it as an XLA flag
+    import os as _os
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=4")
+try:  # jax < 0.5: cross-process CPU collectives need the gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass  # newer jax: gloo is the default
 
 from tmhpvsim_tpu.parallel.distributed import (
     initialize_from_env, local_chain_slice,
@@ -209,7 +228,16 @@ import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # jax < 0.5 spells it as an XLA flag
+    import os as _os
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=4")
+try:  # jax < 0.5: cross-process CPU collectives need the gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass  # newer jax: gloo is the default
 
 from tmhpvsim_tpu.parallel.distributed import initialize_from_env
 assert initialize_from_env()

@@ -23,11 +23,13 @@ def test_scan_matches_python_loop():
     n = 100
     key = jax.random.key(42)
     params = mh.step_params(jnp.float64)
-    keys = jax.random.split(key, n)
     state = jnp.asarray(1.0, dtype=jnp.float64)
     loop = []
     for i in range(n):
-        state = mh.transition(keys[i], state, params, jnp.float64)
+        # transition i is keyed by fold_in(key, i) — the random-access
+        # keying contract of chain_window/chain
+        state = mh.transition(jax.random.fold_in(key, i), state, params,
+                              jnp.float64)
         loop.append(float(state))
     scan = np.asarray(mh.chain(key, n, dtype=jnp.float64))
     np.testing.assert_allclose(scan, np.asarray(loop), rtol=1e-12, atol=1e-14)

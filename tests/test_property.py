@@ -11,7 +11,10 @@ import datetime as dt
 import math
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tmhpvsim_tpu.runtime.funnel import SynchronizingFunnel
 from tmhpvsim_tpu.models import renewal

@@ -142,7 +142,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               profile_dir: Optional[str] = None,
               output: str = "trace",
               prng_impl: str = "threefry2x32",
-              block_impl: str = "auto") -> None:
+              block_impl: str = "auto",
+              tune: str = "off") -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -213,6 +214,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         output=output,
         prng_impl=prng_impl,
         block_impl=block_impl,
+        tune=tune,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation
@@ -221,6 +223,18 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     else:
         sim = Simulation(cfg)
     cfg = sim.config  # site_grid may have adjusted n_chains
+    plan = sim.plan
+    logger.info(
+        "plan [%s]: block_impl=%s scan_unroll=%d stats_fusion=%s "
+        "slab_chains=%d", plan.source, plan.block_impl, plan.scan_unroll,
+        plan.stats_fusion, plan.slab_chains,
+    )
+    if checkpoint and plan.slab_chains < cfg.n_chains:
+        # a slabbed run has no single resumable state pytree; checkpointed
+        # runs execute unslabbed (the plan's other knobs still apply)
+        sim.allow_slabs = False
+        logger.info("checkpointing disables chain slabbing "
+                    "(slab_chains=%d ignored)", plan.slab_chains)
 
     if output == "reduce":
         if realtime:
@@ -239,7 +253,11 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                         checkpoint, start_block)
         trace = device_trace(profile_dir) if profile_dir else \
             contextlib.nullcontext()
-        timer = BlockTimer(cfg.n_chains, cfg.block_s)
+        # under a slabbing plan each on_block tick covers one slab-sized
+        # block (engine/slab.py), not the full chain batch
+        n_tick = (plan.slab_chains if sim.allow_slabs
+                  and plan.slab_chains < cfg.n_chains else cfg.n_chains)
+        timer = BlockTimer(n_tick, cfg.block_s)
 
         def on_block(bi, state, acc):
             timer.tick()

@@ -179,10 +179,17 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "scan-fused on accelerators, wide on CPU; scan2 nests "
                    "per-minute RNG tiles (jax backend, see "
                    "config.SimConfig.block_impl)")
+@click.option("--tune", type=click.Choice(["off", "auto", "force"]),
+              default="off",
+              help="runtime autotuner: auto = use/populate the persistent "
+                   "per-device plan cache (short real-block probes on a "
+                   "miss); force = re-probe even on a hit; the resolved "
+                   "plan is echoed in the logs (jax backend, see "
+                   "config.SimConfig.tune)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, backend, n_chains, chain, sharded, checkpoint, block_s,
           site_grid_spec, sites_csv, profile_dir, output, prng_impl,
-          block_impl):
+          block_impl, tune):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     if (site_grid_spec or sites_csv) and backend != "jax":
@@ -199,6 +206,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--prng-impl requires --backend=jax")
     if block_impl != "auto" and backend != "jax":
         raise click.UsageError("--block-impl requires --backend=jax")
+    if tune != "off" and backend != "jax":
+        raise click.UsageError("--tune requires --backend=jax")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
@@ -233,7 +242,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   sharded, checkpoint, block_s, realtime=realtime,
                   site_grid=site_grid, profile_dir=profile_dir,
                   output=output, prng_impl=prng_impl,
-                  block_impl=block_impl)
+                  block_impl=block_impl, tune=tune)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

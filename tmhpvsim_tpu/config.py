@@ -155,6 +155,24 @@ class SiteGrid:
         )
 
 
+def slice_grid(grid: Optional[SiteGrid], off: int, n: int
+               ) -> Optional[SiteGrid]:
+    """``grid`` restricted to sites [off, off+n) — the per-chain site rows
+    a chain slab (or an autotune probe) of those chains simulates.  None
+    passes through (single-site configs have no grid to slice)."""
+    if grid is None:
+        return None
+    return dataclasses.replace(
+        grid,
+        latitude=tuple(grid.latitude[off:off + n]),
+        longitude=tuple(grid.longitude[off:off + n]),
+        altitude=tuple(grid.altitude[off:off + n]),
+        surface_tilt=tuple(grid.surface_tilt[off:off + n]),
+        surface_azimuth=tuple(grid.surface_azimuth[off:off + n]),
+        albedo=tuple(grid.albedo[off:off + n]),
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelOptions:
     """Behavioural switches for the stochastic model.
@@ -193,8 +211,48 @@ class ModelOptions:
 
 
 @dataclasses.dataclass(frozen=True)
+class Plan:
+    """A fully-RESOLVED execution plan: the knobs the engine actually runs
+    with, after ``'auto'`` defaults, the autotuner, or a cache entry have
+    been applied (engine/autotune.py).
+
+    Unlike the corresponding ``SimConfig`` fields, nothing here is
+    symbolic: ``block_impl`` is one of the three concrete formulations,
+    ``stats_fusion`` one of the two concrete topologies, and
+    ``slab_chains`` the concrete chain-slab size the ``SlabScheduler``
+    executes (``slab_chains >= n_chains`` means no slabbing).  Every
+    candidate plan of one config produces the same simulation up to float
+    reassociation — within one ``block_impl``, unroll and slab variations
+    are BIT-identical (keyed construction; tested in
+    tests/test_autotune.py) — so plan choice is a pure performance
+    decision.
+    """
+
+    #: resolved block formulation: 'wide' | 'scan' | 'scan2'
+    block_impl: str
+    #: lax.scan unroll factor (SimConfig.scan_unroll)
+    scan_unroll: int
+    #: resolved reduce-mode jit topology: 'fused' | 'split'
+    stats_fusion: str
+    #: chains per sequential slab; >= n_chains disables slabbing
+    slab_chains: int
+    #: provenance: 'static' (auto-defaults, no measurement) | 'probe'
+    #: (measured this process) | 'cache' (persisted probe result) |
+    #: 'broadcast' (received from process 0 on a multi-host mesh)
+    source: str = "static"
+
+
+@dataclasses.dataclass(frozen=True)
 class SimConfig:
-    """One simulation run: the time grid, the batch, and the output mode."""
+    """One simulation run: the time grid, the batch, and the output mode.
+
+    The performance knobs (``block_impl``, ``scan_unroll``,
+    ``stats_fusion`` and the chain-slab size) are REQUESTS: the engine
+    resolves them into a concrete :class:`Plan` at construction —
+    statically when ``tune='off'``, by measured probe (or a persisted
+    probe result) when ``tune='auto'``/``'force'`` (engine/autotune.py).
+    ``Simulation.plan`` records what actually ran.
+    """
 
     start: str = "2019-09-05 12:00:00"   # naive local wall time at `site.timezone`
     duration_s: int = 86_400             # simulated seconds (1 Hz grid)
@@ -272,6 +330,19 @@ class SimConfig:
     #: (measured on TPU v5e: the split path writes + re-reads ~566 MB per
     #: 65536x1080 block).  'auto' picks fused on accelerators, split on CPU.
     stats_fusion: str = "auto"
+
+    #: runtime autotuning of the performance knobs (engine/autotune.py).
+    #: 'off'   -> resolve 'auto' knobs statically (backend heuristics; the
+    #:            historical behaviour, zero overhead)
+    #: 'auto'  -> look up a measured plan in the persistent per-device
+    #:            cache (~/.cache/tmhpvsim_tpu/autotune.json, overridable
+    #:            via TMHPVSIM_AUTOTUNE_CACHE); on a miss, time a small
+    #:            candidate grid (block_impl x scan_unroll x slab size)
+    #:            with short real-block probes, pick the fastest and
+    #:            persist it — subsequent runs at the same key pay zero
+    #:            probe cost
+    #: 'force' -> re-probe even on a cache hit (refresh a stale entry)
+    tune: str = "off"
 
     #: JAX PRNG implementation for every stochastic draw.  'threefry2x32'
     #: (the JAX default) is fully counter-based and splittable but costs

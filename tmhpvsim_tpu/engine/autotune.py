@@ -1,0 +1,435 @@
+"""Runtime autotuner: probe-based execution-plan selection with a
+persistent per-device cache.
+
+Round-5 hardware runs (benchmarks/PERF_ANALYSIS.md §7a) proved throughput
+is a cliff function of the static knobs: the scan-fused block at
+65536x1080 runs 3.5 ms at ``scan_unroll=8`` but 60-193 ms once the
+unrolled live set spills VMEM, and the winning combination differs by
+backend (CPU prefers ``wide``, TPU ``scan``, long-horizon shapes
+``scan2``).  This module makes that tuning a subsystem instead of
+folklore:
+
+* :func:`static_plan` — the historical ``'auto'`` heuristics, resolved
+  into a concrete :class:`~tmhpvsim_tpu.config.Plan` (``tune='off'``,
+  zero overhead);
+* :func:`probe_grid` — time a small candidate grid (``block_impl`` x
+  ``scan_unroll`` x slab size) with short REAL-block probes: compile
+  once, time a couple of steady blocks, and free each candidate
+  Simulation before the next so HBM-residency poisoning (§7a fact 2:
+  a resident sim degraded later timed runs up to 30x) cannot skew the
+  comparison.  Every candidate of one config simulates the same run
+  (keyed construction), so plan choice is purely a performance decision;
+* a JSON cache keyed by (device kind, backend, n_chains, block_s, dtype,
+  prng_impl, engine version) under ``~/.cache/tmhpvsim_tpu/autotune.json``
+  (override: ``TMHPVSIM_AUTOTUNE_CACHE``) so later runs at the same key
+  pay zero probe cost;
+* :func:`resolve_plan_for_mesh` — multi-host meshes probe on process 0
+  at the per-device shape and broadcast the winner, so every host runs
+  the same plan without N hosts re-probing.
+
+``bench.py`` shares :func:`time_reduce_blocks` (its variant sweep and
+these probes are the same measurement protocol).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import tempfile
+import time
+
+from tmhpvsim_tpu.config import Plan, SimConfig, slice_grid
+
+logger = logging.getLogger(__name__)
+
+#: bump when the engine's block formulations change meaning: stale cache
+#: entries (different key) are simply ignored, never misapplied
+AUTOTUNE_ENGINE_VERSION = 1
+
+#: candidate grid (module-level so tests/callers can narrow it)
+CANDIDATE_IMPLS = ("wide", "scan", "scan2")
+CANDIDATE_UNROLLS = (1, 4, 8, 12)
+#: slab sizes; None means n_chains (no slabbing).  65536 is the measured
+#: single-chip sweet spot, 16384 a guard for smaller-VMEM parts.
+CANDIDATE_SLAB_CHAINS = (None, 65536, 16384)
+
+#: steady blocks timed per probe (after the one compile/warm-up block)
+PROBE_TIMED_BLOCKS = 2
+
+#: probes performed by this process (tests assert cache hits via this)
+PROBE_COUNT = 0
+
+
+# ---------------------------------------------------------------------------
+# static resolution (tune='off' and the probe fallback)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_fusion(config: SimConfig) -> str:
+    import jax
+
+    if config.stats_fusion == "auto":
+        return "fused" if jax.default_backend() != "cpu" else "split"
+    if config.stats_fusion in ("fused", "split"):
+        return config.stats_fusion
+    raise ValueError(
+        f"stats_fusion must be 'auto', 'fused' or 'split', "
+        f"got {config.stats_fusion!r}"
+    )
+
+
+def _resolve_impl(config: SimConfig) -> str:
+    import jax
+
+    if config.block_impl == "auto":
+        return "scan" if jax.default_backend() != "cpu" else "wide"
+    if config.block_impl in ("wide", "scan", "scan2"):
+        return config.block_impl
+    raise ValueError(
+        f"block_impl must be 'auto', 'wide', 'scan' or 'scan2', "
+        f"got {config.block_impl!r}"
+    )
+
+
+def static_plan(config: SimConfig) -> Plan:
+    """The un-measured plan: 'auto' knobs resolved by backend heuristic
+    (scan+fused on accelerators, wide+split on CPU — the historical
+    behaviour), no slabbing."""
+    return Plan(
+        block_impl=_resolve_impl(config),
+        scan_unroll=config.scan_unroll,
+        stats_fusion=_resolve_fusion(config),
+        slab_chains=config.n_chains,
+        source="static",
+    )
+
+
+# ---------------------------------------------------------------------------
+# measurement (shared with bench.py)
+# ---------------------------------------------------------------------------
+
+
+def time_reduce_blocks(sim, n_blocks: int, n_rounds: int = 1,
+                       profile_dir=None):
+    """(compile_s, best_steady_s, rate): one warm-up block, then n_rounds x
+    n_blocks timed reduce-mode blocks through the public step_acc path,
+    best round kept (the tunnel TPU's throughput varies ~2x between
+    otherwise identical runs).  ``sim.n_blocks`` must cover
+    1 + n_blocks*n_rounds blocks; rate is simulated site-seconds per wall
+    second."""
+    import contextlib
+
+    import jax
+
+    from tmhpvsim_tpu.engine.simulation import InputPrefetcher
+
+    sim.state = sim.init_state()
+    acc = sim.init_reduce_acc()
+    pf = InputPrefetcher(sim, 0, sim.n_blocks)
+    t_c = time.perf_counter()
+    inputs, _ = pf.get(0)
+    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+    jax.block_until_ready(acc)
+    compile_s = time.perf_counter() - t_c
+
+    trace = contextlib.nullcontext()
+    if profile_dir:
+        from tmhpvsim_tpu.engine.profiling import device_trace
+
+        trace = device_trace(profile_dir)
+
+    best = float("inf")
+    bi = 1
+    try:
+        with trace:
+            for _ in range(n_rounds):
+                t0 = time.perf_counter()
+                for _ in range(n_blocks):
+                    inputs, _ = pf.get(bi)
+                    bi += 1
+                    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+                jax.block_until_ready(acc)
+                best = min(best, time.perf_counter() - t0)
+    finally:
+        pf.close()
+    n = sim.config.n_chains
+    bs = sim.config.block_s
+    return compile_s, best, n * bs * n_blocks / best
+
+
+def probe_plan(config: SimConfig, plan: Plan,
+               n_timed: int = PROBE_TIMED_BLOCKS) -> float:
+    """Measure one candidate plan with a short real-block run; returns its
+    rate (site-seconds/wall-second).
+
+    The probe simulates ``min(n_chains, slab_chains)`` chains for
+    ``n_timed + 1`` blocks of the target ``block_s`` — the slab-sized
+    shape each slab of the full run would execute — through the same
+    timed path as bench.py's variants.  The candidate Simulation goes out
+    of scope before the next candidate compiles, freeing its device
+    buffers (HBM-residency poisoning, module docstring)."""
+    from tmhpvsim_tpu.engine.simulation import Simulation
+
+    n = min(config.n_chains, plan.slab_chains)
+    pcfg = dataclasses.replace(
+        config,
+        tune="off",
+        n_chains=n,
+        n_chains_total=None,
+        chain_offset=0,
+        site_grid=slice_grid(config.site_grid, 0, n),
+        duration_s=config.block_s * (n_timed + 1),
+        output="reduce",
+    )
+    sim = Simulation(pcfg, plan=dataclasses.replace(plan, slab_chains=n))
+    _, _, rate = time_reduce_blocks(sim, n_timed, 1)
+    del sim  # free device buffers before the next candidate compiles
+    return rate
+
+
+def candidate_plans(config: SimConfig, slabs: bool = True) -> list:
+    """The candidate grid for one config: block_impl x scan_unroll x slab
+    size, with an explicitly pinned (non-'auto') ``block_impl`` respected
+    and slab sizes >= n_chains deduplicated to the unslabbed candidate.
+    ``slabs=False`` drops the slab dimension (per-mesh tuning probes at
+    the fixed per-device shape)."""
+    fusion = _resolve_fusion(config)
+    impls = (CANDIDATE_IMPLS if config.block_impl == "auto"
+             else (_resolve_impl(config),))
+    slab_sizes = []
+    for s in (CANDIDATE_SLAB_CHAINS if slabs else (None,)):
+        n = config.n_chains if s is None else min(s, config.n_chains)
+        if n > 0 and n not in slab_sizes:
+            slab_sizes.append(n)
+    return [
+        Plan(block_impl=impl, scan_unroll=u, stats_fusion=fusion,
+             slab_chains=slab, source="probe")
+        for impl in impls
+        for u in CANDIDATE_UNROLLS
+        for slab in slab_sizes
+    ]
+
+
+def probe_grid(config: SimConfig, slabs: bool = True) -> tuple:
+    """Time every candidate plan; returns (best plan, candidate records).
+
+    A candidate that fails to compile/run is recorded with its error and
+    skipped; if every candidate fails the static plan is returned so a
+    broken probe environment degrades to the historical behaviour instead
+    of killing the run."""
+    global PROBE_COUNT
+    best = None
+    records = []
+    for plan in candidate_plans(config, slabs=slabs):
+        PROBE_COUNT += 1
+        rec = {
+            "block_impl": plan.block_impl,
+            "scan_unroll": plan.scan_unroll,
+            "stats_fusion": plan.stats_fusion,
+            "slab_chains": plan.slab_chains,
+        }
+        try:
+            rate = probe_plan(config, plan)
+        except Exception as e:
+            logger.warning("autotune candidate %s failed: %s", rec, e)
+            rec["error"] = str(e)[:200]
+            records.append(rec)
+            continue
+        rec["rate"] = round(rate, 1)
+        records.append(rec)
+        logger.info("autotune probe impl=%s unroll=%d slab=%d: %.3g "
+                    "site-s/s", plan.block_impl, plan.scan_unroll,
+                    plan.slab_chains, rate)
+        if best is None or rate > best[1]:
+            best = (plan, rate)
+    if best is None:
+        logger.warning("every autotune candidate failed; falling back to "
+                       "the static plan")
+        return static_plan(config), records
+    return best[0], records
+
+
+# ---------------------------------------------------------------------------
+# persistent per-device cache
+# ---------------------------------------------------------------------------
+
+
+def cache_path() -> str:
+    env = os.environ.get("TMHPVSIM_AUTOTUNE_CACHE")
+    if env:
+        return env
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    return os.path.join(root, "tmhpvsim_tpu", "autotune.json")
+
+
+def plan_key(config: SimConfig) -> str:
+    """Cache key: everything the winning plan is conditional on — the
+    device model + backend and the shape/dtype/PRNG knobs that move the
+    optimum — plus the engine version (stale formulations never match)."""
+    import jax
+
+    dev = jax.devices()[0]
+    return "|".join(str(x) for x in (
+        dev.device_kind, jax.default_backend(), config.n_chains,
+        config.block_s, config.dtype, config.prng_impl,
+        AUTOTUNE_ENGINE_VERSION,
+    ))
+
+
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}  # missing or corrupt: behave like a cold cache
+
+
+def _plan_from_entry(entry: dict) -> Plan:
+    p = entry["plan"]
+    plan = Plan(
+        block_impl=str(p["block_impl"]),
+        scan_unroll=int(p["scan_unroll"]),
+        stats_fusion=str(p["stats_fusion"]),
+        slab_chains=int(p["slab_chains"]),
+        source="cache",
+    )
+    if plan.block_impl not in ("wide", "scan", "scan2") or \
+            plan.stats_fusion not in ("fused", "split") or \
+            plan.scan_unroll < 1 or plan.slab_chains < 1:
+        raise ValueError(f"malformed cached plan {p!r}")
+    return plan
+
+
+def _store_plan(path: str, key: str, plan: Plan, candidates: list) -> None:
+    """Merge one entry into the cache, atomically (tmp + rename) so a
+    concurrent reader never sees a torn file.  Cache write failures are
+    logged, not raised — the plan is already resolved."""
+    try:
+        cache = _load_cache(path)
+        cache[key] = {
+            "plan": {
+                "block_impl": plan.block_impl,
+                "scan_unroll": plan.scan_unroll,
+                "stats_fusion": plan.stats_fusion,
+                "slab_chains": plan.slab_chains,
+            },
+            "candidates": candidates,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(cache, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            os.unlink(tmp)
+            raise
+    except OSError as e:
+        logger.warning("autotune cache write failed (%s): %s", path, e)
+
+
+def cached_candidates(config: SimConfig) -> list:
+    """The probe records persisted with this config's cached plan
+    ([] when the key is absent) — lets callers/tests compare the winner
+    against the other candidates without re-probing."""
+    entry = _load_cache(cache_path()).get(plan_key(config))
+    return list(entry.get("candidates", ())) if entry else []
+
+
+# ---------------------------------------------------------------------------
+# resolution entry points
+# ---------------------------------------------------------------------------
+
+
+def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
+    """The plan a :class:`Simulation` of ``config`` should run.
+
+    ``tune='off'``: the static plan (no measurement, no cache IO).
+    ``tune='auto'``: the cached plan for this key if present, else probe
+    the candidate grid and persist the winner.  ``tune='force'``: probe
+    and persist even on a cache hit."""
+    if config.tune == "off":
+        return static_plan(config)
+    if config.tune not in ("auto", "force"):
+        raise ValueError(
+            f"tune must be 'auto', 'off' or 'force', got {config.tune!r}"
+        )
+    path = cache_path()
+    key = plan_key(config)
+    if config.tune == "auto":
+        entry = _load_cache(path).get(key)
+        if entry is not None:
+            try:
+                return _plan_from_entry(entry)
+            except (KeyError, TypeError, ValueError) as e:
+                logger.warning("ignoring malformed autotune cache entry "
+                               "for %s: %s", key, e)
+    plan, candidates = probe_grid(config, slabs=slabs)
+    if plan.source == "probe":  # don't cache the all-failed fallback
+        _store_plan(path, key, plan, candidates)
+    return plan
+
+
+def broadcast_plan(plan: Plan) -> Plan:
+    """Process 0's plan on every process of a multi-host run (no-op
+    single-process).  Encoded as a small int array over the existing
+    jax.distributed channel — no new transport."""
+    import jax
+
+    if jax.process_count() == 1:
+        return plan
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    impls = ("wide", "scan", "scan2")
+    fusions = ("split", "fused")
+    enc = np.asarray([
+        impls.index(plan.block_impl), plan.scan_unroll,
+        plan.slab_chains, fusions.index(plan.stats_fusion),
+    ], dtype=np.int32)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(enc))
+    source = plan.source if jax.process_index() == 0 else "broadcast"
+    return Plan(
+        block_impl=impls[int(out[0])],
+        scan_unroll=int(out[1]),
+        stats_fusion=fusions[int(out[3])],
+        slab_chains=int(out[2]),
+        source=source,
+    )
+
+
+def resolve_plan_for_mesh(config: SimConfig, n_dev: int) -> Plan:
+    """Plan resolution for a sharded run over ``n_dev`` devices: probe at
+    the PER-DEVICE chain shape (that is what each chip executes under
+    shard_map), on process 0 only, and broadcast the winner so every host
+    runs the same plan.  Slabbing is disabled — the sharded loop drives
+    all devices in lockstep, so the slab dimension does not apply."""
+    import jax
+
+    if config.tune == "off":
+        plan = static_plan(config)
+    else:
+        n_eff = (len(config.site_grid) if config.site_grid is not None
+                 else config.n_chains)
+        per_dev = max(1, n_eff // n_dev)
+        pcfg = dataclasses.replace(
+            config,
+            n_chains=per_dev,
+            n_chains_total=None,
+            chain_offset=0,
+            site_grid=slice_grid(config.site_grid, 0, per_dev),
+        )
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            plan = static_plan(pcfg)  # replaced by the broadcast below
+        else:
+            plan = resolve_plan(pcfg, slabs=False)
+        plan = broadcast_plan(plan)
+    # slabbing never applies to the sharded loop; pin it off
+    n_eff = (len(config.site_grid) if config.site_grid is not None
+             else config.n_chains)
+    return dataclasses.replace(plan, slab_chains=n_eff)

@@ -150,7 +150,7 @@ class Simulation:
         stats = sim.run_reduced()        # or: per-chain running statistics
     """
 
-    def __init__(self, config: SimConfig):
+    def __init__(self, config: SimConfig, plan=None):
         if config.block_s % 60 != 0:
             raise ValueError("block_s must be a multiple of 60 (minute grid)")
         if config.site_grid is not None and \
@@ -173,6 +173,17 @@ class Simulation:
         elif config.chain_offset:
             raise ValueError("chain_offset requires n_chains_total")
         self.config = config
+        # Resolve the execution plan (engine/autotune.py): static for
+        # tune='off', measured/cached otherwise.  AFTER the site-grid
+        # n_chains override, so probes and cache keys see the real batch.
+        from tmhpvsim_tpu.engine import autotune
+
+        self.plan = autotune.resolve_plan(config) if plan is None else plan
+        #: subclasses/callers with their own partitioning (the sharded
+        #: mesh loop, checkpointed runs in apps/pvsim.py) clear this to
+        #: keep run_reduced/run_ensemble from delegating to the
+        #: SlabScheduler
+        self.allow_slabs = True
         tz = (config.site_grid.timezone if config.site_grid is not None
               else config.site.timezone)
         self._padded_s = _round_up(config.duration_s, config.block_s)
@@ -221,25 +232,11 @@ class Simulation:
                                         donate_argnums=0)
         self._scan2_series_jit = jax.jit(self._block_step_scan2_series,
                                          donate_argnums=0)
-        if config.stats_fusion == "auto":
-            self._use_fused = jax.default_backend() != "cpu"
-        elif config.stats_fusion in ("fused", "split"):
-            self._use_fused = config.stats_fusion == "fused"
-        else:
-            raise ValueError(
-                f"stats_fusion must be 'auto', 'fused' or 'split', "
-                f"got {config.stats_fusion!r}"
-            )
-        if config.block_impl == "auto":
-            self._impl = "scan" if jax.default_backend() != "cpu" \
-                else "wide"
-        elif config.block_impl in ("wide", "scan", "scan2"):
-            self._impl = config.block_impl
-        else:
-            raise ValueError(
-                f"block_impl must be 'auto', 'wide', 'scan' or 'scan2', "
-                f"got {config.block_impl!r}"
-            )
+        # the RESOLVED knobs come from the plan (auto heuristics, a probe,
+        # or a cache entry — engine/autotune.py), not the raw config
+        self._use_fused = self.plan.stats_fusion == "fused"
+        self._impl = self.plan.block_impl
+        self._unroll = self.plan.scan_unroll
         #: scan-family impls share the ensemble series path and labels
         self._use_scan = self._impl in ("scan", "scan2")
         self._series_jit = jax.jit(self._ensemble_series)
@@ -328,13 +325,30 @@ class Simulation:
         return self._memo_jit("state", sharding, build)()
 
     def _memo_jit(self, kind, sharding, build):
-        """One jitted zero-arg initializer per (kind, sharding)."""
+        """One jitted zero-arg initializer per (kind, sharding).
+
+        On a fully-addressable (single-host) mesh the sharding is applied
+        by ``device_put`` AFTER an unsharded compile rather than as
+        ``out_shardings``: compiling the initializer through the SPMD
+        partitioner trips a dtype verifier bug in jax 0.4.x gamma/t
+        while-loops (s64 vs s32 compare), and the layout of a one-shot
+        initializer is not perf-critical.  Multi-host meshes keep
+        ``out_shardings`` — ``device_put`` cannot target other hosts'
+        devices there (and the partitioner path is required anyway).
+        """
         key = (kind, sharding)
         fn = self._init_jits.get(key)
         if fn is None:
-            fn = self._init_jits[key] = jax.jit(
-                build, out_shardings=sharding
-            )
+            if sharding is not None and getattr(
+                sharding, "is_fully_addressable", True
+            ):
+                inner = jax.jit(build)
+
+                def fn(_inner=inner, _sh=sharding):
+                    return jax.device_put(_inner(), _sh)
+            else:
+                fn = jax.jit(build, out_shardings=sharding)
+            self._init_jits[key] = fn
         return fn
 
     # ------------------------------------------------------------------
@@ -531,7 +545,7 @@ class Simulation:
             carry, csi, _covered = ci.csi_scan_block(
                 chain["k_scan"], arrays, mvals, mlo,
                 chain["carry"], block_idx, cfg.options, dtype,
-                unroll=cfg.scan_unroll,
+                unroll=self._unroll,
                 cloudy_pair=chain["cloudy_pair"],
             )
             ac = pvmod.power_from_csi(
@@ -592,7 +606,16 @@ class Simulation:
         scan-fused series step that sums across chains inside the scan
         body and never materialises (n_chains, block_s) arrays; or
         (``'scan2'``) its nested variant with per-minute RNG tiles.
+
+        When the resolved plan slabs the chain batch (engine/slab.py) a
+        fresh run delegates to the SlabScheduler, which combines the
+        slabs' fleet means chain-count-weighted; resumes (state/
+        start_block) always run unslabbed.
         """
+        if state is None and start_block == 0:
+            sched = self._slab_scheduler()
+            if sched is not None:
+                return sched.run_ensemble()
         inv_n = 1.0 / self.config.n_chains
         use_scan = self._use_scan
         if self._impl == "scan2":
@@ -797,7 +820,7 @@ class Simulation:
         xs, step, cc_carry = self._scan_block_setup(state, inputs)
         (rcarry, acc), _ = jax.lax.scan(
             self._make_acc_body(step), (state["carry"], acc), xs,
-            unroll=cfg.scan_unroll,
+            unroll=self._unroll,
         )
         return dict(state, carry=rcarry, cc_carry=cc_carry), acc
 
@@ -861,7 +884,7 @@ class Simulation:
 
         def inner(carry, xs_inner):
             return jax.lax.scan(inner_body, carry, xs_inner,
-                                unroll=cfg.scan_unroll)[0], None
+                                unroll=self._unroll)[0], None
 
         (rcarry, acc), _ = self._scan2_outer(
             state, xs, inner, (state["carry"], acc)
@@ -885,7 +908,7 @@ class Simulation:
 
         def inner(carry, xs_inner):
             return jax.lax.scan(body, carry, xs_inner,
-                                unroll=cfg.scan_unroll)
+                                unroll=self._unroll)
 
         rcarry, (m_sum, p_sum) = self._scan2_outer(
             state, xs, inner, state["carry"]
@@ -907,7 +930,7 @@ class Simulation:
             return rc, (meter.sum(), ac.sum())
 
         rcarry, (m_sum, p_sum) = jax.lax.scan(
-            body, state["carry"], xs, unroll=self.config.scan_unroll
+            body, state["carry"], xs, unroll=self._unroll
         )
         return dict(state, carry=rcarry, cc_carry=cc_carry), m_sum, p_sum
 
@@ -995,6 +1018,17 @@ class Simulation:
                 "resuming run_reduced needs the checkpointed accumulator: "
                 "pass acc= alongside state=/start_block="
             )
+        if state is None and acc is None and start_block == 0:
+            # a fresh run under a slabbing plan executes as sequential
+            # slab-sized runs (engine/slab.py) — bit-identical results,
+            # each slab inside the fast chain-count regime.  Resumed runs
+            # carry single-build state and always run unslabbed.
+            sched = self._slab_scheduler()
+            if sched is not None:
+                reduced = sched.run_reduced(on_block=on_block)
+                # host-side accumulator: ensemble_stats folds numpy fine
+                self._last_acc = reduced
+                return reduced
         state = self.init_state() if state is None \
             else self._place_resume(self._check_resume_layout(state))
         self.state = state
@@ -1013,6 +1047,20 @@ class Simulation:
         finally:
             pf.close()
         return {k: self._host_view(v) for k, v in acc.items()}
+
+    def _slab_scheduler(self):
+        """The SlabScheduler this run should delegate to, or None when
+        slabbing does not apply: the plan doesn't slab, the config is
+        itself already an explicit slab, or the caller disabled
+        delegation (``allow_slabs`` — sharded meshes partition chains
+        themselves; checkpointed runs need single-build state)."""
+        cfg = self.config
+        if (not self.allow_slabs or cfg.n_chains_total is not None
+                or not 0 < self.plan.slab_chains < cfg.n_chains):
+            return None
+        from tmhpvsim_tpu.engine.slab import SlabScheduler
+
+        return SlabScheduler(cfg, self.plan)
 
     def _place_resume(self, tree):
         """Loaded checkpoint pytrees (host numpy from ``checkpoint.load``)

@@ -1,0 +1,122 @@
+"""Chain-slab scheduler: execute a big run as sequential slab-sized runs.
+
+Promotes the bench-only slab workaround (benchmarks/PERF_ANALYSIS.md §7c)
+into the engine: chain counts past the single-chip sweet spot (measured
+round 5: ~14x/block cliff at 262144 chains when the scan body's unrolled
+live set spills VMEM) execute as sequential slabs of ``plan.slab_chains``
+chains, each a plain :class:`~tmhpvsim_tpu.engine.simulation.Simulation`
+over chains [off, off+n) of the notional full run
+(``SimConfig.n_chains_total``/``chain_offset``).
+
+Keyed construction makes this EXACT, not approximate: per-chain keys are
+``split(seed-key, n_chains_total)`` sliced at the offset (threefry split
+is counter-based) and every draw is keyed by global value index, so the
+concatenation of the slabs' outputs is BIT-identical to the unslabbed run
+(tests/test_engine.py TestChainSlabs; re-asserted through this scheduler
+in tests/test_autotune.py).  Each slab Simulation is freed before the
+next compiles — equal-shape slabs share one jit executable via the
+persistent compile cache, and no slab's buffers stay HBM-resident to
+degrade the next (PERF_ANALYSIS §7a fact 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from tmhpvsim_tpu.config import Plan, SimConfig, slice_grid
+
+
+class SlabScheduler:
+    """Sequential slab execution of ``config`` under ``plan``.
+
+    Built by ``Simulation`` when ``plan.slab_chains < n_chains`` (and the
+    config is not itself already a slab); drives one slab-sized
+    Simulation at a time through the parent's own run loops.
+    """
+
+    def __init__(self, config: SimConfig, plan: Plan):
+        if config.n_chains_total is not None:
+            raise ValueError(
+                "SlabScheduler cannot re-slab an explicit chain slab "
+                "(n_chains_total is already set)"
+            )
+        if not 0 < plan.slab_chains < config.n_chains:
+            raise ValueError(
+                f"slab_chains={plan.slab_chains} must be in "
+                f"(0, n_chains={config.n_chains}) to slab"
+            )
+        self.config = config
+        self.plan = plan
+        total = config.n_chains
+        slab = plan.slab_chains
+        self.slab_cfgs = []
+        for off in range(0, total, slab):
+            n = min(slab, total - off)
+            self.slab_cfgs.append(dataclasses.replace(
+                config,
+                tune="off",  # the plan is already resolved
+                n_chains=n,
+                n_chains_total=total,
+                chain_offset=off,
+                site_grid=slice_grid(config.site_grid, off, n),
+            ))
+
+    def __len__(self):
+        return len(self.slab_cfgs)
+
+    def _make_sim(self, cfg: SimConfig):
+        from tmhpvsim_tpu.engine.simulation import Simulation
+
+        # per-slab plan: same resolved knobs, slabbing consumed
+        plan = dataclasses.replace(self.plan, slab_chains=cfg.n_chains)
+        return Simulation(cfg, plan=plan)
+
+    def run_reduced(self, on_block=None) -> dict:
+        """Per-chain running statistics of the full run: each slab's
+        ``run_reduced`` concatenated in chain order — bit-identical to the
+        unslabbed result (module docstring).  ``on_block(bi, state, acc)``
+        receives a GLOBAL block counter (slab-major: slab 0's blocks, then
+        slab 1's, ...) so timing hooks see monotonic progress."""
+        outs = []
+        gblock = 0
+        for cfg in self.slab_cfgs:
+            sim = self._make_sim(cfg)
+            cb = None
+            if on_block is not None:
+                def cb(bi, state, acc, _g=gblock):
+                    return on_block(_g + bi, state, acc)
+            outs.append(sim.run_reduced(on_block=cb))
+            gblock += sim.n_blocks
+            del sim  # free the slab's buffers before the next compiles
+        return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+
+    def run_ensemble(self) -> Iterator:
+        """Fleet-level 1 Hz series of the full run: chain-count-weighted
+        combination of the slabs' per-second fleet means.  Slabs run to
+        completion one at a time (the per-block vectors are only
+        O(block_s) on the host), then the combined BlockResults are
+        yielded in time order."""
+        total = self.config.n_chains
+        meta = None       # [(offset, epoch)]
+        m_sums = p_sums = None
+        for cfg in self.slab_cfgs:
+            sim = self._make_sim(cfg)
+            w = cfg.n_chains / total
+            blocks = list(sim.run_ensemble())
+            if meta is None:
+                meta = [(b.offset, b.epoch) for b in blocks]
+                m_sums = [w * b.meter for b in blocks]
+                p_sums = [w * b.pv for b in blocks]
+            else:
+                for i, b in enumerate(blocks):
+                    m_sums[i] = m_sums[i] + w * b.meter
+                    p_sums[i] = p_sums[i] + w * b.pv
+            del sim
+        from tmhpvsim_tpu.engine.simulation import BlockResult
+
+        for (off, epoch), m, p in zip(meta, m_sums, p_sums):
+            yield BlockResult(offset=off, epoch=epoch, meter=m, pv=p,
+                              residual=m - p)

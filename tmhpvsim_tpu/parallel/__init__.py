@@ -1,6 +1,21 @@
 """Multi-chip / multi-host execution layer."""
 
-from tmhpvsim_tpu.parallel.mesh import (  # noqa: F401
+try:
+    # jax >= 0.6 exports shard_map at the top level and spells the
+    # replication-check kwarg ``check_vma``
+    from jax import shard_map  # noqa: F401
+except ImportError:  # jax 0.4.x: experimental home, kwarg is ``check_rep``
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @_functools.wraps(_shard_map)
+    def shard_map(f, **kw):
+        kw.setdefault("check_rep", kw.pop("check_vma", True))
+        return _shard_map(f, **kw)
+
+
+from tmhpvsim_tpu.parallel.mesh import (  # noqa: E402,F401
     ShardedSimulation,
     chain_sharding,
     make_mesh,

@@ -22,6 +22,20 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def _already_initialized() -> bool:
+    """``jax.distributed.is_initialized()``, tolerating jax < 0.5 where the
+    accessor does not exist and the client handle must be read directly."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:  # jax.distributed re-exports from jax._src.distributed
+        from jax._src import distributed as _dist_src
+
+        state = _dist_src.global_state
+    return getattr(state, "client", None) is not None
+
+
 def initialize_from_env() -> bool:
     """Initialise ``jax.distributed`` when running under a multi-host
     launcher; no-op (returns False) in single-process runs.
@@ -33,7 +47,7 @@ def initialize_from_env() -> bool:
     # NB: the env vars must be inspected BEFORE any jax query that can
     # initialise a backend — even jax.process_count() does, after which
     # jax.distributed.initialize() is forbidden.
-    if jax.distributed.is_initialized():
+    if _already_initialized():
         return True  # already initialised by the runtime/launcher
     addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES")

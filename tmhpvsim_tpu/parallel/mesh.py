@@ -30,7 +30,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+# the version-compat shim (check_vma <-> check_rep) lives in the package
+# __init__, which runs before this module on any import path
+from tmhpvsim_tpu.parallel import shard_map
 
 from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.engine.simulation import BlockResult, Simulation
@@ -79,9 +82,21 @@ class ShardedSimulation(Simulation):
     shape; there is no cross-chain reduction in the per-chain outputs.
     """
 
-    def __init__(self, config: SimConfig, mesh: Optional[Mesh] = None):
-        super().__init__(config)
-        self.mesh = mesh if mesh is not None else make_mesh()
+    def __init__(self, config: SimConfig, mesh: Optional[Mesh] = None,
+                 plan=None):
+        mesh = mesh if mesh is not None else make_mesh()
+        if plan is None:
+            # per-mesh tuning (engine/autotune.py): probe at the
+            # per-device chain shape — that is what each chip executes
+            # under shard_map — on process 0 only, broadcast the winner.
+            # tune='off' resolves statically; chain slabbing never
+            # applies here (the mesh partitions the chain axis itself).
+            from tmhpvsim_tpu.engine import autotune
+
+            plan = autotune.resolve_plan_for_mesh(config, mesh.devices.size)
+        super().__init__(config, plan=plan)
+        self.allow_slabs = False
+        self.mesh = mesh
         n_dev = self.mesh.devices.size
         if self.config.n_chains % n_dev != 0:
             raise ValueError(
