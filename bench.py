@@ -165,7 +165,8 @@ def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
     return SimConfig(**base)
 
 
-def _timed_reduce_run(sim, n_blocks: int, n_rounds: int, profile_dir=None):
+def _timed_reduce_run(sim, n_blocks: int, n_rounds: int, profile_dir=None,
+                      expect_platform=None):
     """(compile_s, best_steady_s, rate): one warm-up block, then n_rounds x
     n_blocks timed reduce-mode blocks through the public step_acc path,
     best round kept (the tunnel TPU's throughput varies ~2x between
@@ -173,11 +174,51 @@ def _timed_reduce_run(sim, n_blocks: int, n_rounds: int, profile_dir=None):
 
     The timing loop itself lives in engine/autotune.py — the variant
     sweep and ``tune='auto'`` plan probes share one measurement path,
-    so a bench rate and a probe rate are directly comparable."""
+    so a bench rate and a probe rate are directly comparable.
+    ``expect_platform`` arms the device-trace platform guard when
+    ``profile_dir`` is set (obs/profiler.py)."""
     from tmhpvsim_tpu.engine.autotune import time_reduce_blocks
 
     return time_reduce_blocks(sim, n_blocks, n_rounds=n_rounds,
-                              profile_dir=profile_dir)
+                              profile_dir=profile_dir,
+                              expect_platform=expect_platform)
+
+
+def _bench_timing(compile_s, steady_wall_s, n_timed_blocks, rate) -> dict:
+    """A RunReport timing section from the bench measurement protocol
+    (one compile-inclusive warm-up block, ``n_timed_blocks`` timed
+    steady blocks of total wall ``steady_wall_s``)."""
+    return {
+        "compile_s": compile_s,
+        "first_block_s": compile_s,
+        "steady_block_s": (steady_wall_s / n_timed_blocks
+                           if n_timed_blocks else None),
+        "n_blocks_timed": int(n_timed_blocks) + 1,
+        "site_seconds_per_s": rate,
+        "rate_includes_compile": False,
+    }
+
+
+def _bench_report(app: str, *, config=None, plan=None, timing=None,
+                  headline=None, profile=None, slabs=None,
+                  device=None) -> dict | None:
+    """A validated obs RunReport document, embedded ADDITIVELY in a bench
+    artifact as ``doc["run_report"]`` (the legacy ad-hoc fields stay —
+    battery scripts key richness decisions off them).  Never raises: a
+    report failure must not cost the benchmark number it describes."""
+    from tmhpvsim_tpu.obs.report import RunReport
+
+    try:
+        rep = RunReport(app, config=config, plan=plan)
+        rep.timing = timing
+        rep.headline = headline
+        rep.profile = profile
+        rep.slabs = slabs
+        rep.device = device
+        return rep.doc()
+    except Exception as e:
+        print(f"# run_report build failed ({app}): {e}", file=sys.stderr)
+        return None
 
 
 def _hot_jit_cost(sim) -> dict:
@@ -377,6 +418,22 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
     plan = ok[best_name].get("plan")
     if plan is not None:
         doc["tuned_plan"] = plan
+    # schema-versioned report alongside the ad-hoc fields; device injected
+    # from what the sweep already knows — this also runs on the watchdog
+    # thread, where a fresh jax query against a wedged tunnel could hang
+    # the salvage itself
+    best = ok[best_name]
+    timed_blocks = extra.get("timed_blocks")
+    timing = None
+    if timed_blocks and "best_round_wall_s" in best:
+        timing = _bench_timing(best.get("compile_s"),
+                               best["best_round_wall_s"], timed_blocks, rate)
+    doc["run_report"] = _bench_report(
+        "bench.headline", plan=plan, timing=timing,
+        headline={"site_seconds_per_s": rate, "variant": best_name},
+        device={"platform": platform,
+                "device_kind": extra.get("device_kind")},
+    )
     return doc
 
 
@@ -734,6 +791,11 @@ def _reduce_config_run(label: str, cfg, sharded: bool, note: str,
         "scaled_from": scaled_from,
         "note": note,
     }
+    doc["run_report"] = _bench_report(
+        f"bench.config.{label}", config=cfg, plan=_plan_doc(sim.plan),
+        timing=_bench_timing(compile_s, steady_s, sim.n_blocks - 1, rate),
+        headline={"site_seconds_per_s": doc["value"]},
+    )
     _persist_partial({"phase": "config", **doc})
     print(json.dumps(doc))
 
@@ -771,6 +833,8 @@ def _reduce_config_run_slabs(label: str, cfgs: list, note: str,
     total_site_s = 0.0
     total_steady = 0.0
     total_compile = 0.0
+    n_timed_blocks = 0
+    slab_plan = None
     slab_echo = []
     for cfg in cfgs:
         sim = Simulation(cfg)
@@ -780,6 +844,8 @@ def _reduce_config_run_slabs(label: str, cfgs: list, note: str,
         total_site_s += cfg.n_chains * cfg.block_s * (sim.n_blocks - 1)
         total_steady += steady
         total_compile += c_s
+        n_timed_blocks += sim.n_blocks - 1
+        slab_plan = _plan_doc(sim.plan)  # equal-shape slabs share a plan
         slab_doc = {"chain_offset": cfg.chain_offset,
                     "n_chains": cfg.n_chains,
                     "steady_wall_s": round(steady, 2),
@@ -814,6 +880,13 @@ def _reduce_config_run_slabs(label: str, cfgs: list, note: str,
         "scaled_from": scaled_from,
         "note": note,
     }
+    doc["run_report"] = _bench_report(
+        f"bench.config.{label}", config=c0, plan=slab_plan,
+        timing=_bench_timing(total_compile, total_steady, n_timed_blocks,
+                             rate),
+        headline={"site_seconds_per_s": doc["value"]},
+        slabs={"completed": len(slab_echo), "total": len(cfgs)},
+    )
     _persist_partial({"phase": "config", **doc})
     print(json.dumps(doc))
 
@@ -894,7 +967,7 @@ def config_1() -> None:
         wall = time.perf_counter() - t0
         rows = sum(1 for _ in open(csv_path)) - 1
     rate = duration / wall
-    print(json.dumps({
+    doc = {
         "config": "1: 1 site x 1 day, asyncio/CPU reference path",
         "metric": "simulated seconds/sec (1 site)",
         "value": round(rate, 1),
@@ -907,7 +980,13 @@ def config_1() -> None:
         "note": ("full app pair: metersim producer + pvsim consumer + "
                  "funnel join + CSV sink; the reference's own ceiling on "
                  "this config is ~100 sim-s/s (utils.py:36 10 ms floor)"),
-    }))
+    }
+    doc["run_report"] = _bench_report(
+        "bench.config.1", config=dict(doc["echo"]),
+        headline={"sim_seconds_per_s": doc["value"]},
+        device={"platform": "cpu"},  # asyncio path: no device involved
+    )
+    print(json.dumps(doc))
 
 
 def config_2() -> None:
@@ -1101,7 +1180,7 @@ def scaling() -> None:
     base = results[0]["rate_per_device"]
     for r in results:
         r["efficiency_vs_1dev"] = round(r["rate_per_device"] / base, 3)
-    print(json.dumps({
+    doc = {
         "artifact": "weak-scaling mechanics, virtual CPU mesh",
         "per_device_chains": per_dev,
         "results": results,
@@ -1111,7 +1190,12 @@ def scaling() -> None:
                    "validates sharded-program mechanics at each mesh "
                    "size, NOT hardware scaling efficiency (needs a real "
                    "multi-chip slice)"),
-    }))
+    }
+    doc["run_report"] = _bench_report(
+        "bench.scaling", config={"per_device_chains": per_dev},
+        headline={"results": results},
+    )
+    print(json.dumps(doc))
 
 
 def sweep() -> None:
@@ -1177,6 +1261,12 @@ def sweep() -> None:
                 "n_chains": cfg.n_chains, "block_s": bs, "unroll": unroll,
                 **cost,
             }
+            doc["run_report"] = _bench_report(
+                "bench.sweep", config=cfg, plan=_plan_doc(sim.plan),
+                timing=_bench_timing(c_s, dt, n_blocks, rate),
+                headline={"site_seconds_per_s": doc["rate"],
+                          "variant": label},
+            )
             _persist_partial({"phase": "sweep", **doc})
             print(json.dumps(doc), flush=True)
             # free device state/executable before the next variant
@@ -1190,18 +1280,45 @@ def sweep() -> None:
 
 
 def profile(out_dir: str) -> None:
-    """Capture a jax.profiler trace of steady headline blocks."""
+    """Capture a jax.profiler trace of steady headline blocks.
+
+    The trace is only device evidence if it actually ran on the device
+    it claims (round 5's profile_r05 "TPU" traces were silently
+    CPU-fallback): the platform guard records the traced backend in
+    ``trace_manifest.json`` and this mode exits rc=4 on a mismatch with
+    the expected platform (env TMHPVSIM_PROFILE_EXPECT, default tpu) so
+    battery scripts cannot archive a CPU trace as a TPU artifact."""
     platform, fallback = _probe_or_fallback()
+    expect = os.environ.get("TMHPVSIM_PROFILE_EXPECT", "tpu")
     n_chains = N_CHAINS if platform == "tpu" else CPU_N_CHAINS
     from tmhpvsim_tpu.engine import Simulation
+    from tmhpvsim_tpu.obs.profiler import read_manifest
 
     sim = Simulation(_make_cfg(n_chains, 4))
-    c_s, dt, rate = _timed_reduce_run(sim, 3, 1, profile_dir=out_dir)
-    print(json.dumps({
+    c_s, dt, rate = _timed_reduce_run(sim, 3, 1, profile_dir=out_dir,
+                                      expect_platform=expect)
+    manifest = read_manifest(out_dir)
+    mismatch = bool(manifest and manifest.get("platform_mismatch"))
+    doc = {
         "artifact": "profiler trace", "dir": out_dir,
         "platform": platform, "rate": round(rate, 1),
         "compile_s": round(c_s, 1),
-    }))
+        "expected_platform": expect,
+        "traced_platform": (manifest or {}).get("traced_platform"),
+        "platform_mismatch": mismatch,
+    }
+    doc["run_report"] = _bench_report(
+        "bench.profile", config=sim.config, plan=_plan_doc(sim.plan),
+        timing=_bench_timing(c_s, dt, 3, rate), profile=manifest,
+        headline={"site_seconds_per_s": doc["rate"]},
+    )
+    print(json.dumps(doc), flush=True)
+    if mismatch:
+        print(f"# platform_mismatch: trace in {out_dir} captured "
+              f"{(manifest or {}).get('traced_platform')!r}, expected "
+              f"{expect!r} — not device evidence (set "
+              "TMHPVSIM_PROFILE_EXPECT to override)", file=sys.stderr)
+        sys.exit(4)
 
 
 def repro(k: int) -> None:
@@ -1267,12 +1384,19 @@ def repro(k: int) -> None:
             break
     ok = sorted(r for r in rates if r)
     if ok:
-        print(json.dumps({
+        summary = {
             "phase": "repro-summary", "platform": "tpu",
             "trials": ran, "requested": k,
             "landed": len(ok),
             "min": ok[0], "median": ok[len(ok) // 2], "max": ok[-1],
-        }), flush=True)
+        }
+        summary["run_report"] = _bench_report(
+            "bench.repro",
+            headline={"site_seconds_per_s": summary["median"],
+                      "min": ok[0], "max": ok[-1], "landed": len(ok)},
+            device={"platform": "tpu"},  # summary of TPU-only trials
+        )
+        print(json.dumps(summary), flush=True)
 
 
 def one_variant() -> None:
@@ -1287,12 +1411,18 @@ def one_variant() -> None:
     kw = {k: v for k, v in VARIANT_CFGS[name].items() if k != "_probe"}
     sim = Simulation(_make_cfg(n, nb * nr + 1, **kw))
     c_s, dt, rate = _timed_reduce_run(sim, nb, nr)
-    print(json.dumps({
+    doc = {
         "variant": name, "platform": platform, "rate": round(rate, 1),
         "compile_s": round(c_s, 1), "best_round_wall_s": round(dt, 3),
         "block_ms": round(dt / nb * 1e3, 2), "n_chains": n,
         "impl": _impl_label(sim),
-    }), flush=True)
+    }
+    doc["run_report"] = _bench_report(
+        "bench.one_variant", config=sim.config, plan=_plan_doc(sim.plan),
+        timing=_bench_timing(c_s, dt, nb, rate),
+        headline={"site_seconds_per_s": doc["rate"], "variant": name},
+    )
+    print(json.dumps(doc), flush=True)
 
 
 def main() -> None:

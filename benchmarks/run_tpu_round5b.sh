@@ -140,7 +140,18 @@ if python bench.py --scaling > benchmarks/SCALING.json.tmp 2>> "$LOG"; then
   mv benchmarks/SCALING.json.tmp benchmarks/SCALING.json
 fi
 echo "--- profile start $(date -u +%FT%TZ)" >> "$LOG"
+# rc=4 is the platform guard (obs/profiler.py): the trace captured a
+# different backend than expected (round 5's "TPU" traces were silently
+# CPU-fallback) — quarantine the capture so it cannot be archived as
+# device evidence; trace_manifest.json inside records what actually ran
 python bench.py --profile benchmarks/profile_r05 >> "$LOG" 2>&1
+prof_rc=$?
+if [ "$prof_rc" -eq 4 ]; then
+  mv benchmarks/profile_r05 benchmarks/profile_r05.mismatch 2>/dev/null
+  echo "--- profile: PLATFORM MISMATCH (rc=4); trace quarantined as benchmarks/profile_r05.mismatch" >> "$LOG"
+elif [ "$prof_rc" -ne 0 ]; then
+  echo "--- profile: failed rc=$prof_rc" >> "$LOG"
+fi
 # sweep late: the tuning matrix is the committed evidence for the
 # fast-regime point (take 1's 13 TPU entries lived only in the
 # gitignored journal and died with the checkout) and now includes the

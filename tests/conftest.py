@@ -132,6 +132,9 @@ _SLOW_LANE = {
     "test_sites_actually_differ",
     "test_rbg_keys_survive_configless_save",
     "test_cli_pvsim_site_grid",
+    # obs acceptance: two full-size timed arms (enabled vs disabled
+    # registry) at 65536 chains on CPU
+    "test_metrics_overhead_65536_chains",
 }
 
 

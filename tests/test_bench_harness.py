@@ -25,6 +25,47 @@ def test_headline_doc_picks_best_rate():
     assert doc["north_star_frac"] == round(250.0 / bench.NORTH_STAR, 3)
 
 
+def test_headline_doc_embeds_valid_run_report():
+    """Every headline doc — including the watchdog's partial salvage,
+    which runs on a monitor thread against a possibly-wedged backend —
+    carries a schema-valid RunReport with device injected from what the
+    sweep already measured (no fresh jax queries)."""
+    from tmhpvsim_tpu.obs.report import validate_report
+
+    variants = {
+        "scan-threefry": {"rate": 500.0, "compile_s": 2.0,
+                          "best_round_wall_s": 1.2,
+                          "plan": {"block_impl": "scan", "scan_unroll": 8,
+                                   "stats_fusion": "fused",
+                                   "slab_chains": 64, "source": "static"}},
+    }
+    doc = bench._headline_doc(variants, "tpu", partial=True, n_chains=64,
+                              device_kind="TPU v5e", timed_blocks=4)
+    rep = validate_report(doc["run_report"])
+    assert rep["app"] == "bench.headline"
+    assert rep["device"] == {"platform": "tpu", "device_kind": "TPU v5e"}
+    assert rep["headline"]["variant"] == "scan-threefry"
+    assert rep["headline"]["site_seconds_per_s"] == 500.0
+    assert rep["timing"]["compile_s"] == 2.0
+    assert rep["timing"]["steady_block_s"] == 1.2 / 4
+    assert rep["timing"]["rate_includes_compile"] is False
+    assert rep["plan"]["block_impl"] == "scan"
+    # the whole doc (legacy fields + report) must stay one JSON line
+    json.dumps(doc)
+
+
+def test_headline_doc_run_report_survives_sparse_variants():
+    """Old journalled partials have no plan/best_round_wall_s; the
+    report must degrade (timing None) rather than fail the salvage."""
+    from tmhpvsim_tpu.obs.report import validate_report
+
+    doc = bench._headline_doc({"scan-rbg": {"rate": 9.0}}, "cpu-fallback")
+    rep = validate_report(doc["run_report"])
+    assert rep["timing"] is None
+    assert rep["device"]["platform"] == "cpu-fallback"
+    assert rep["device"]["device_kind"] is None
+
+
 def test_persist_partial_appends_json_lines(tmp_path, monkeypatch):
     p = tmp_path / "journal.jsonl"
     monkeypatch.setattr(bench, "PARTIAL_PATH", str(p))

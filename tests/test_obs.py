@@ -1,0 +1,501 @@
+"""Observability subsystem tests: metrics registry semantics, sinks,
+BlockTimer compile/steady split, the device-trace platform guard,
+RunReport schema validation, pacing monitor, and the end-to-end
+``pvsim --metrics/--run-report`` smoke (tests/test_obs.py is named by
+obs/metrics.py as the home of the 65536-chain overhead assertion)."""
+
+import json
+import logging
+import os
+
+import pytest
+
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs.metrics import (
+    JsonlSink,
+    MetricsRegistry,
+    PrometheusSink,
+    make_sink,
+    use_registry,
+)
+from tmhpvsim_tpu.obs.profiler import (
+    MANIFEST_NAME,
+    BlockTimer,
+    PlatformMismatchError,
+    device_trace,
+    read_manifest,
+)
+from tmhpvsim_tpu.obs.report import (
+    REPORT_KIND,
+    REPORT_SCHEMA_VERSION,
+    RunReport,
+    validate_report,
+)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_accumulates(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+
+    def test_counter_rejects_decrease(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("x").inc(-1)
+
+    def test_gauge_set_and_add(self):
+        g = MetricsRegistry().gauge("g")
+        g.set(4.0)
+        g.add(-1.5)
+        assert g.value == 2.5
+
+    def test_histogram_stats_and_buckets(self):
+        h = MetricsRegistry().histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(55.55)
+        assert snap["min"] == 0.05
+        assert snap["max"] == 50.0
+        assert snap["mean"] == pytest.approx(55.55 / 4)
+        # cumulative per Prometheus semantics; the 50.0 obs only lands
+        # in the implicit +Inf bucket (count)
+        assert snap["buckets"] == [[0.1, 1], [1.0, 2], [10.0, 3]]
+
+    def test_same_name_same_object(self):
+        reg = MetricsRegistry()
+        assert reg.counter("n") is reg.counter("n")
+
+    def test_type_collision_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("n")
+        with pytest.raises(TypeError):
+            reg.gauge("n")
+
+    def test_disabled_registry_is_noop(self):
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("x")
+        c.inc(5)
+        reg.gauge("g").set(1.0)
+        reg.histogram("h").observe(2.0)
+        assert c.value == 0.0
+        assert reg.snapshot() == {"counters": {}, "gauges": {},
+                                  "histograms": {}}
+
+    def test_timed_nests(self):
+        reg = MetricsRegistry()
+        with reg.timed("outer"):
+            with reg.timed("inner"):
+                pass
+        snap = reg.snapshot()["histograms"]
+        assert snap["outer"]["count"] == 1
+        assert snap["inner"]["count"] == 1
+        assert snap["outer"]["sum"] >= snap["inner"]["sum"]
+
+    def test_use_registry_swaps_default(self):
+        fresh = MetricsRegistry()
+        prev = obs_metrics.get_registry()
+        with use_registry(fresh):
+            assert obs_metrics.get_registry() is fresh
+        assert obs_metrics.get_registry() is prev
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+class TestSinks:
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        reg = MetricsRegistry()
+        reg.add_sink(JsonlSink(path))
+        reg.counter("blocks").inc()
+        reg.flush(event="block")
+        reg.counter("blocks").inc()
+        reg.flush(event="end")
+        reg.close()
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ln["event"] for ln in lines] == ["block", "end"]
+        assert lines[0]["metrics"]["counters"]["blocks"] == 1
+        assert lines[1]["metrics"]["counters"]["blocks"] == 2
+
+    def test_prometheus_round_trip(self, tmp_path):
+        path = str(tmp_path / "m.prom")
+        reg = MetricsRegistry()
+        reg.counter("engine.blocks_total").inc(3)
+        reg.gauge("engine.compile_s").set(1.5)
+        reg.histogram("engine.block_wall_s").observe(0.7)
+        reg.add_sink(PrometheusSink(path))
+        reg.flush()
+        reg.close()
+        text = open(path).read()
+        assert "# TYPE tmhpvsim_engine_blocks_total counter" in text
+        assert "tmhpvsim_engine_blocks_total 3" in text
+        assert "tmhpvsim_engine_compile_s 1.5" in text
+        assert 'tmhpvsim_engine_block_wall_s_bucket{le="+Inf"} 1' in text
+        assert "tmhpvsim_engine_block_wall_s_count 1" in text
+
+    def test_make_sink_dispatch(self, tmp_path):
+        assert isinstance(make_sink(str(tmp_path / "a.prom")),
+                          PrometheusSink)
+        assert isinstance(make_sink(str(tmp_path / "a.jsonl")), JsonlSink)
+
+    def test_flush_survives_sink_oserror(self, tmp_path):
+        reg = MetricsRegistry()
+        sink = JsonlSink(str(tmp_path / "m.jsonl"))
+        sink._f.close()  # provoke "write to closed file"
+        reg.add_sink(sink)
+        reg.counter("x").inc()
+        reg.flush()  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# BlockTimer: compile vs steady split (satellite 1 regression)
+# ---------------------------------------------------------------------------
+
+class TestBlockTimer:
+    def test_single_block_has_no_steady(self):
+        t = BlockTimer(n_chains=4, block_s=60, log=False)
+        t.tick()
+        s = t.summary()
+        assert s["n_blocks_timed"] == 1
+        assert s["compile_s"] is not None
+        assert s["first_block_s"] == s["compile_s"]
+        # the old summary() passed the compile-inclusive block off as
+        # steady_block_s; it must be None when no steady block exists
+        assert s["steady_block_s"] is None
+        assert s["rate_includes_compile"] is True
+        assert s["site_seconds_per_s"] > 0
+
+    def test_zero_blocks(self):
+        s = BlockTimer(4, 60, log=False).summary()
+        assert s["n_blocks_timed"] == 0
+        assert s["compile_s"] is None
+        assert s["steady_block_s"] is None
+        assert s["site_seconds_per_s"] == 0.0
+
+    def test_multi_block_splits_and_feeds_registry(self):
+        reg = MetricsRegistry()
+        t = BlockTimer(4, 60, log=False, registry=reg, prefix="engine")
+        for _ in range(3):
+            t.tick()
+        s = t.summary()
+        assert s["n_blocks_timed"] == 3
+        assert s["steady_block_s"] is not None
+        assert s["rate_includes_compile"] is False
+        snap = reg.snapshot()
+        assert "engine.compile_s" in snap["gauges"]
+        assert snap["histograms"]["engine.block_wall_s"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# device trace platform guard (satellite 2 + acceptance regression)
+# ---------------------------------------------------------------------------
+
+class TestPlatformGuard:
+    def test_manifest_records_traced_platform(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "trace")
+        with device_trace(d):
+            jnp.zeros(8).block_until_ready()
+        m = read_manifest(d)
+        assert m is not None
+        assert m["traced_platform"] == jax.default_backend() == "cpu"
+        assert m["expected_platform"] is None
+        assert m["platform_mismatch"] is False
+
+    def test_mismatch_warns_and_tags(self, tmp_path, caplog):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "trace")
+        with caplog.at_level(logging.WARNING,
+                             logger="tmhpvsim_tpu.obs.profiler"):
+            with device_trace(d, expect_platform="tpu"):
+                jnp.zeros(8).block_until_ready()
+        m = read_manifest(d)
+        assert m["platform_mismatch"] is True
+        assert m["expected_platform"] == "tpu"
+        assert any("platform_mismatch" in r.message for r in caplog.records)
+
+    def test_strict_raises_but_still_writes_manifest(self, tmp_path):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "trace")
+        with pytest.raises(PlatformMismatchError):
+            with device_trace(d, expect_platform="tpu", strict=True):
+                jnp.zeros(8).block_until_ready()
+        assert read_manifest(d)["platform_mismatch"] is True
+
+    def test_expect_env_default(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("TMHPVSIM_EXPECT_PLATFORM", "tpu")
+        d = str(tmp_path / "trace")
+        with device_trace(d):
+            jnp.zeros(8).block_until_ready()
+        assert read_manifest(d)["platform_mismatch"] is True
+
+    def test_missing_manifest_reads_none(self, tmp_path):
+        assert read_manifest(str(tmp_path)) is None
+
+    def test_engine_profiling_shim_reexports(self):
+        # tests/test_distributed.py monkeypatches this path; it must
+        # keep resolving to the same objects as the obs package
+        from tmhpvsim_tpu.engine import profiling as shim
+
+        assert shim.BlockTimer is BlockTimer
+        assert shim.device_trace is device_trace
+
+
+# ---------------------------------------------------------------------------
+# RunReport schema
+# ---------------------------------------------------------------------------
+
+class TestRunReport:
+    def test_minimal_report_validates(self):
+        doc = RunReport("test").doc()
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION
+        assert doc["kind"] == REPORT_KIND
+        assert doc["device"]["platform"] == "cpu"
+        validate_report(doc)
+
+    def test_write_is_valid_json(self, tmp_path):
+        path = str(tmp_path / "sub" / "r.json")
+        RunReport("test").write(path)
+        validate_report(json.load(open(path)))
+
+    def test_rejects_missing_required(self):
+        doc = RunReport("test").doc()
+        del doc["app"]
+        with pytest.raises(ValueError, match="app"):
+            validate_report(doc)
+
+    def test_rejects_wrong_schema_version(self):
+        doc = RunReport("test").doc()
+        doc["schema_version"] = 999
+        with pytest.raises(ValueError, match="schema_version"):
+            validate_report(doc)
+
+    def test_rejects_unknown_top_level_key(self):
+        doc = RunReport("test").doc()
+        doc["surprise"] = 1
+        with pytest.raises(ValueError, match="unknown keys"):
+            validate_report(doc)
+
+    def test_rejects_mistyped_section(self):
+        doc = RunReport("test").doc()
+        doc["timing"] = "fast"
+        with pytest.raises(ValueError, match="timing"):
+            validate_report(doc)
+
+    def test_rejects_unserialisable(self):
+        doc = RunReport("test").doc()
+        doc["headline"] = {"x": object()}
+        with pytest.raises(ValueError, match="serialisable"):
+            validate_report(doc)
+
+    def test_attach_metrics_derives_sections(self):
+        reg = MetricsRegistry()
+        reg.histogram("checkpoint.save_s").observe(0.2)
+        reg.gauge("slab.total").set(3)
+        reg.gauge("slab.completed").set(2)
+        reg.gauge("clock.pacing_lag_s").set(1.0)
+        reg.gauge("clock.pacing_slip_total_s").set(4.5)
+        rep = RunReport("test")
+        rep.attach_metrics(reg)
+        doc = rep.doc()
+        assert doc["checkpoint"]["saves"] == 1
+        assert doc["checkpoint"]["save_total_s"] == pytest.approx(0.2)
+        assert doc["slabs"] == {"completed": 2, "total": 3}
+        assert doc["realtime"]["pacing_slip_total_s"] == 4.5
+
+    def test_config_echo_compacts_site_grid(self):
+        from tmhpvsim_tpu.config import SiteGrid, SimConfig
+
+        grid = SiteGrid.regular((45.0, 46.0), (5.0, 6.0), 3, 4)
+        cfg = SimConfig(start="2019-09-05 10:00:00", duration_s=120,
+                        n_chains=12, seed=1, block_s=60, site_grid=grid)
+        doc = RunReport("test", config=cfg).doc()
+        assert doc["config"]["site_grid"] == {"n_sites": 12}
+
+
+# ---------------------------------------------------------------------------
+# pacing monitor (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestPacingMonitor:
+    def test_slip_accumulates_only_new_lag(self):
+        from tmhpvsim_tpu.runtime.clock import PacingMonitor
+
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            mon = PacingMonitor(period=1.0, warn_every_s=10.0)
+            mon.observe(0.5, now=0.0)   # under 2 periods: no warn
+            mon.observe(3.0, now=1.0)
+            mon.observe(2.0, now=2.0)   # recovering: no new slip
+            mon.observe(4.0, now=3.0)
+        g = reg.snapshot()["gauges"]
+        assert g["clock.pacing_lag_s"] == 4.0
+        # 0 -> 0.5 -> 3.0 -> (recover) -> 2.0 -> 4.0: new slip only
+        assert g["clock.pacing_slip_total_s"] == pytest.approx(5.0)
+
+    def test_warn_rate_limited(self, caplog):
+        from tmhpvsim_tpu.runtime.clock import PacingMonitor
+
+        with use_registry(MetricsRegistry()):
+            mon = PacingMonitor(period=1.0, warn_every_s=10.0)
+            with caplog.at_level(logging.WARNING,
+                                 logger="tmhpvsim_tpu.runtime.clock"):
+                assert mon.observe(3.0, now=0.0) is True
+                assert mon.observe(4.0, now=5.0) is False   # rate-limited
+                assert mon.observe(5.0, now=11.0) is True   # window over
+                assert mon.observe(0.1, now=22.0) is False  # caught up
+        warns = [r for r in caplog.records if "behind realtime" in r.message]
+        assert len(warns) == 2
+        assert "cumulative slip" in warns[0].message
+
+
+# ---------------------------------------------------------------------------
+# engine + app integration
+# ---------------------------------------------------------------------------
+
+def _small_cfg(**kw):
+    from tmhpvsim_tpu.config import SimConfig
+
+    base = dict(start="2019-09-05 10:00:00", duration_s=7200, n_chains=3,
+                seed=7, block_s=3600, dtype="float32")
+    base.update(kw)
+    return SimConfig(**base)
+
+
+class TestEngineIntegration:
+    def test_run_reduced_report(self, tmp_path):
+        from tmhpvsim_tpu.engine import Simulation
+
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            sim = Simulation(_small_cfg(output="reduce"))
+            sim.run_reduced()
+            path = str(tmp_path / "r.json")
+            doc = sim.run_report(path=path)
+        validate_report(doc)
+        assert doc["app"] == "engine"
+        assert doc["timing"]["n_blocks_timed"] == 2
+        assert doc["timing"]["compile_s"] is not None
+        assert doc["timing"]["steady_block_s"] is not None
+        assert doc["plan"]["block_impl"] in ("wide", "scan", "scan2")
+        assert doc["headline"]["site_seconds_per_s"] > 0
+        assert doc["metrics"]["counters"]["engine.blocks_total"] == 2
+        validate_report(json.load(open(path)))
+
+    def test_run_ensemble_report(self):
+        from tmhpvsim_tpu.engine import Simulation
+
+        with use_registry(MetricsRegistry()):
+            sim = Simulation(_small_cfg(output="ensemble"))
+            for _ in sim.run_ensemble():
+                pass
+            doc = sim.run_report(app="engine.ensemble")
+        validate_report(doc)
+        assert doc["timing"]["n_blocks_timed"] == 2
+
+    def test_gather_metrics_single_process(self):
+        from tmhpvsim_tpu.parallel.distributed import gather_metrics
+
+        snap = MetricsRegistry().snapshot()
+        assert gather_metrics(snap) == [snap]
+
+
+class TestCliSmoke:
+    def test_cli_pvsim_metrics_run_report(self, tmp_path):
+        """Acceptance smoke: pvsim --backend=jax emits both artifacts
+        with valid schema."""
+        from click.testing import CliRunner
+
+        from tmhpvsim_tpu.cli import pvsim
+
+        out = str(tmp_path / "out.csv")
+        m_path = str(tmp_path / "m.jsonl")
+        r_path = str(tmp_path / "r.json")
+        r = CliRunner().invoke(pvsim, [
+            out, "--backend", "jax", "--no-realtime",
+            "--duration", "180", "--chains", "2", "--seed", "1",
+            "--start", "2019-09-05 10:00:00",
+            "--metrics", m_path, "--run-report", r_path,
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        doc = validate_report(json.load(open(r_path)))
+        assert doc["app"] == "pvsim"
+        assert doc["config"]["n_chains"] == 2
+        assert doc["device"]["platform"] == "cpu"
+        lines = [json.loads(ln) for ln in open(m_path)]
+        assert lines, "no metric snapshots flushed"
+        assert lines[-1]["event"] == "end"
+        assert lines[-1]["metrics"]["counters"]["engine.blocks_total"] >= 1
+        assert sum(1 for _ in open(out)) == 181  # header + 180 rows
+
+    def test_cli_rejects_metrics_on_asyncio_backend(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tmhpvsim_tpu.cli import pvsim
+
+        r = CliRunner().invoke(pvsim, [
+            str(tmp_path / "o.csv"), "--metrics",
+            str(tmp_path / "m.jsonl"),
+        ])
+        assert r.exit_code != 0
+        assert "--backend=jax" in r.output
+
+
+# ---------------------------------------------------------------------------
+# overhead acceptance: metrics enabled within 1% of disabled (slow lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_metrics_overhead_65536_chains():
+    """Steady-block wall with the metrics registry enabled (and a sink
+    attached) must be within 1% of a disabled registry at the 65536-chain
+    CPU config — the per-block hook cost is a handful of dict/float ops
+    against an O(seconds) block wall.  min-of-steady-blocks on each arm
+    filters scheduler noise on this 1-core host."""
+    import tempfile
+
+    from tmhpvsim_tpu.engine import Simulation
+
+    def steady_min(enabled: bool) -> float:
+        reg = MetricsRegistry(enabled=enabled)
+        if enabled:
+            with tempfile.TemporaryDirectory() as d:
+                reg.add_sink(make_sink(os.path.join(d, "m.jsonl")))
+                with use_registry(reg):
+                    sim = Simulation(_small_cfg(
+                        n_chains=65536, duration_s=4 * 60, block_s=60,
+                        block_impl="wide", output="reduce"))
+                    sim.run_reduced()
+                    reg.flush(event="end")
+                reg.close()
+                return min(sim.timer.block_times)
+        with use_registry(reg):
+            sim = Simulation(_small_cfg(
+                n_chains=65536, duration_s=4 * 60, block_s=60,
+                block_impl="wide", output="reduce"))
+            sim.run_reduced()
+        return min(sim.timer.block_times)
+
+    steady_min(True)  # warm the jit + persistent cache for both arms
+    disabled = steady_min(False)
+    enabled = steady_min(True)
+    assert enabled <= disabled * 1.01, (
+        f"metrics overhead {enabled / disabled - 1:.2%} exceeds 1% "
+        f"(enabled {enabled:.4f} s vs disabled {disabled:.4f} s)"
+    )

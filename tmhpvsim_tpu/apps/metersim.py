@@ -17,6 +17,7 @@ from typing import Optional
 
 import numpy as np
 
+from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.runtime import asyncretry, fixedclock, forever
 from tmhpvsim_tpu.runtime.broker import make_transport
 
@@ -82,6 +83,7 @@ async def read_meter_values_jax(queue: asyncio.Queue, realtime: bool,
         t = sec0 + jnp.arange(block_s)
         return ci.meter_block(root, t, METER_MAX_W)
 
+    m_blocks = obs_metrics.get_registry().counter("metersim.blocks_total")
     vals, i, sec = None, 0, 0
     async for time in fixedclock(rate=1, realtime=realtime, start=start,
                                  duration_s=duration_s):
@@ -89,6 +91,7 @@ async def read_meter_values_jax(queue: asyncio.Queue, realtime: bool,
             vals = await asyncio.to_thread(
                 lambda s: np.asarray(block_vals(s)), sec
             )
+            m_blocks.inc()
             i = 0
         await queue.put((time, float(vals[i])))
         i += 1
@@ -105,6 +108,9 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
     a failed publish.
     """
     pending = None
+    m_pub = obs_metrics.get_registry().counter(
+        "metersim.values_published_total"
+    )
 
     @asyncretry(delay=5, attempts=forever)
     async def run():
@@ -115,6 +121,7 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
                     pending = await queue.get()
                 time, value = pending
                 await transport.publish(value, time)
+                m_pub.inc()
                 pending = None
                 queue.task_done()
 

@@ -143,7 +143,9 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               output: str = "trace",
               prng_impl: str = "threefry2x32",
               block_impl: str = "auto",
-              tune: str = "off") -> None:
+              tune: str = "off",
+              metrics_path: Optional[str] = None,
+              run_report_path: Optional[str] = None) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -164,16 +166,82 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     operator" stream): only (block_s,) vectors reach the host, so this
     also scales to 100k+ chains — one psum per block on a sharded mesh.
     Checkpoint/resume and --realtime pacing work exactly as in trace mode.
+
+    Observability (obs/): ``metrics_path`` streams per-block metric
+    snapshots to a JSONL (or ``.prom``) sink; ``run_report_path`` writes
+    the schema-versioned RunReport after the run.  Both ride a fresh
+    per-run registry so the artifacts never mix runs.  On a pod slice
+    every process gathers its metrics (a collective) and process 0
+    embeds them in its report.
     """
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs.profiler import read_manifest
+    from tmhpvsim_tpu.obs.report import RunReport
+
+    registry = obs_metrics.MetricsRegistry()
+    if metrics_path:
+        registry.add_sink(obs_metrics.make_sink(metrics_path))
+    # the Simulation binds the process-default registry at construction,
+    # so the per-run registry must be installed around the whole run
+    with obs_metrics.use_registry(registry):
+        try:
+            sim = _pvsim_jax_run(
+                file, duration_s, n_chains, seed, start=start,
+                chain=chain, sharded=sharded, checkpoint=checkpoint,
+                block_s=block_s, realtime=realtime, site_grid=site_grid,
+                profile_dir=profile_dir, output=output,
+                prng_impl=prng_impl, block_impl=block_impl, tune=tune,
+            )
+        finally:
+            registry.flush(event="end")
+            registry.close()
+    if not run_report_path:
+        return
+    import jax
+
+    summary = sim.timer.summary()
+    rep = RunReport("pvsim", config=sim.config, plan=sim.plan)
+    rep.set_timing(summary)
+    rep.attach_metrics(registry)
+    rep.headline = {"site_seconds_per_s": summary["site_seconds_per_s"]}
+    if profile_dir:
+        rep.profile = read_manifest(profile_dir)
+    if jax.process_count() > 1:
+        from tmhpvsim_tpu.parallel.distributed import gather_metrics
+
+        procs = gather_metrics(registry.snapshot())  # collective
+        if jax.process_index() != 0:
+            return  # process 0 writes the (combined) report
+        rep.processes = procs
+    rep.write(run_report_path)
+
+
+def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
+                   start: Optional[str] = None, chain: int = 0,
+                   sharded: bool = False,
+                   checkpoint: Optional[str] = None,
+                   block_s: Optional[int] = None,
+                   realtime: bool = False,
+                   site_grid=None,
+                   profile_dir: Optional[str] = None,
+                   output: str = "trace",
+                   prng_impl: str = "threefry2x32",
+                   block_impl: str = "auto",
+                   tune: str = "off"):
+    """The run body behind :func:`pvsim_jax`; returns the Simulation so
+    the wrapper can assemble the run report from its config/plan/timer."""
     import contextlib
     import os
     from zoneinfo import ZoneInfo
 
     from tmhpvsim_tpu.config import SimConfig
     from tmhpvsim_tpu.engine import Simulation, checkpoint as ckpt
-    from tmhpvsim_tpu.engine.profiling import BlockTimer, device_trace
     from tmhpvsim_tpu.engine.simulation import write_csv
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs.profiler import BlockTimer, device_trace
     from tmhpvsim_tpu.parallel.distributed import initialize_from_env
+
+    reg = obs_metrics.get_registry()
 
     # Join a pod slice when launched under a multi-host runtime; no-op
     # single-process.  Must run before any jax.devices() query.  Guarded:
@@ -261,6 +329,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
 
         def on_block(bi, state, acc):
             timer.tick()
+            reg.flush(event="block")
             if checkpoint:
                 # host_local_tree: on a pod slice each host saves only its
                 # chain slice (the per-host file this process owns)
@@ -282,7 +351,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             f"{ensemble['pv_max']:.1f} W"
             + (f"; profile in {profile_dir}" if profile_dir else "")
         )
-        return
+        return sim
 
     if output == "ensemble" and chain != 0:
         raise ValueError("ensemble mode writes the fleet mean; --chain "
@@ -344,6 +413,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             start=start_block,
         ):
             timer.tick()
+            reg.flush(event="block")
             if realtime:
                 yield from _paced(blk)
             else:
@@ -367,12 +437,21 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             for _ in blocks():
                 pass
     stats = timer.summary()
+    # steady_block_s is None when only the compile-inclusive first block
+    # was timed (single-block runs) — say so rather than fake a steady rate
+    if stats["steady_block_s"] is not None:
+        block_txt = f"steady block {stats['steady_block_s']:.3f} s"
+    elif stats["compile_s"] is not None:
+        block_txt = f"single block {stats['compile_s']:.3f} s incl. compile"
+    else:
+        block_txt = "no blocks timed"  # fully-resumed run: 0 blocks left
     print(
         f"pvsim: {cfg.n_chains} chains x {cfg.duration_s} s simulated at "
         f"{stats['site_seconds_per_s']:.3g} site-s/s "
-        f"(steady block {stats['steady_block_s']:.3f} s"
+        f"({block_txt}"
         + (f"; profile in {profile_dir}" if profile_dir else "") + ")"
     )
+    return sim
 
 
 def _write_reduced_csv(path: str, reduced: dict, ensemble: dict,

@@ -186,12 +186,23 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "miss); force = re-probe even on a hit; the resolved "
                    "plan is echoed in the logs (jax backend, see "
                    "config.SimConfig.tune)")
+@click.option("--metrics", "metrics_path", default=None,
+              help="Stream per-block metric snapshots to this file: .prom "
+                   "= Prometheus text exposition (atomic rewrite), "
+                   "anything else = JSONL append (jax backend; obs/)")
+@click.option("--run-report", "run_report_path", default=None,
+              help="Write the schema-versioned RunReport JSON (config, "
+                   "resolved plan, device, compile/steady timing, "
+                   "headline rate) here after the run (jax backend)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, backend, n_chains, chain, sharded, checkpoint, block_s,
           site_grid_spec, sites_csv, profile_dir, output, prng_impl,
-          block_impl, tune):
+          block_impl, tune, metrics_path, run_report_path):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
+    if (metrics_path or run_report_path) and backend != "jax":
+        raise click.UsageError("--metrics/--run-report require "
+                               "--backend=jax")
     if (site_grid_spec or sites_csv) and backend != "jax":
         raise click.UsageError("--site-grid/--sites-csv require "
                                "--backend=jax")
@@ -242,7 +253,9 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   sharded, checkpoint, block_s, realtime=realtime,
                   site_grid=site_grid, profile_dir=profile_dir,
                   output=output, prng_impl=prng_impl,
-                  block_impl=block_impl, tune=tune)
+                  block_impl=block_impl, tune=tune,
+                  metrics_path=metrics_path,
+                  run_report_path=run_report_path)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

@@ -112,13 +112,14 @@ def static_plan(config: SimConfig) -> Plan:
 
 
 def time_reduce_blocks(sim, n_blocks: int, n_rounds: int = 1,
-                       profile_dir=None):
+                       profile_dir=None, expect_platform=None):
     """(compile_s, best_steady_s, rate): one warm-up block, then n_rounds x
     n_blocks timed reduce-mode blocks through the public step_acc path,
     best round kept (the tunnel TPU's throughput varies ~2x between
     otherwise identical runs).  ``sim.n_blocks`` must cover
     1 + n_blocks*n_rounds blocks; rate is simulated site-seconds per wall
-    second."""
+    second.  ``expect_platform`` arms the device-trace platform guard
+    when ``profile_dir`` is set (obs/profiler.py)."""
     import contextlib
 
     import jax
@@ -136,9 +137,9 @@ def time_reduce_blocks(sim, n_blocks: int, n_rounds: int = 1,
 
     trace = contextlib.nullcontext()
     if profile_dir:
-        from tmhpvsim_tpu.engine.profiling import device_trace
+        from tmhpvsim_tpu.obs.profiler import device_trace
 
-        trace = device_trace(profile_dir)
+        trace = device_trace(profile_dir, expect_platform=expect_platform)
 
     best = float("inf")
     bi = 1
@@ -183,8 +184,13 @@ def probe_plan(config: SimConfig, plan: Plan,
         duration_s=config.block_s * (n_timed + 1),
         output="reduce",
     )
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs.profiler import annotate
+
+    obs_metrics.get_registry().counter("autotune.probes_total").inc()
     sim = Simulation(pcfg, plan=dataclasses.replace(plan, slab_chains=n))
-    _, _, rate = time_reduce_blocks(sim, n_timed, 1)
+    with annotate("tmhpvsim/autotune.probe"):
+        _, _, rate = time_reduce_blocks(sim, n_timed, 1)
     del sim  # free device buffers before the next candidate compiles
     return rate
 

@@ -98,22 +98,28 @@ def save(path: str, state, next_block: int, config=None) -> None:
     """
     import os
 
-    flat = _flatten(state)
-    meta = {"next_block": int(next_block)}
-    if config is not None:
-        meta["prng_impl"] = getattr(config, "prng_impl", "threefry2x32")
-        meta["config"] = _config_echo(config)
-    else:
-        # no config: infer the impl from the stored key_data layout
-        # (threefry: 2 words, rbg: 4) so bare save()/load() round-trips
-        # still reconstruct the right key type
-        widths = {v.shape[-1] for k, v in flat.items()
-                  if k.startswith(_KEY_PREFIX)}
-        meta["prng_impl"] = "rbg" if widths == {4} else "threefry2x32"
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **flat, **{_META: json.dumps(meta)})
-    os.replace(tmp, path)
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs.profiler import annotate
+
+    with obs_metrics.get_registry().timed("checkpoint.save_s"), \
+            annotate("tmhpvsim/checkpoint.save"):
+        flat = _flatten(state)
+        meta = {"next_block": int(next_block)}
+        if config is not None:
+            meta["prng_impl"] = getattr(config, "prng_impl",
+                                        "threefry2x32")
+            meta["config"] = _config_echo(config)
+        else:
+            # no config: infer the impl from the stored key_data layout
+            # (threefry: 2 words, rbg: 4) so bare save()/load()
+            # round-trips still reconstruct the right key type
+            widths = {v.shape[-1] for k, v in flat.items()
+                      if k.startswith(_KEY_PREFIX)}
+            meta["prng_impl"] = "rbg" if widths == {4} else "threefry2x32"
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat, **{_META: json.dumps(meta)})
+        os.replace(tmp, path)
 
 
 def peek_meta(path: str) -> dict:
@@ -124,6 +130,15 @@ def peek_meta(path: str) -> dict:
 
 def load(path: str, config=None) -> Tuple[dict, int]:
     """Read (state, next_block); verifies the config echo when given."""
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs.profiler import annotate
+
+    with obs_metrics.get_registry().timed("checkpoint.restore_s"), \
+            annotate("tmhpvsim/checkpoint.restore"):
+        return _load(path, config)
+
+
+def _load(path: str, config=None) -> Tuple[dict, int]:
     with np.load(path, allow_pickle=False) as data:
         meta = json.loads(str(data[_META]))
         flat = {k: data[k] for k in data.files if k != _META}

@@ -1,78 +1,17 @@
-"""Observability: block timing + device profiler traces.
+"""Compatibility shim: block timing + profiler moved to ``obs``.
 
-The reference's only observability is stdlib logging and a behind-realtime
-warning (SURVEY.md §5).  The TPU build adds the two things that matter for
-a device workload: per-block throughput accounting (simulated site-seconds
-per wall second — the benchmark metric) and ``jax.profiler`` traces for
-XLA-level inspection in TensorBoard/Perfetto.
+The observability subsystem (metrics registry, run reports, platform-
+guarded device traces) lives in :mod:`tmhpvsim_tpu.obs`; this module
+re-exports the profiler names so existing imports — and test
+monkeypatching of ``engine.profiling.BlockTimer`` — keep working.
 """
 
 from __future__ import annotations
 
-import contextlib
-import logging
-import time
-
-logger = logging.getLogger(__name__)
-
-
-class BlockTimer:
-    """Accumulates per-block wall times and derives throughput.
-
-    Usage::
-
-        timer = BlockTimer(n_chains=cfg.n_chains, block_s=cfg.block_s)
-        for blk in sim.run_blocks():
-            timer.tick()        # call once per completed block
-        timer.summary()         # dict; also logged at INFO
-    """
-
-    def __init__(self, n_chains: int, block_s: int):
-        self.n_chains = n_chains
-        self.block_s = block_s
-        self._last = time.perf_counter()
-        self._first_dt = None
-        self.block_times = []
-
-    def tick(self) -> float:
-        now = time.perf_counter()
-        dt = now - self._last
-        self._last = now
-        if self._first_dt is None:
-            self._first_dt = dt  # includes compile; kept separately
-        else:
-            self.block_times.append(dt)
-        rate = self.n_chains * self.block_s / dt
-        logger.info(
-            "block done in %.3f s (%.3g site-s/s)%s", dt, rate,
-            " [first: includes compile]" if not self.block_times else "",
-        )
-        return dt
-
-    def summary(self) -> dict:
-        steady = self.block_times or [self._first_dt or 0.0]
-        total = sum(steady)
-        out = {
-            "n_blocks_timed": len(steady),
-            "first_block_s": self._first_dt,
-            "steady_block_s": total / len(steady),
-            "site_seconds_per_s": (
-                self.n_chains * self.block_s * len(steady) / total
-                if total else 0.0
-            ),
-        }
-        logger.info("throughput: %(site_seconds_per_s).3g site-s/s "
-                    "(steady block %(steady_block_s).3f s)", out)
-        return out
-
-
-@contextlib.contextmanager
-def device_trace(log_dir: str):
-    """``jax.profiler`` trace scope (view in TensorBoard / Perfetto)."""
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+from tmhpvsim_tpu.obs.profiler import (  # noqa: F401
+    BlockTimer,
+    PlatformMismatchError,
+    annotate,
+    device_trace,
+    read_manifest,
+)

@@ -50,6 +50,8 @@ import numpy as np
 
 from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs.profiler import BlockTimer, annotate
 from tmhpvsim_tpu.models import clearsky_index as ci
 from tmhpvsim_tpu.models import pv as pvmod
 from tmhpvsim_tpu.models import renewal
@@ -179,6 +181,18 @@ class Simulation:
         from tmhpvsim_tpu.engine import autotune
 
         self.plan = autotune.resolve_plan(config) if plan is None else plan
+        #: the process-default metrics registry at construction time —
+        #: apps that want an isolated per-run registry install it with
+        #: obs.metrics.use_registry() BEFORE constructing the Simulation
+        self.metrics = obs_metrics.get_registry()
+        #: quiet internal block timer: apps keep their own (logging)
+        #: BlockTimer as the single log voice; this one feeds the
+        #: registry (engine.compile_s / engine.block_wall_s) and
+        #: run_report()'s timing section
+        self.timer = BlockTimer(config.n_chains, config.block_s,
+                                log=False, registry=self.metrics,
+                                prefix="engine")
+        self._m_blocks = self.metrics.counter("engine.blocks_total")
         #: subclasses/callers with their own partitioning (the sharded
         #: mesh loop, checkpointed runs in apps/pvsim.py) clear this to
         #: keep run_reduced/run_ensemble from delegating to the
@@ -968,14 +982,21 @@ class Simulation:
         # processing the yielded block (apps/pvsim.py), so the state must
         # always correspond to the LAST YIELDED block.  Host/device overlap
         # comes from the input prefetcher + async jax dispatch instead.
+        self.timer.reset_clock()
         try:
             for bi in range(start_block, self.n_blocks):
                 inputs, epoch = pf.get(bi)
-                self.state, a, b = jit(self.state, inputs)
+                with annotate("tmhpvsim/block_step"):
+                    self.state, a, b = jit(self.state, inputs)
                 off = bi * cfg.block_s
                 n_valid = min(cfg.block_s, cfg.duration_s - off)
-                yield make_result(off, np.asarray(epoch[:n_valid]),
-                                  a, b, n_valid)
+                result = make_result(off, np.asarray(epoch[:n_valid]),
+                                     a, b, n_valid)
+                # the gather in make_result synchronised, so the tick
+                # bounds this block's dispatch+compute+gather wall
+                self.timer.tick()
+                self._m_blocks.inc()
+                yield result
         finally:
             pf.close()
 
@@ -1037,11 +1058,19 @@ class Simulation:
                 acc, self.init_reduce_acc, "acc"))
         self._last_acc = acc  # device-side, for ensemble_stats()
         pf = InputPrefetcher(self, start_block, self.n_blocks)
+        self.timer.reset_clock()
         try:
             for bi in range(start_block, self.n_blocks):
                 inputs, _ = pf.get(bi)
-                self.state, acc = self.step_acc(self.state, inputs, acc)
+                with annotate("tmhpvsim/block_step"):
+                    self.state, acc = self.step_acc(self.state, inputs,
+                                                    acc)
                 self._last_acc = acc
+                # async dispatch: per-block ticks measure dispatch-to-
+                # dispatch, which backpressure makes honest over a run
+                # (same semantics as the app-level timers)
+                self.timer.tick()
+                self._m_blocks.inc()
                 if on_block is not None:
                     on_block(bi, self.state, acc)
         finally:
@@ -1135,6 +1164,23 @@ class Simulation:
             v = np.asarray(a[name], np.int64 if dkind == "i" else np.float64)
             out[name] = (int if dkind == "i" else float)(np_op[kind](v))
         return out
+
+    def run_report(self, app: str = "engine", path=None, headline=None):
+        """The run's :class:`~tmhpvsim_tpu.obs.report.RunReport`: config,
+        the resolved plan, the internal timer's compile/steady split, and
+        every metric this run's registry accumulated (slab progress,
+        checkpoint timings, pacing slip).  Writes to ``path`` when given;
+        returns the validated document either way."""
+        from tmhpvsim_tpu.obs.report import RunReport
+
+        rep = RunReport(app, config=self.config, plan=self.plan)
+        summary = self.timer.summary()
+        rep.set_timing(summary)
+        rep.attach_metrics(self.metrics)
+        rep.headline = headline if headline is not None else {
+            "site_seconds_per_s": summary["site_seconds_per_s"],
+        }
+        return rep.write(path) if path else rep.doc()
 
 
 def write_csv(path: str, blocks: Iterator[BlockResult], chain: int = 0,

@@ -27,6 +27,8 @@ from typing import Iterator
 import numpy as np
 
 from tmhpvsim_tpu.config import Plan, SimConfig, slice_grid
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs.profiler import annotate
 
 
 class SlabScheduler:
@@ -80,16 +82,24 @@ class SlabScheduler:
         unslabbed result (module docstring).  ``on_block(bi, state, acc)``
         receives a GLOBAL block counter (slab-major: slab 0's blocks, then
         slab 1's, ...) so timing hooks see monotonic progress."""
+        reg = obs_metrics.get_registry()
+        g_total = reg.gauge("slab.total")
+        g_done = reg.gauge("slab.completed")
+        g_total.set(len(self.slab_cfgs))
+        g_done.set(0)
+        reg.counter("slab.runs_total").inc()
         outs = []
         gblock = 0
-        for cfg in self.slab_cfgs:
+        for si, cfg in enumerate(self.slab_cfgs):
             sim = self._make_sim(cfg)
             cb = None
             if on_block is not None:
                 def cb(bi, state, acc, _g=gblock):
                     return on_block(_g + bi, state, acc)
-            outs.append(sim.run_reduced(on_block=cb))
+            with annotate(f"tmhpvsim/slab{si}"):
+                outs.append(sim.run_reduced(on_block=cb))
             gblock += sim.n_blocks
+            g_done.set(si + 1)
             del sim  # free the slab's buffers before the next compiles
         return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
 
@@ -99,13 +109,20 @@ class SlabScheduler:
         completion one at a time (the per-block vectors are only
         O(block_s) on the host), then the combined BlockResults are
         yielded in time order."""
+        reg = obs_metrics.get_registry()
+        g_total = reg.gauge("slab.total")
+        g_done = reg.gauge("slab.completed")
+        g_total.set(len(self.slab_cfgs))
+        g_done.set(0)
+        reg.counter("slab.runs_total").inc()
         total = self.config.n_chains
         meta = None       # [(offset, epoch)]
         m_sums = p_sums = None
-        for cfg in self.slab_cfgs:
+        for si, cfg in enumerate(self.slab_cfgs):
             sim = self._make_sim(cfg)
             w = cfg.n_chains / total
-            blocks = list(sim.run_ensemble())
+            with annotate(f"tmhpvsim/slab{si}"):
+                blocks = list(sim.run_ensemble())
             if meta is None:
                 meta = [(b.offset, b.epoch) for b in blocks]
                 m_sums = [w * b.meter for b in blocks]
@@ -114,6 +131,7 @@ class SlabScheduler:
                 for i, b in enumerate(blocks):
                     m_sums[i] = m_sums[i] + w * b.meter
                     p_sums[i] = p_sums[i] + w * b.pv
+            g_done.set(si + 1)
             del sim
         from tmhpvsim_tpu.engine.simulation import BlockResult
 

@@ -1,0 +1,37 @@
+"""Observability subsystem: metrics registry, run reports, profiler.
+
+Three host-side modules (nothing here ever runs inside jit):
+
+* :mod:`~tmhpvsim_tpu.obs.metrics` — low-overhead counters / gauges /
+  histograms with pluggable sinks (JSONL, Prometheus text exposition);
+* :mod:`~tmhpvsim_tpu.obs.report` — the schema-versioned ``RunReport``
+  emitted at the end of every engine/app/bench run;
+* :mod:`~tmhpvsim_tpu.obs.profiler` — block timing, ``jax.profiler``
+  trace annotations, and platform-guarded device traces (the round-5
+  retraction happened because a CPU-fallback trace was committed as
+  device evidence; the guard makes that impossible to miss again).
+
+``engine/profiling.py`` remains as a compatibility shim re-exporting
+the profiler names.
+"""
+
+from tmhpvsim_tpu.obs.metrics import (  # noqa: F401
+    JsonlSink,
+    MetricsRegistry,
+    PrometheusSink,
+    get_registry,
+    make_sink,
+    use_registry,
+)
+from tmhpvsim_tpu.obs.profiler import (  # noqa: F401
+    BlockTimer,
+    PlatformMismatchError,
+    annotate,
+    device_trace,
+    read_manifest,
+)
+from tmhpvsim_tpu.obs.report import (  # noqa: F401
+    REPORT_SCHEMA_VERSION,
+    RunReport,
+    validate_report,
+)

@@ -1,0 +1,351 @@
+"""Low-overhead host-side metrics: counters, gauges, histograms, sinks.
+
+Everything here runs on the host, outside jit — an instrumented call is
+a dict lookup plus a float add, so the engine's per-block hooks cost
+microseconds against block walls of milliseconds to seconds (asserted by
+tests/test_obs.py's 65536-chain overhead test).  A registry with no
+sinks attached never touches the filesystem; a disabled registry
+(``MetricsRegistry(enabled=False)``) hands out shared no-op metric
+objects so instrumented code needs no conditionals.
+
+Sinks (``registry.add_sink``) receive the registry on every ``flush()``:
+
+* :class:`JsonlSink` — appends one JSON snapshot line per flush (the
+  ``--metrics PATH`` artifact: greppable time series of the run);
+* :class:`PrometheusSink` — rewrites a text-exposition snapshot file
+  atomically (point a node_exporter textfile collector at it).
+
+``make_sink(path)`` picks by suffix: ``.prom`` -> Prometheus, anything
+else JSONL.  The process-default registry (:func:`get_registry`) is what
+the engine/runtime layers instrument against; apps install a fresh one
+per run via :func:`use_registry` so reports never mix runs.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Iterable, Optional
+
+logger = logging.getLogger(__name__)
+
+#: histogram bucket upper bounds (seconds-flavoured log-ish grid; the
+#: +Inf bucket is implicit).  Wide enough for µs-scale host hooks and
+#: minute-scale compile times alike.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+)
+
+
+class Counter:
+    """Monotonically increasing value (floats allowed: cumulative
+    seconds are counters too)."""
+
+    __slots__ = ("name", "_v")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease "
+                             f"(inc({amount}))")
+        self._v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "_v")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+
+    def set(self, value: float) -> None:
+        self._v = float(value)
+
+    def add(self, delta: float) -> None:
+        self._v += float(delta)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Histogram:
+    """Count/sum/min/max plus cumulative bucket counts (Prometheus
+    semantics: ``buckets[i]`` counts observations <= ``bounds[i]``)."""
+
+    __slots__ = ("name", "bounds", "bucket_counts", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, name: str, buckets: Iterable[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self.bucket_counts = [0] * len(self.bounds)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
+        i = bisect.bisect_left(self.bounds, value)
+        if i < len(self.bucket_counts):
+            self.bucket_counts[i] += 1
+
+    def snapshot(self) -> dict:
+        cum = []
+        running = 0
+        for bound, n in zip(self.bounds, self.bucket_counts):
+            running += n
+            cum.append([bound, running])
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": (self.sum / self.count) if self.count else None,
+            "buckets": cum,
+        }
+
+
+class _NullMetric:
+    """Shared no-op stand-in handed out by a disabled registry."""
+
+    name = "<disabled>"
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def add(self, delta: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+
+_NULL = _NullMetric()
+
+
+class MetricsRegistry:
+    """Named metrics + sinks.  Creation is locked (threads share the
+    process-default registry); the hot-path mutators are plain float ops
+    under the GIL — single-writer-per-metric is the expected pattern."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._metrics: dict = {}
+        self._sinks: list = []
+        self._lock = threading.Lock()
+
+    # -- metric accessors ------------------------------------------------
+
+    def _get(self, name: str, cls, **kw):
+        if not self.enabled:
+            return _NULL
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.setdefault(name, cls(name, **kw))
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}"
+            )
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, Histogram, buckets=buckets)
+
+    @contextlib.contextmanager
+    def timed(self, name: str):
+        """Wall-time a block into histogram ``name`` (nests naturally:
+        inner scopes are separate metrics and the outer span includes
+        them)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.histogram(name).observe(time.perf_counter() - t0)
+
+    # -- snapshots & sinks -----------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able state of every metric."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            else:
+                out["histograms"][name] = m.snapshot()
+        return out
+
+    def prometheus_text(self, prefix: str = "tmhpvsim") -> str:
+        """The registry in Prometheus text exposition format."""
+        lines = []
+        for name, m in sorted(self._metrics.items()):
+            pname = _prom_name(f"{prefix}_{name}" if prefix else name)
+            if isinstance(m, Counter):
+                lines += [f"# TYPE {pname} counter",
+                          f"{pname} {_prom_num(m.value)}"]
+            elif isinstance(m, Gauge):
+                lines += [f"# TYPE {pname} gauge",
+                          f"{pname} {_prom_num(m.value)}"]
+            else:
+                lines.append(f"# TYPE {pname} histogram")
+                running = 0
+                for bound, n in zip(m.bounds, m.bucket_counts):
+                    running += n
+                    lines.append(
+                        f'{pname}_bucket{{le="{_prom_num(bound)}"}} '
+                        f"{running}"
+                    )
+                lines.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{pname}_sum {_prom_num(m.sum)}")
+                lines.append(f"{pname}_count {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def add_sink(self, sink) -> None:
+        self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        if sink in self._sinks:
+            self._sinks.remove(sink)
+
+    def flush(self, event: Optional[str] = None) -> None:
+        """Emit the current state to every sink (no-op with no sinks)."""
+        for sink in self._sinks:
+            try:
+                sink.emit(self, event)
+            except Exception as e:
+                # a sink must never kill the run it observes (closed
+                # fd -> ValueError, full disk -> OSError)
+                logger.warning("metrics sink %r failed: %s", sink, e)
+
+    def close(self) -> None:
+        for sink in self._sinks:
+            close = getattr(sink, "close", None)
+            if close is not None:
+                close()
+        self._sinks.clear()
+
+
+def _prom_name(name: str) -> str:
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return name if not name[:1].isdigit() else "_" + name
+
+
+def _prom_num(v: float) -> str:
+    # integral values render without the trailing '.0' Prometheus text
+    # parsers tolerate but humans grep for
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class JsonlSink:
+    """Appends ``{"ts": ..., "event": ..., "metrics": snapshot}`` as one
+    JSON line per flush."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def emit(self, registry: MetricsRegistry, event: Optional[str]) -> None:
+        doc = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "event": event,
+            "metrics": registry.snapshot(),
+        }
+        self._f.write(json.dumps(doc) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class PrometheusSink:
+    """Rewrites ``path`` with a full text-exposition snapshot on every
+    flush (atomic tmp + rename: a scraper never reads a torn file)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def emit(self, registry: MetricsRegistry, event: Optional[str]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(registry.prometheus_text())
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        pass
+
+
+def make_sink(path: str):
+    """Sink for ``--metrics PATH``: ``.prom`` -> Prometheus snapshot,
+    anything else JSONL append."""
+    return PrometheusSink(path) if path.endswith(".prom") \
+        else JsonlSink(path)
+
+
+#: process-default registry: what library layers (engine, runtime.clock,
+#: checkpoint, slab) instrument against
+_default = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default
+
+
+@contextlib.contextmanager
+def use_registry(registry: MetricsRegistry):
+    """Install ``registry`` as the process default for the scope — apps
+    wrap each run so a run's report only sees that run's metrics.
+    NB: library code that cached ``get_registry()`` at construction time
+    keeps its registry; construct Simulations inside the scope."""
+    global _default
+    prev = _default
+    _default = registry
+    try:
+        yield registry
+    finally:
+        _default = prev

@@ -1,0 +1,238 @@
+"""Block timing, trace annotations, and platform-guarded device traces.
+
+Absorbs ``engine/profiling.py`` (kept there as a re-export shim) and
+hardens it around the round-5 failure mode: the "device" traces in
+``benchmarks/profile_r05`` were silently CPU-fallback captures — the
+env-pinned TPU tunnel had flipped the process to CPU before the trace
+started — and the roofline claim built on them had to be retracted
+(VERDICT.md §5).  :func:`device_trace` therefore records the platform
+that actually executed inside a sidecar manifest
+(``trace_manifest.json``) next to the trace, logs a WARNING whenever it
+differs from the caller's expectation, and can refuse outright
+(``strict=True``).  A trace directory without a manifest, or with
+``platform_mismatch: true``, is not device evidence.
+
+:func:`annotate` wraps ``jax.profiler.TraceAnnotation`` so the engine's
+block step, slab, checkpoint and autotune-probe regions are navigable
+spans in Perfetto/TensorBoard instead of one undifferentiated wall of
+XLA ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+#: sidecar written into every trace directory by :func:`device_trace`
+MANIFEST_NAME = "trace_manifest.json"
+MANIFEST_SCHEMA_VERSION = 1
+
+#: env override for the expected platform when the caller passes none
+#: (battery scripts export it so ad-hoc captures inherit the guard)
+EXPECT_ENV = "TMHPVSIM_EXPECT_PLATFORM"
+
+
+class PlatformMismatchError(RuntimeError):
+    """A ``strict`` device trace executed on a platform other than the
+    expected one (e.g. TPU expected, CPU traced)."""
+
+
+class BlockTimer:
+    """Accumulates per-block wall times and derives throughput.
+
+    The first tick is kept apart as the compile-inclusive block
+    (``compile_s``); steady-state statistics come only from later
+    blocks, and ``summary()`` reports ``steady_block_s=None`` rather
+    than passing the compile block off as steady state when it is all
+    there is (the pre-obs version conflated them).
+
+    Usage::
+
+        timer = BlockTimer(n_chains=cfg.n_chains, block_s=cfg.block_s)
+        for blk in sim.run_blocks():
+            timer.tick()        # call once per completed block
+        timer.summary()         # dict; also logged at INFO
+
+    ``log=False`` silences the per-tick/summary INFO lines (the engine's
+    internal timer runs quiet so apps' own timers stay the single log
+    voice).  With ``registry=`` every steady block also lands in
+    ``<prefix>.block_wall_s`` and the compile block in
+    ``<prefix>.compile_s`` on that metrics registry.
+    """
+
+    def __init__(self, n_chains: int, block_s: int, log: bool = True,
+                 registry=None, prefix: str = "blocks"):
+        self.n_chains = n_chains
+        self.block_s = block_s
+        self._log = log
+        self._registry = registry
+        self._prefix = prefix
+        self._last = time.perf_counter()
+        self._first_dt = None
+        self.block_times = []
+
+    def reset_clock(self) -> None:
+        """Restart the tick reference without discarding history — call
+        at loop entry when construction and first block are separated by
+        unrelated work (autotune probes, checkpoint loads)."""
+        self._last = time.perf_counter()
+
+    def tick(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._last
+        self._last = now
+        if self._first_dt is None:
+            self._first_dt = dt  # includes compile; kept separately
+            if self._registry is not None:
+                self._registry.gauge(f"{self._prefix}.compile_s").set(dt)
+        else:
+            self.block_times.append(dt)
+            if self._registry is not None:
+                self._registry.histogram(
+                    f"{self._prefix}.block_wall_s").observe(dt)
+        if self._log:
+            rate = self.n_chains * self.block_s / dt
+            logger.info(
+                "block done in %.3f s (%.3g site-s/s)%s", dt, rate,
+                " [first: includes compile]" if not self.block_times else "",
+            )
+        return dt
+
+    def summary(self) -> dict:
+        """Timing split compile-vs-steady.
+
+        ``compile_s`` is the first (compile-inclusive) block wall —
+        upper bound on compile, includes one block of steady work;
+        ``steady_block_s`` averages the remaining blocks and is None
+        when none exist.  ``site_seconds_per_s`` prefers steady blocks
+        and falls back to the compile-inclusive one, flagged by
+        ``rate_includes_compile``.  ``first_block_s`` is kept as an
+        alias of ``compile_s`` for older consumers.
+        """
+        steady = self.block_times
+        total = sum(steady)
+        n_timed = len(steady) + (1 if self._first_dt is not None else 0)
+        if total:
+            rate = self.n_chains * self.block_s * len(steady) / total
+        elif self._first_dt:
+            rate = self.n_chains * self.block_s / self._first_dt
+        else:
+            rate = 0.0
+        out = {
+            "n_blocks_timed": n_timed,
+            "first_block_s": self._first_dt,
+            "compile_s": self._first_dt,
+            "steady_block_s": (total / len(steady)) if steady else None,
+            "site_seconds_per_s": rate,
+            "rate_includes_compile": not steady,
+        }
+        if self._log:
+            if steady:
+                logger.info(
+                    "throughput: %(site_seconds_per_s).3g site-s/s "
+                    "(steady block %(steady_block_s).3f s)", out)
+            elif self._first_dt is not None:
+                logger.info(
+                    "throughput: %(site_seconds_per_s).3g site-s/s "
+                    "(single block %(compile_s).3f s, includes compile; "
+                    "no steady blocks timed)", out)
+        return out
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Host-side ``jax.profiler.TraceAnnotation`` span (a named region in
+    Perfetto); degrades to a no-op when jax/profiling is unavailable."""
+    try:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:  # no jax, or profiling backend unavailable
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+def read_manifest(log_dir: str) -> Optional[dict]:
+    """The trace sidecar manifest, or None when absent/unreadable (an
+    absent manifest means the capture predates the platform guard — do
+    not treat it as device evidence)."""
+    try:
+        with open(os.path.join(log_dir, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str, expect_platform: Optional[str] = None,
+                 strict: bool = False):
+    """``jax.profiler`` trace scope with a platform-guarded sidecar.
+
+    On exit, ``trace_manifest.json`` in ``log_dir`` records the backend
+    that actually executed (``jax.default_backend()``), the expected
+    platform, and ``platform_mismatch``.  A mismatch logs at WARNING —
+    and raises :class:`PlatformMismatchError` under ``strict=True`` — so
+    a CPU-fallback capture can never again be committed as a device
+    trace unnoticed.  ``expect_platform`` defaults to the
+    ``TMHPVSIM_EXPECT_PLATFORM`` env var; None/unset disables the guard
+    (the platform is still recorded).
+    """
+    import jax
+
+    if expect_platform is None:
+        expect_platform = os.environ.get(EXPECT_ENV) or None
+    t0 = time.perf_counter()
+    started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    jax.profiler.start_trace(log_dir)
+    body_ok = True
+    try:
+        yield
+    except BaseException:
+        body_ok = False
+        raise
+    finally:
+        jax.profiler.stop_trace()
+        traced = None
+        device_kind = None
+        try:
+            traced = jax.default_backend()
+            device_kind = jax.devices()[0].device_kind
+        except Exception as e:  # never lose the trace over a query
+            logger.warning("could not query traced platform: %s", e)
+        mismatch = (expect_platform is not None and traced is not None
+                    and traced != expect_platform)
+        manifest = {
+            "schema_version": MANIFEST_SCHEMA_VERSION,
+            "traced_platform": traced,
+            "device_kind": device_kind,
+            "expected_platform": expect_platform,
+            "platform_mismatch": mismatch,
+            "started_utc": started,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            with open(os.path.join(log_dir, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f, indent=1)
+        except OSError as e:
+            logger.warning("trace manifest write failed (%s): %s",
+                           log_dir, e)
+        if mismatch:
+            logger.warning(
+                "platform_mismatch: device trace in %s captured backend "
+                "%r but %r was expected — this capture is NOT %s "
+                "evidence (see %s)", log_dir, traced, expect_platform,
+                expect_platform, MANIFEST_NAME,
+            )
+            if strict and body_ok:
+                raise PlatformMismatchError(
+                    f"trace in {log_dir} executed on {traced!r}, "
+                    f"expected {expect_platform!r}"
+                )

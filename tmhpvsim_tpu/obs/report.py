@@ -1,0 +1,285 @@
+"""RunReport: the schema-versioned JSON artifact every run emits.
+
+One report per engine/app/bench run, so every performance claim is
+backed by a machine-checkable record of what ran where: config + the
+resolved autotune ``Plan``, device platform/kind/process count, HBM
+stats, per-block walls split compile-vs-steady, checkpoint save/restore
+timings, slab progress, pacing slip, the headline site-s/s figure, and
+(when a device trace was captured) the trace's platform-guard manifest.
+Retraction-proofing is the point: round 5's roofline had to be
+withdrawn because none of this was recorded (VERDICT.md §5).
+
+The validator is hand-rolled (no jsonschema dependency): required keys,
+per-field types, no unknown top-level keys, and the document must be
+JSON-serialisable.  Consumers match on ``schema_version`` /
+``kind`` — bump :data:`REPORT_SCHEMA_VERSION` on breaking changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+REPORT_SCHEMA_VERSION = 1
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+_NUM = (int, float)
+_OPT_DICT = (dict, type(None))
+
+#: top-level schema: name -> (required, allowed types).  Optional dict
+#: sections are None when the run had nothing to report there.
+_TOP_SCHEMA = {
+    "schema_version": (True, int),
+    "kind": (True, str),
+    "app": (True, str),
+    "created_utc": (True, str),
+    "device": (True, dict),
+    "config": (False, _OPT_DICT),
+    "plan": (False, _OPT_DICT),
+    "timing": (False, _OPT_DICT),
+    "checkpoint": (False, _OPT_DICT),
+    "slabs": (False, _OPT_DICT),
+    "realtime": (False, _OPT_DICT),
+    "headline": (False, _OPT_DICT),
+    "metrics": (False, _OPT_DICT),
+    "profile": (False, _OPT_DICT),
+    "processes": (False, (list, type(None))),
+}
+
+_DEVICE_SCHEMA = {
+    "platform": (True, (str, type(None))),
+    "device_kind": (False, (str, type(None))),
+    "n_devices": (False, int),
+    "process_count": (False, int),
+    "process_index": (False, int),
+    "memory_stats": (False, _OPT_DICT),
+}
+
+_TIMING_SCHEMA = {
+    "compile_s": (False, _NUM + (type(None),)),
+    "steady_block_s": (False, _NUM + (type(None),)),
+    "first_block_s": (False, _NUM + (type(None),)),
+    "n_blocks_timed": (False, int),
+    "site_seconds_per_s": (False, _NUM + (type(None),)),
+    "rate_includes_compile": (False, bool),
+}
+
+
+def _check_fields(doc: dict, schema: dict, where: str,
+                  closed: bool = False) -> None:
+    for key, (required, types) in schema.items():
+        if key not in doc:
+            if required:
+                raise ValueError(f"run report {where}: missing required "
+                                 f"key {key!r}")
+            continue
+        if not isinstance(doc[key], types):
+            raise ValueError(
+                f"run report {where}: {key!r} has type "
+                f"{type(doc[key]).__name__}, expected "
+                f"{'/'.join(t.__name__ for t in (types if isinstance(types, tuple) else (types,)))}"
+            )
+    if closed:
+        unknown = set(doc) - set(schema)
+        if unknown:
+            raise ValueError(f"run report {where}: unknown keys "
+                             f"{sorted(unknown)}")
+
+
+def validate_report(doc) -> dict:
+    """Validate ``doc`` against the versioned schema; returns it.
+
+    Raises ValueError on: non-dict, wrong kind/schema_version, missing
+    required fields, mistyped fields, unknown top-level keys, or a
+    document json.dumps cannot serialise.
+    """
+    if not isinstance(doc, dict):
+        raise ValueError(f"run report must be a dict, got "
+                         f"{type(doc).__name__}")
+    _check_fields(doc, _TOP_SCHEMA, "top level", closed=True)
+    if doc["kind"] != REPORT_KIND:
+        raise ValueError(f"run report kind {doc['kind']!r} != "
+                         f"{REPORT_KIND!r}")
+    if doc["schema_version"] != REPORT_SCHEMA_VERSION:
+        raise ValueError(
+            f"run report schema_version {doc['schema_version']!r} != "
+            f"{REPORT_SCHEMA_VERSION} (this build)"
+        )
+    _check_fields(doc["device"], _DEVICE_SCHEMA, "device")
+    if isinstance(doc.get("timing"), dict):
+        _check_fields(doc["timing"], _TIMING_SCHEMA, "timing")
+    try:
+        json.dumps(doc)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"run report is not JSON-serialisable: {e}") from e
+    return doc
+
+
+def device_info() -> dict:
+    """Platform/device/process facts, every query individually guarded —
+    a report must never die on a backend that cannot answer (the wedged
+    tunnel answers nothing; the watchdog path still needs its report)."""
+    out = {"platform": None, "device_kind": None, "n_devices": 0,
+           "process_count": 1, "process_index": 0, "memory_stats": None}
+    try:
+        import jax
+    except Exception as e:
+        logger.warning("device_info: jax unavailable (%s)", e)
+        return out
+    for key, query in (
+        ("platform", lambda: jax.default_backend()),
+        ("device_kind", lambda: jax.local_devices()[0].device_kind),
+        ("n_devices", lambda: jax.device_count()),
+        ("process_count", lambda: jax.process_count()),
+        ("process_index", lambda: jax.process_index()),
+    ):
+        try:
+            out[key] = query()
+        except Exception:
+            pass
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats is not None:
+            # plain ints only (device stats can carry numpy scalars)
+            out["memory_stats"] = {k: int(v) for k, v in stats.items()}
+    except Exception:
+        pass  # CPU backends have no memory_stats
+    return out
+
+
+def _config_doc(config) -> Optional[dict]:
+    """JSON-able echo of a SimConfig (or a prepared dict, passed
+    through).  Dataclass-based so the echo tracks config growth; tuples
+    normalised to lists for stable comparisons."""
+    if config is None or isinstance(config, dict):
+        return config
+    try:
+        doc = dataclasses.asdict(config)
+    except TypeError:
+        doc = {k: getattr(config, k) for k in dir(config)
+               if not k.startswith("_")
+               and isinstance(getattr(config, k), (str, int, float,
+                                                   bool, type(None)))}
+    grid = doc.get("site_grid")
+    if isinstance(grid, dict):  # 10k-site grids: echo the size, not rows
+        doc["site_grid"] = {"n_sites": len(grid.get("latitude", ()))}
+    return json.loads(json.dumps(doc, default=_jsonable))
+
+
+def _jsonable(v):
+    for cast in (int, float, str):
+        try:
+            return cast(v)
+        except (TypeError, ValueError):
+            continue
+    return repr(v)
+
+
+def _plan_doc(plan) -> Optional[dict]:
+    if plan is None or isinstance(plan, dict):
+        return plan
+    return {"block_impl": plan.block_impl,
+            "scan_unroll": plan.scan_unroll,
+            "stats_fusion": plan.stats_fusion,
+            "slab_chains": plan.slab_chains,
+            "source": plan.source}
+
+
+class RunReport:
+    """Incremental builder for one run's report.
+
+    Sections start as None and are filled by the run path that owns
+    them; ``doc()`` assembles + validates, ``write()`` lands the JSON
+    atomically.  ``device`` is collected at build time unless the
+    caller set it (bench's pure-host doc builder injects its own).
+    """
+
+    def __init__(self, app: str, config=None, plan=None):
+        self.app = app
+        self.config = _config_doc(config)
+        self.plan = _plan_doc(plan)
+        self.device: Optional[dict] = None
+        self.timing: Optional[dict] = None
+        self.checkpoint: Optional[dict] = None
+        self.slabs: Optional[dict] = None
+        self.realtime: Optional[dict] = None
+        self.headline: Optional[dict] = None
+        self.metrics: Optional[dict] = None
+        self.profile: Optional[dict] = None
+        self.processes: Optional[list] = None
+
+    def set_timing(self, timer_summary: dict) -> None:
+        """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
+        keys = ("compile_s", "first_block_s", "steady_block_s",
+                "n_blocks_timed", "site_seconds_per_s",
+                "rate_includes_compile")
+        self.timing = {k: timer_summary[k] for k in keys
+                       if k in timer_summary}
+
+    def attach_metrics(self, registry) -> None:
+        """Snapshot a metrics registry and derive the checkpoint / slab
+        / realtime sections from the well-known metric names the
+        instrumented layers use."""
+        snap = registry.snapshot()
+        self.metrics = snap
+        hists = snap.get("histograms", {})
+        gauges = snap.get("gauges", {})
+        save = hists.get("checkpoint.save_s")
+        restore = hists.get("checkpoint.restore_s")
+        if save or restore:
+            self.checkpoint = {
+                "saves": (save or {}).get("count", 0),
+                "save_total_s": (save or {}).get("sum", 0.0),
+                "restores": (restore or {}).get("count", 0),
+                "restore_total_s": (restore or {}).get("sum", 0.0),
+            }
+        if "slab.total" in gauges:
+            self.slabs = {"completed": int(gauges.get("slab.completed", 0)),
+                          "total": int(gauges["slab.total"])}
+        if "clock.pacing_slip_total_s" in gauges or \
+                "clock.pacing_lag_s" in gauges:
+            self.realtime = {
+                "pacing_lag_s": gauges.get("clock.pacing_lag_s", 0.0),
+                "pacing_slip_total_s":
+                    gauges.get("clock.pacing_slip_total_s", 0.0),
+            }
+
+    def doc(self, validate: bool = True) -> dict:
+        out = {
+            "schema_version": REPORT_SCHEMA_VERSION,
+            "kind": REPORT_KIND,
+            "app": self.app,
+            "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "device": self.device if self.device is not None
+            else device_info(),
+            "config": self.config,
+            "plan": self.plan,
+            "timing": self.timing,
+            "checkpoint": self.checkpoint,
+            "slabs": self.slabs,
+            "realtime": self.realtime,
+            "headline": self.headline,
+            "metrics": self.metrics,
+            "profile": self.profile,
+            "processes": self.processes,
+        }
+        return validate_report(out) if validate else out
+
+    def write(self, path: str) -> dict:
+        """Validate + write the report JSON (atomic tmp + rename)."""
+        doc = self.doc()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        return doc

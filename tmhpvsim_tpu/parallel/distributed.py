@@ -13,6 +13,7 @@ host-local views a CSV-writing process needs.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 
@@ -100,3 +101,36 @@ def host_gather_ensemble(arr) -> np.ndarray:
     every host; this is a plain device->host copy, no DCN traffic.
     """
     return np.asarray(arr)
+
+
+def gather_metrics(snapshot: dict) -> list:
+    """Every process's metrics snapshot, in process-index order.
+
+    COLLECTIVE: all processes must call it (same pattern as
+    engine/autotune.py broadcast_plan).  Process 0 embeds the result as
+    the run report's ``processes`` section; the other processes get the
+    same list and simply skip writing.  Single-process runs return
+    ``[snapshot]`` without touching any collective.
+
+    Snapshots are host-side python dicts, so they ride DCN as
+    JSON-encoded uint8 payloads: an allgather of the byte lengths sizes
+    a zero-padded buffer allgather, then each row decodes back to a
+    dict.
+    """
+    if jax.process_count() == 1:
+        return [snapshot]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(
+        json.dumps(snapshot).encode("utf-8"), dtype=np.uint8
+    )
+    lengths = multihost_utils.process_allgather(
+        np.asarray([payload.size], dtype=np.int32)
+    ).ravel()
+    buf = np.zeros(int(lengths.max()), dtype=np.uint8)
+    buf[:payload.size] = payload
+    rows = multihost_utils.process_allgather(buf)
+    return [
+        json.loads(bytes(rows[i][:int(lengths[i])]).decode("utf-8"))
+        for i in range(len(lengths))
+    ]

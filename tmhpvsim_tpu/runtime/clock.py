@@ -26,6 +26,57 @@ from typing import AsyncIterator, Optional
 logger = logging.getLogger(__name__)
 
 
+class PacingMonitor:
+    """Realtime pacing lag as metrics + rate-limited WARNs.
+
+    The reference warns on every late tick past two periods (utils.py:41)
+    and records nothing — at 1 Hz a persistently-behind run floods the
+    log while the total slip stays invisible.  This keeps two gauges on
+    the metrics registry — ``clock.pacing_lag_s`` (current lag behind the
+    ideal grid) and ``clock.pacing_slip_total_s`` (cumulative NEW slip:
+    lag increases only, so recovered lag is not double-counted) — and
+    emits at most one WARN per ``warn_every_s``, carrying the cumulative
+    figure.
+
+    ``observe`` takes an injectable ``now`` for tests and returns True
+    when it warned.
+    """
+
+    def __init__(self, period: float, warn_every_s: float = 10.0):
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+        self.period = period
+        self.warn_every_s = warn_every_s
+        self._last_warn = None
+        self._prev_lag = 0.0
+        reg = obs_metrics.get_registry()
+        self._g_lag = reg.gauge("clock.pacing_lag_s")
+        self._g_slip = reg.gauge("clock.pacing_slip_total_s")
+        self._g_lag.set(0.0)
+        self._g_slip.set(0.0)
+
+    def observe(self, behind: float, now: Optional[float] = None) -> bool:
+        lag = max(0.0, behind)
+        self._g_lag.set(lag)
+        if lag > self._prev_lag:
+            self._g_slip.add(lag - self._prev_lag)
+        self._prev_lag = lag
+        if behind <= 2 * self.period:
+            return False
+        if now is None:
+            now = time.monotonic()
+        if self._last_warn is not None and \
+                now - self._last_warn < self.warn_every_s:
+            return False
+        self._last_warn = now
+        logger.warning(
+            "%.2f s behind realtime (cumulative slip %.2f s; warnings "
+            "rate-limited to one per %.0f s)",
+            behind, self._g_slip.value, self.warn_every_s,
+        )
+        return True
+
+
 async def fixedclock(
     rate: float = 1.0,
     realtime: bool = True,
@@ -40,14 +91,14 @@ async def fixedclock(
     if start is None:
         start = _dt.datetime.now()
     start_wall = time.monotonic()
+    monitor = PacingMonitor(period) if realtime else None
     i = 0
     while duration_s is None or i * period < duration_s:
         yield start + _dt.timedelta(seconds=i * period)
         i += 1
         if realtime:
             behind = (time.monotonic() - start_wall) - i * period
-            if behind > 2 * period:
-                logger.warning("We are %.2f seconds behind realtime", behind)
+            monitor.observe(behind)
             await asyncio.sleep(max(0.0, -behind))
         else:
             await asyncio.sleep(0)
