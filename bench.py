@@ -143,6 +143,12 @@ def _probe_or_fallback() -> tuple[str, bool]:
     return platform, False
 
 
+#: process-wide telemetry level for every config _make_cfg builds
+#: (--telemetry; obs/telemetry.py).  Default off: the headline numbers
+#: stay the untouched hot path.
+TELEMETRY = "off"
+
+
 def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
               **kw):
     from tmhpvsim_tpu.config import SimConfig
@@ -160,6 +166,7 @@ def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
         # built from this default inherits the safe mode
         prng_impl="threefry2x32",
         block_impl="auto",      # scan-fused on accelerators
+        telemetry=TELEMETRY,
     )
     base.update(kw)
     return SimConfig(**base)
@@ -1439,7 +1446,14 @@ def main() -> None:
                          "variant (compile-variance probe)")
     ap.add_argument("--one-variant", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--telemetry", choices=["off", "light", "full"],
+                    default="off",
+                    help="in-graph telemetry level for every config this "
+                         "invocation runs (obs/telemetry.py; default off "
+                         "keeps the headline hot path untouched)")
     args = ap.parse_args()
+    global TELEMETRY
+    TELEMETRY = args.telemetry
     if args.config:
         {"1": config_1, "2": config_2, "3": config_3, "3a": config_3a,
          "4": config_4, "5": config_5}[args.config]()

@@ -162,5 +162,11 @@ fi
 run_json benchmarks/SWEEP_r05.jsonl    sweep     --sweep
 # config 3 LAST (full-year 10k sites, the longest step)
 run_json benchmarks/BENCH_config3.json  config3  --config 3
+# perf-trend gate (non-fatal here: the battery's job is to collect
+# evidence; rc=1 in the log flags a >10% steady-state regression vs the
+# best prior same-platform round for the human doing the round writeup)
+echo "--- bench_trend start $(date -u +%FT%TZ)" >> "$LOG"
+python tools/bench_trend.py >> "$LOG" 2>&1 \
+  || echo "--- bench_trend: REGRESSION OR ERROR rc=$?" >> "$LOG"
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

@@ -135,6 +135,8 @@ _SLOW_LANE = {
     # obs acceptance: two full-size timed arms (enabled vs disabled
     # registry) at 65536 chains on CPU
     "test_metrics_overhead_65536_chains",
+    # telemetry acceptance: same shape, light-vs-off arms
+    "test_telemetry_overhead_65536_chains",
 }
 
 

@@ -243,7 +243,7 @@ from tmhpvsim_tpu.parallel.distributed import initialize_from_env
 assert initialize_from_env()
 
 from tmhpvsim_tpu.apps import pvsim as app
-from tmhpvsim_tpu.engine.profiling import BlockTimer
+from tmhpvsim_tpu.obs.profiler import BlockTimer
 
 pid = jax.process_index()
 workdir = sys.argv[1]   # shared tmp dir passed by the harness

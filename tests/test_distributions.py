@@ -45,6 +45,23 @@ def test_asymmetric_laplace_ppf_roundtrip():
         np.testing.assert_allclose(back, np.asarray(q), atol=1e-10)
 
 
+def test_asymmetric_laplace_ppf_log_guard_at_edges():
+    """The ppf's two branches both evaluate under ``jnp.where``; at the
+    edges (q=0 selects the low branch, q=1 the high branch) the selected
+    branch's log argument is exactly 0, and only the ``jnp.maximum(...,
+    1e-38)`` guards keep the value (and its gradient) finite — without
+    them both are ±inf (verified against the unguarded closed form)."""
+    for kappa in (0.6, 1.0, 2.2375):
+        x = np.asarray(d.asymmetric_laplace_ppf(
+            jnp.asarray([0.0, 1.0], jnp.float64), kappa))
+        assert np.isfinite(x).all(), (kappa, x)
+        assert x[0] < 0 < x[1]  # extreme quantiles on the correct sides
+
+    g = jax.grad(lambda q: d.asymmetric_laplace_ppf(q, 1.5))
+    for q in (0.0, 1e-30, 0.2, 0.9, 1.0 - 1e-16, 1.0):
+        assert np.isfinite(g(jnp.float64(q))), q
+
+
 def test_student_t():
     df = 11.150488007085713
     s = d.student_t(jax.random.key(1), 0.0, 1.0, df, (N,), jnp.float64)

@@ -249,9 +249,15 @@ class TestPlatformGuard:
         assert read_manifest(str(tmp_path)) is None
 
     def test_engine_profiling_shim_reexports(self):
-        # tests/test_distributed.py monkeypatches this path; it must
-        # keep resolving to the same objects as the obs package
-        from tmhpvsim_tpu.engine import profiling as shim
+        # the shim must warn on import (deprecation hygiene: pyproject
+        # escalates DeprecationWarnings from tmhpvsim_tpu.* to errors,
+        # so no internal import can come back) while still resolving to
+        # the same objects as the obs package
+        import sys
+
+        sys.modules.pop("tmhpvsim_tpu.engine.profiling", None)
+        with pytest.warns(DeprecationWarning, match="obs.profiler"):
+            from tmhpvsim_tpu.engine import profiling as shim
 
         assert shim.BlockTimer is BlockTimer
         assert shim.device_trace is device_trace

@@ -144,6 +144,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               prng_impl: str = "threefry2x32",
               block_impl: str = "auto",
               tune: str = "off",
+              telemetry: str = "off",
+              telemetry_strict: bool = False,
               metrics_path: Optional[str] = None,
               run_report_path: Optional[str] = None) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
@@ -173,6 +175,12 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     per-run registry so the artifacts never mix runs.  On a pod slice
     every process gathers its metrics (a collective) and process 0
     embeds them in its report.
+
+    ``telemetry`` ('off'|'light'|'full', reduce mode only) folds
+    in-graph NaN/Inf counters + moments into the block step
+    (obs/telemetry.py) and runs the drift sentinel per block;
+    ``telemetry_strict`` escalates sentinel WARNs to DriftError.  The
+    sentinel's verdict lands in the report's ``telemetry`` section.
     """
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import read_manifest
@@ -191,6 +199,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 block_s=block_s, realtime=realtime, site_grid=site_grid,
                 profile_dir=profile_dir, output=output,
                 prng_impl=prng_impl, block_impl=block_impl, tune=tune,
+                telemetry=telemetry, telemetry_strict=telemetry_strict,
             )
         finally:
             registry.flush(event="end")
@@ -204,6 +213,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     rep.set_timing(summary)
     rep.attach_metrics(registry)
     rep.headline = {"site_seconds_per_s": summary["site_seconds_per_s"]}
+    if getattr(sim, "sentinel", None) is not None:
+        rep.telemetry = sim.sentinel.report()
     if profile_dir:
         rep.profile = read_manifest(profile_dir)
     if jax.process_count() > 1:
@@ -227,7 +238,9 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    output: str = "trace",
                    prng_impl: str = "threefry2x32",
                    block_impl: str = "auto",
-                   tune: str = "off"):
+                   tune: str = "off",
+                   telemetry: str = "off",
+                   telemetry_strict: bool = False):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
     the wrapper can assemble the run report from its config/plan/timer."""
     import contextlib
@@ -283,6 +296,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         prng_impl=prng_impl,
         block_impl=block_impl,
         tune=tune,
+        telemetry=telemetry,
+        telemetry_strict=telemetry_strict,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation

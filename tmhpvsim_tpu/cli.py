@@ -186,6 +186,16 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "miss); force = re-probe even on a hit; the resolved "
                    "plan is echoed in the logs (jax backend, see "
                    "config.SimConfig.tune)")
+@click.option("--telemetry", type=click.Choice(["off", "light", "full"]),
+              default="off",
+              help="in-graph numerics telemetry (jax backend, reduce "
+                   "mode): light = NaN/Inf counters + moments on the "
+                   "device scan carry, checked per block by the drift "
+                   "sentinel; full adds the csi histogram + cloud "
+                   "occupancy; off pays nothing (obs/telemetry.py)")
+@click.option("--telemetry-strict", is_flag=True, default=False,
+              help="escalate drift-sentinel WARNs (NaN/Inf, reference "
+                   "band escape) to a hard error")
 @click.option("--metrics", "metrics_path", default=None,
               help="Stream per-block metric snapshots to this file: .prom "
                    "= Prometheus text exposition (atomic rewrite), "
@@ -197,7 +207,8 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, backend, n_chains, chain, sharded, checkpoint, block_s,
           site_grid_spec, sites_csv, profile_dir, output, prng_impl,
-          block_impl, tune, metrics_path, run_report_path):
+          block_impl, tune, telemetry, telemetry_strict, metrics_path,
+          run_report_path):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     if (metrics_path or run_report_path) and backend != "jax":
@@ -219,6 +230,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--block-impl requires --backend=jax")
     if tune != "off" and backend != "jax":
         raise click.UsageError("--tune requires --backend=jax")
+    if (telemetry != "off" or telemetry_strict) and backend != "jax":
+        raise click.UsageError("--telemetry requires --backend=jax")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
@@ -254,6 +267,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   site_grid=site_grid, profile_dir=profile_dir,
                   output=output, prng_impl=prng_impl,
                   block_impl=block_impl, tune=tune,
+                  telemetry=telemetry,
+                  telemetry_strict=telemetry_strict,
                   metrics_path=metrics_path,
                   run_report_path=run_report_path)
         return

@@ -240,6 +240,12 @@ class Plan:
     #: (measured this process) | 'cache' (persisted probe result) |
     #: 'broadcast' (received from process 0 on a multi-host mesh)
     source: str = "static"
+    #: resolved in-graph telemetry level: 'off' | 'light' | 'full'
+    #: (obs/telemetry.py).  Not a tuned knob — carried on the Plan so the
+    #: engine builds its jits from one resolved object; autotune cache
+    #: entries never persist it (engine/autotune.py re-applies the
+    #: config's request on every cache hit).
+    telemetry: str = "off"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -360,3 +366,16 @@ class SimConfig:
     #: quality is equivalent for Monte-Carlo use; all parity/KS tests pass
     #: under either (the golden model is seeded numpy, not stream-matched).
     prng_impl: str = "threefry2x32"
+
+    #: in-graph numerics telemetry (obs/telemetry.py): 'off' (telemetry
+    #: structurally absent from the traced graph — byte-identical HLO to
+    #: a build without it), 'light' (per-field NaN/Inf counters + running
+    #: moments on the scan carry, flushed per block into the metrics
+    #: registry under device.* and checked by the drift sentinel), or
+    #: 'full' (light + csi histogram + cloud-state occupancy).  Reduce
+    #: mode only; other output modes ignore it.
+    telemetry: str = "off"
+
+    #: escalate drift-sentinel WARNs (NaN/Inf appearance, reference-band
+    #: escape) to obs.sentinel.DriftError
+    telemetry_strict: bool = False

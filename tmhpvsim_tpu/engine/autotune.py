@@ -80,6 +80,15 @@ def _resolve_fusion(config: SimConfig) -> str:
     )
 
 
+def _resolve_telemetry(config: SimConfig) -> str:
+    level = getattr(config, "telemetry", "off")
+    if level not in ("off", "light", "full"):
+        raise ValueError(
+            f"telemetry must be 'off', 'light' or 'full', got {level!r}"
+        )
+    return level
+
+
 def _resolve_impl(config: SimConfig) -> str:
     import jax
 
@@ -103,6 +112,7 @@ def static_plan(config: SimConfig) -> Plan:
         stats_fusion=_resolve_fusion(config),
         slab_chains=config.n_chains,
         source="static",
+        telemetry=_resolve_telemetry(config),
     )
 
 
@@ -209,9 +219,10 @@ def candidate_plans(config: SimConfig, slabs: bool = True) -> list:
         n = config.n_chains if s is None else min(s, config.n_chains)
         if n > 0 and n not in slab_sizes:
             slab_sizes.append(n)
+    telemetry = _resolve_telemetry(config)
     return [
         Plan(block_impl=impl, scan_unroll=u, stats_fusion=fusion,
-             slab_chains=slab, source="probe")
+             slab_chains=slab, source="probe", telemetry=telemetry)
         for impl in impls
         for u in CANDIDATE_UNROLLS
         for slab in slab_sizes
@@ -371,14 +382,20 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
         entry = _load_cache(path).get(key)
         if entry is not None:
             try:
-                return _plan_from_entry(entry)
+                # cache entries never persist telemetry (not a tuned
+                # knob); re-apply this config's request
+                return dataclasses.replace(
+                    _plan_from_entry(entry),
+                    telemetry=_resolve_telemetry(config),
+                )
             except (KeyError, TypeError, ValueError) as e:
                 logger.warning("ignoring malformed autotune cache entry "
                                "for %s: %s", key, e)
     plan, candidates = probe_grid(config, slabs=slabs)
     if plan.source == "probe":  # don't cache the all-failed fallback
         _store_plan(path, key, plan, candidates)
-    return plan
+    return dataclasses.replace(plan,
+                               telemetry=_resolve_telemetry(config))
 
 
 def broadcast_plan(plan: Plan) -> Plan:
@@ -406,6 +423,8 @@ def broadcast_plan(plan: Plan) -> Plan:
         stats_fusion=fusions[int(out[3])],
         slab_chains=int(out[2]),
         source=source,
+        # not broadcast: every process resolved the same config locally
+        telemetry=plan.telemetry,
     )
 
 

@@ -1,14 +1,29 @@
-"""Compatibility shim: block timing + profiler moved to ``obs``.
+"""Deprecated compatibility shim: block timing + profiler moved to ``obs``.
 
 The observability subsystem (metrics registry, run reports, platform-
 guarded device traces) lives in :mod:`tmhpvsim_tpu.obs`; this module
 re-exports the profiler names so existing imports — and test
 monkeypatching of ``engine.profiling.BlockTimer`` — keep working.
+
+Importing it emits a :class:`DeprecationWarning` attributed to the
+importer (``stacklevel=2``), and the test suite escalates
+DeprecationWarnings raised from inside ``tmhpvsim_tpu.*`` to errors
+(pyproject filterwarnings), so no new internal import of the shim can
+land.
 """
 
 from __future__ import annotations
 
-from tmhpvsim_tpu.obs.profiler import (  # noqa: F401
+import warnings
+
+warnings.warn(
+    "tmhpvsim_tpu.engine.profiling is deprecated; import from "
+    "tmhpvsim_tpu.obs.profiler instead",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from tmhpvsim_tpu.obs.profiler import (  # noqa: E402,F401
     BlockTimer,
     PlatformMismatchError,
     annotate,

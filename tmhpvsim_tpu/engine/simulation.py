@@ -51,6 +51,7 @@ import numpy as np
 from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
 from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs import telemetry as tel
 from tmhpvsim_tpu.obs.profiler import BlockTimer, annotate
 from tmhpvsim_tpu.models import clearsky_index as ci
 from tmhpvsim_tpu.models import pv as pvmod
@@ -258,6 +259,23 @@ class Simulation:
         #: jax.jit(closure) per call would never hit the trace cache, which
         #: matters for per-block users of step_reduced/init_reduce_acc
         self._init_jits = {}
+        #: in-graph telemetry (obs/telemetry.py): dedicated tel jits are
+        #: built ONLY when enabled and the off-path jits above are never
+        #: touched, so telemetry='off' lowers to byte-identical HLO
+        #: (asserted by tests/test_telemetry.py)
+        self._telemetry = getattr(self.plan, "telemetry", "off")
+        self._tel_last = None
+        #: the DriftSentinel once telemetry has observed a block
+        #: (obs/sentinel.py); run_report() embeds its verdict
+        self.sentinel = None
+        if self._telemetry != "off":
+            self._scan_acc_tel_jit = jax.jit(
+                self._block_step_scan_acc_tel, donate_argnums=(0, 2)
+            )
+            self._scan2_acc_tel_jit = jax.jit(
+                self._block_step_scan2_acc_tel, donate_argnums=(0, 2)
+            )
+            self._wide_tel_jit = jax.jit(self._wide_telemetry)
 
     # ------------------------------------------------------------------
     # chain state
@@ -703,14 +721,18 @@ class Simulation:
         acc = self._block_stats_acc(meter, pv, inputs["block_idx"]["t"], acc)
         return state, acc
 
-    def _scan_block_setup(self, state, inputs, predraw=True):
+    def _scan_block_setup(self, state, inputs, predraw=True,
+                          with_extras=False):
         """Shared preamble of the scan-fused paths (traced): windows,
         value-major tables, pre-drawn time-major RNG streams, geometry
         routing.  Returns (xs, step, cc_carry) where ``step(rc, x) ->
         (rc', meter, ac)`` runs one second of the full pipeline on
         (n_chains,) vectors.  ``predraw=False`` omits the u/z/meter
         streams from xs — the nested 'scan2' formulation draws them
-        per-minute inside its outer scan instead."""
+        per-minute inside its outer scan instead.  ``with_extras=True``
+        (telemetry paths only) appends a fourth return to ``step``: the
+        intermediates the TelemetryAcc folds ({'csi', 'covered'}); the
+        default step is byte-for-byte the untouched off path."""
         cfg = self.config
         dtype = self.dtype
         opts = cfg.options
@@ -763,7 +785,7 @@ class Simulation:
             xs.update(u=u_T, z=z_T, meter=meter_T)
 
         def step(rc, x):
-            rc, csi, _covered = ci.csi_compose_step(
+            rc, csi, covered = ci.csi_compose_step(
                 tables, x, rc, opts, dtype
             )
             if shared_geom is None:
@@ -782,6 +804,9 @@ class Simulation:
             ac = pvmod.power_from_csi(
                 csi, g, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
             ).astype(dtype)
+            if with_extras:
+                return (rc, x["meter"].astype(dtype), ac,
+                        {"csi": csi, "covered": covered})
             return rc, x["meter"].astype(dtype), ac
 
         return xs, step, cc_carry
@@ -837,6 +862,91 @@ class Simulation:
             unroll=self._unroll,
         )
         return dict(state, carry=rcarry, cc_carry=cc_carry), acc
+
+    def _make_acc_tel_body(self, step):
+        """Telemetry variant of ``_make_acc_body``: the same statistics
+        fold (duplicated verbatim rather than factored out, so the off
+        path's traced graph cannot change) plus the TelemetryAcc fold on
+        a second carry passenger.  ``step`` must come from
+        ``_scan_block_setup(..., with_extras=True)``."""
+        cfg = self.config
+        dtype = self.dtype
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        level = self._telemetry
+
+        def body(carry, x):
+            (rc, st), ta = carry
+            rc, meter, ac, extras = step(rc, x)
+            residual = meter - ac
+            valid = x["t"] < cfg.duration_s      # scalar: padding mask
+            vz = jnp.where(valid, 1.0, 0.0).astype(dtype)
+            st = {
+                "pv_sum": st["pv_sum"] + ac * vz,
+                "pv_max": jnp.maximum(st["pv_max"],
+                                      jnp.where(valid, ac, -big)),
+                "meter_sum": st["meter_sum"] + meter * vz,
+                "residual_sum": st["residual_sum"] + residual * vz,
+                "residual_min": jnp.minimum(st["residual_min"],
+                                            jnp.where(valid, residual, big)),
+                "residual_max": jnp.maximum(st["residual_max"],
+                                            jnp.where(valid, residual, -big)),
+                "n_seconds": st["n_seconds"] + valid.astype(jnp.int32),
+            }
+            ta = tel.fold_second(
+                ta, level, meter=meter, pv=ac, csi=extras["csi"],
+                residual=residual, covered=extras["covered"], valid=valid,
+            )
+            return ((rc, st), ta), None
+
+        return body
+
+    def _block_step_scan_acc_tel(self, state, inputs, acc):
+        """``_block_step_scan_acc`` with the TelemetryAcc riding the scan
+        carry (plan.telemetry != 'off').  The accumulator is
+        zero-initialised here, inside the jit, so the returned telemetry
+        is this block's pure delta: the sharded wrapper can psum shard
+        contributions without double-counting and the sentinel gets
+        per-block moments.  The in-scan acc is per-chain (elementwise
+        fold; see obs/telemetry.py) and collapses to shard-level scalars
+        once, here, after the scan."""
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    with_extras=True)
+        n = state["carry"]["sec"].shape[0]
+        ta0 = tel.init_acc(self._telemetry, self.dtype, n_chains=n)
+        ((rcarry, acc), ta), _ = jax.lax.scan(
+            self._make_acc_tel_body(step), ((state["carry"], acc), ta0),
+            xs, unroll=self._unroll,
+        )
+        return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
+                tel.reduce_chainwise(ta))
+
+    def _block_step_scan2_acc_tel(self, state, inputs, acc):
+        """``_block_step_scan2_acc`` with the TelemetryAcc riding both
+        scan levels (see ``_block_step_scan_acc_tel``)."""
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    predraw=False,
+                                                    with_extras=True)
+        inner_body = self._make_acc_tel_body(step)
+
+        def inner(carry, xs_inner):
+            return jax.lax.scan(inner_body, carry, xs_inner,
+                                unroll=self._unroll)[0], None
+
+        n = state["carry"]["sec"].shape[0]
+        ta0 = tel.init_acc(self._telemetry, self.dtype, n_chains=n)
+        ((rcarry, acc), ta), _ = self._scan2_outer(
+            state, xs, inner, ((state["carry"], acc), ta0)
+        )
+        return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
+                tel.reduce_chainwise(ta))
+
+    def _wide_telemetry(self, meter, pv, t):
+        """Telemetry fold over the wide impl's materialised block arrays
+        (meter/pv/residual only: the wide producer never materialises
+        csi, which ``tel.summarize`` reports as unobserved)."""
+        ta = tel.init_acc(self._telemetry, self.dtype)
+        return tel.fold_wide(ta, self._telemetry, meter=meter, pv=pv,
+                             t=t, duration_s=self.config.duration_s)
 
     def _scan2_outer(self, state, xs, inner, carry0):
         """The nested ('scan2') outer scan, shared by the reduce and
@@ -950,6 +1060,8 @@ class Simulation:
 
     def step_acc(self, state, inputs, acc):
         """One reduce-mode block folded into the on-device accumulator."""
+        if self._telemetry != "off":
+            return self._step_acc_tel(state, inputs, acc)
         if self._impl == "scan2":
             return self._scan2_acc_jit(state, inputs, acc)
         if self._impl == "scan":
@@ -958,6 +1070,27 @@ class Simulation:
             return self._fused_acc_jit(state, inputs, acc)
         state, meter, pv = self._block_jit(state, inputs)
         acc = self._stats_acc_jit(meter, pv, inputs["block_idx"]["t"], acc)
+        return state, acc
+
+    def _step_acc_tel(self, state, inputs, acc):
+        """Reduce-mode block with in-graph telemetry: the scan impls run
+        their dedicated tel jits; the wide impl runs the split producer
+        plus a telemetry fold over the materialised arrays (the fused
+        topology is bypassed under telemetry — the fold needs the wide
+        arrays anyway, so fusing would buy nothing).  The block's
+        TelemetryAcc lands in ``self._tel_last`` for the per-block host
+        flush (``_observe_telemetry``); the (state, acc) contract of
+        ``step_acc`` is unchanged."""
+        if self._impl == "scan2":
+            state, acc, ta = self._scan2_acc_tel_jit(state, inputs, acc)
+        elif self._impl == "scan":
+            state, acc, ta = self._scan_acc_tel_jit(state, inputs, acc)
+        else:
+            state, meter, pv = self._block_jit(state, inputs)
+            ta = self._wide_tel_jit(meter, pv, inputs["block_idx"]["t"])
+            acc = self._stats_acc_jit(meter, pv, inputs["block_idx"]["t"],
+                                      acc)
+        self._tel_last = ta
         return state, acc
 
     # ------------------------------------------------------------------
@@ -1071,11 +1204,35 @@ class Simulation:
                 # (same semantics as the app-level timers)
                 self.timer.tick()
                 self._m_blocks.inc()
+                # BEFORE on_block: a strict sentinel raise must keep a
+                # poisoned block out of checkpoints/sinks
+                if self._telemetry != "off":
+                    self._observe_telemetry(bi)
                 if on_block is not None:
                     on_block(bi, self.state, acc)
         finally:
             pf.close()
         return {k: self._host_view(v) for k, v in acc.items()}
+
+    def _observe_telemetry(self, bi: int) -> None:
+        """Per-block telemetry flush: fetch the block's ~30 accumulator
+        scalars (piggybacking on the per-block sync reduce mode already
+        pays), publish them into the registry under ``device.*`` and hand
+        the summary to the drift sentinel.  Constructed lazily so an
+        'off' run never imports the sentinel."""
+        if self._tel_last is None:
+            return
+        ta = {k: self._repl_view(v) for k, v in self._tel_last.items()}
+        summary = tel.summarize(ta)
+        tel.publish(self.metrics, summary)
+        if self.sentinel is None:
+            from tmhpvsim_tpu.obs.sentinel import DriftSentinel
+
+            self.sentinel = DriftSentinel(
+                self.config, level=self._telemetry,
+                strict=getattr(self.config, "telemetry_strict", False),
+            )
+        self.sentinel.observe_block(bi, summary)
 
     def _slab_scheduler(self):
         """The SlabScheduler this run should delegate to, or None when
@@ -1177,6 +1334,8 @@ class Simulation:
         summary = self.timer.summary()
         rep.set_timing(summary)
         rep.attach_metrics(self.metrics)
+        if self.sentinel is not None:
+            rep.telemetry = self.sentinel.report()
         rep.headline = headline if headline is not None else {
             "site_seconds_per_s": summary["site_seconds_per_s"],
         }

@@ -26,7 +26,11 @@ from typing import Optional
 
 logger = logging.getLogger(__name__)
 
-REPORT_SCHEMA_VERSION = 1
+#: v1: PR-2 sections.  v2: adds the optional ``telemetry`` section
+#: (drift-sentinel verdict + per-field worst z-scores, obs/sentinel.py).
+#: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
+#: prior-version documents stay loadable (tested).
+REPORT_SCHEMA_VERSION = 2
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -50,6 +54,7 @@ _TOP_SCHEMA = {
     "metrics": (False, _OPT_DICT),
     "profile": (False, _OPT_DICT),
     "processes": (False, (list, type(None))),
+    "telemetry": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -106,10 +111,11 @@ def validate_report(doc) -> dict:
     if doc["kind"] != REPORT_KIND:
         raise ValueError(f"run report kind {doc['kind']!r} != "
                          f"{REPORT_KIND!r}")
-    if doc["schema_version"] != REPORT_SCHEMA_VERSION:
+    if not 1 <= doc["schema_version"] <= REPORT_SCHEMA_VERSION:
         raise ValueError(
-            f"run report schema_version {doc['schema_version']!r} != "
-            f"{REPORT_SCHEMA_VERSION} (this build)"
+            f"run report schema_version {doc['schema_version']!r} outside "
+            f"[1, {REPORT_SCHEMA_VERSION}] (this build); newer documents "
+            "need a newer reader"
         )
     _check_fields(doc["device"], _DEVICE_SCHEMA, "device")
     if isinstance(doc.get("timing"), dict):
@@ -213,6 +219,8 @@ class RunReport:
         self.metrics: Optional[dict] = None
         self.profile: Optional[dict] = None
         self.processes: Optional[list] = None
+        #: drift-sentinel section (obs/sentinel.py DriftSentinel.report())
+        self.telemetry: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -269,6 +277,7 @@ class RunReport:
             "metrics": self.metrics,
             "profile": self.profile,
             "processes": self.processes,
+            "telemetry": self.telemetry,
         }
         return validate_report(out) if validate else out
 

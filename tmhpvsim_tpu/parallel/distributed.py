@@ -103,6 +103,18 @@ def host_gather_ensemble(arr) -> np.ndarray:
     return np.asarray(arr)
 
 
+def psum_telemetry(ta: dict, axis_name: str) -> dict:
+    """Mesh-wide reduction of a per-shard TelemetryAcc (traced, inside
+    shard_map): counters/sums psum, running extrema pmin/pmax — the kind
+    per leaf comes from ``obs.telemetry.leaf_kinds``.  The result is
+    replicated, so the per-block host flush reads any one shard."""
+    from tmhpvsim_tpu.obs.telemetry import leaf_kinds
+
+    coll = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
+    kinds = leaf_kinds(ta)
+    return {k: coll[kinds[k]](v, axis_name) for k, v in ta.items()}
+
+
 def gather_metrics(snapshot: dict) -> list:
     """Every process's metrics snapshot, in process-index order.
 

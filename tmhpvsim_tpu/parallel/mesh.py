@@ -123,6 +123,12 @@ class ShardedSimulation(Simulation):
             self._block_step_scan2_series
         )
         self._series_jit = self._trace_ensemble
+        if self._telemetry != "off":
+            self._scan_acc_tel_jit = self._build_sharded_scan_acc_tel()
+            self._scan2_acc_tel_jit = self._build_sharded_scan_acc_tel(
+                self._block_step_scan2_acc_tel
+            )
+            self._wide_tel_jit = self._build_sharded_wide_tel()
 
     def init_state(self):
         return super().init_state(sharding=chain_sharding(self.mesh))
@@ -186,6 +192,48 @@ class ShardedSimulation(Simulation):
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 2))
+
+    def _build_sharded_scan_acc_tel(self, fn=None):
+        """Telemetry variant of ``_build_sharded_scan_acc``: each shard
+        folds its own TelemetryAcc inside the scan, then the per-block
+        deltas are psum/pmin/pmax-reduced over the mesh — one tiny
+        collective tree of ~30 scalars per block, replicated output so
+        the host flush reads any one shard
+        (parallel/distributed.psum_telemetry)."""
+        from tmhpvsim_tpu.parallel import distributed
+
+        inner = self._block_step_scan_acc_tel if fn is None else fn
+
+        def step(state, inputs, acc):
+            state, acc, ta = inner(state, inputs, acc)
+            return state, acc, distributed.psum_telemetry(ta, CHAIN_AXIS)
+
+        spec_c, spec_r = P(CHAIN_AXIS), P()
+        mapped = shard_map(
+            step, mesh=self.mesh,
+            in_specs=(spec_c, spec_r, spec_c),
+            out_specs=(spec_c, spec_c, spec_r),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 2))
+
+    def _build_sharded_wide_tel(self):
+        """Wide-impl telemetry fold under shard_map: per-shard fold over
+        the materialised meter/pv arrays, mesh-reduced like the scan
+        variant."""
+        from tmhpvsim_tpu.parallel import distributed
+
+        def fold(meter, pv, t):
+            ta = self._wide_telemetry(meter, pv, t)
+            return distributed.psum_telemetry(ta, CHAIN_AXIS)
+
+        mapped = shard_map(
+            fold, mesh=self.mesh,
+            in_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
 
     def _build_sharded_scan_series(self, series_fn=None):
         """Ensemble mode's scan-fused step under shard_map (``series_fn``

@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Benchmark trend table + steady-state regression gate.
+
+Reads the checked-in per-round bench artifacts (``BENCH_r0*.json`` —
+driver wrappers around bench.py's headline JSON) plus any newer
+headline / run_report documents, prints a compile / steady / throughput
+trend table, and exits nonzero when the newest round regressed its
+steady-state block wall (or, when no steady timing is recorded,
+its throughput) by more than ``--max-regress`` percent against the best
+prior round on the SAME platform — the gate ``run_tpu_round5b.sh`` and
+CI hang the bench trajectory on.
+
+Accepted document shapes (the repo's bench history spans all four):
+
+* driver wrapper: ``{"n", "cmd", "rc", "tail", "parsed"}`` — ``parsed``
+  is the headline doc, or None for a failed round (shown as a failed
+  row, never gated on);
+* legacy headline (round 3): top-level ``value`` / ``compile_s`` /
+  ``best_round_wall_s`` / ``timed_blocks``;
+* variant headline (rounds 4+): ``variants`` dict keyed by variant
+  name, ``headline_variant`` naming the winner; steady wall comes from
+  the winner's ``best_round_wall_s`` over ``timed_blocks``, or from the
+  embedded ``run_report.timing`` when present (PR-2 bench docs);
+* a bare obs RunReport document (``kind: tmhpvsim_tpu.run_report``).
+
+No third-party imports: runs anywhere the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+
+def _steady_from_headline(doc: dict) -> float | None:
+    """Steady block wall [s] of a headline doc, best effort."""
+    rep = doc.get("run_report")
+    if isinstance(rep, dict):
+        timing = rep.get("timing") or {}
+        if timing.get("steady_block_s") is not None:
+            return float(timing["steady_block_s"])
+    timed_blocks = doc.get("timed_blocks")
+    variants = doc.get("variants")
+    if isinstance(variants, dict) and variants:
+        best = variants.get(doc.get("headline_variant"))
+        if not isinstance(best, dict):
+            rated = [v for v in variants.values()
+                     if isinstance(v, dict) and "rate" in v]
+            best = max(rated, key=lambda v: v["rate"]) if rated else None
+        if isinstance(best, dict) and \
+                best.get("best_round_wall_s") is not None and timed_blocks:
+            return float(best["best_round_wall_s"]) / float(timed_blocks)
+    if doc.get("best_round_wall_s") is not None and timed_blocks:
+        return float(doc["best_round_wall_s"]) / float(timed_blocks)
+    return None
+
+
+def _compile_from_headline(doc: dict) -> float | None:
+    variants = doc.get("variants")
+    if isinstance(variants, dict):
+        best = variants.get(doc.get("headline_variant"))
+        if isinstance(best, dict) and best.get("compile_s") is not None:
+            return float(best["compile_s"])
+    if doc.get("compile_s") is not None:
+        return float(doc["compile_s"])
+    rep = doc.get("run_report")
+    if isinstance(rep, dict):
+        timing = rep.get("timing") or {}
+        if timing.get("compile_s") is not None:
+            return float(timing["compile_s"])
+    return None
+
+
+def normalize(path: str) -> dict:
+    """One artifact -> a trend row (``failed`` rows carry only a name)."""
+    name = os.path.basename(path)
+    row = {"name": name, "order": name, "platform": None, "value": None,
+           "compile_s": None, "steady_block_s": None, "failed": True}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        row["note"] = f"unreadable: {e}"
+        return row
+    if not isinstance(doc, dict):
+        row["note"] = "not a JSON object"
+        return row
+
+    if "parsed" in doc and "cmd" in doc:          # driver wrapper
+        if doc.get("n") is not None:
+            row["order"] = f"{int(doc['n']):06d}"
+            row["name"] = f"r{int(doc['n']):02d}"
+        if doc.get("parsed") is None:
+            row["note"] = f"round failed (rc={doc.get('rc')})"
+            return row
+        doc = doc["parsed"]
+
+    if doc.get("kind") == REPORT_KIND:            # bare RunReport
+        timing = doc.get("timing") or {}
+        headline = doc.get("headline") or {}
+        row.update(
+            failed=False,
+            platform=(doc.get("device") or {}).get("platform"),
+            value=headline.get("site_seconds_per_s"),
+            compile_s=timing.get("compile_s"),
+            steady_block_s=timing.get("steady_block_s"),
+        )
+        return row
+
+    if "value" in doc or "variants" in doc:       # headline doc
+        row.update(
+            failed=False,
+            platform=doc.get("platform"),
+            value=doc.get("value"),
+            compile_s=_compile_from_headline(doc),
+            steady_block_s=_steady_from_headline(doc),
+        )
+        return row
+
+    row["note"] = "unrecognised document shape"
+    return row
+
+
+def _fmt(v, unit="") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and (abs(v) >= 1e5 or 0 < abs(v) < 1e-3):
+        return f"{v:.3g}{unit}"
+    return f"{v:.3f}{unit}" if isinstance(v, float) else f"{v}{unit}"
+
+
+def print_table(rows: list) -> None:
+    cols = ("round", "platform", "site-s/s/chip", "compile_s",
+            "steady_block_s", "note")
+    table = [cols]
+    for r in rows:
+        table.append((
+            r["name"], r["platform"] or "-", _fmt(r["value"]),
+            _fmt(r["compile_s"]), _fmt(r["steady_block_s"]),
+            r.get("note", ""),
+        ))
+    widths = [max(len(str(line[i])) for line in table)
+              for i in range(len(cols))]
+    for i, line in enumerate(table):
+        print("  ".join(str(c).ljust(w) for c, w in zip(line, widths))
+              .rstrip())
+        if i == 0:
+            print("  ".join("-" * w for w in widths))
+
+
+def check_regression(rows: list, max_regress_pct: float):
+    """(ok, message): newest valid round vs the best prior same-platform
+    round — steady block wall when both recorded one, throughput
+    otherwise."""
+    valid = [r for r in rows if not r["failed"]]
+    if len(valid) < 2:
+        return True, "no prior round to compare against; gate passes"
+    newest = valid[-1]
+    prior = [r for r in valid[:-1] if r["platform"] == newest["platform"]]
+    if not prior:
+        return True, (f"no prior round on platform "
+                      f"{newest['platform']!r}; gate passes")
+    tol = max_regress_pct / 100.0
+    steady_prior = [r for r in prior if r["steady_block_s"] is not None]
+    if newest["steady_block_s"] is not None and steady_prior:
+        best = min(steady_prior, key=lambda r: r["steady_block_s"])
+        limit = best["steady_block_s"] * (1.0 + tol)
+        if newest["steady_block_s"] > limit:
+            return False, (
+                f"STEADY-STATE REGRESSION: {newest['name']} "
+                f"steady_block_s={newest['steady_block_s']:.4g} vs best "
+                f"prior {best['name']}={best['steady_block_s']:.4g} "
+                f"(+{(newest['steady_block_s'] / best['steady_block_s'] - 1) * 100:.1f}% "
+                f"> {max_regress_pct:g}% allowed)"
+            )
+        return True, (
+            f"steady gate ok: {newest['name']} "
+            f"steady_block_s={newest['steady_block_s']:.4g} within "
+            f"{max_regress_pct:g}% of best prior "
+            f"{best['name']}={best['steady_block_s']:.4g}"
+        )
+    value_prior = [r for r in prior if r["value"] is not None]
+    if newest["value"] is not None and value_prior:
+        best = max(value_prior, key=lambda r: r["value"])
+        limit = best["value"] * (1.0 - tol)
+        if newest["value"] < limit:
+            return False, (
+                f"THROUGHPUT REGRESSION: {newest['name']} "
+                f"value={newest['value']:.4g} vs best prior "
+                f"{best['name']}={best['value']:.4g} "
+                f"(-{(1 - newest['value'] / best['value']) * 100:.1f}% "
+                f"> {max_regress_pct:g}% allowed)"
+            )
+        return True, (
+            f"throughput gate ok: {newest['name']} "
+            f"value={newest['value']:.4g} within {max_regress_pct:g}% of "
+            f"best prior {best['name']}={best['value']:.4g}"
+        )
+    return True, "newest round records no comparable metric; gate passes"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench trend table + steady-state regression gate")
+    ap.add_argument("files", nargs="*",
+                    help="bench artifacts in round order (default: "
+                         "BENCH_r*.json in the repo root, sorted)")
+    ap.add_argument("--max-regress", type=float, default=10.0,
+                    metavar="PCT",
+                    help="allowed steady-state (or throughput) regression "
+                         "of the newest round vs the best prior "
+                         "same-platform round [%%] (default 10)")
+    args = ap.parse_args(argv)
+
+    files = args.files
+    if not files:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not files:
+        print("bench_trend: no bench artifacts found", file=sys.stderr)
+        return 0
+
+    rows = [normalize(p) for p in files]
+    rows.sort(key=lambda r: r["order"])
+    print_table(rows)
+    ok, msg = check_regression(rows, args.max_regress)
+    print(msg)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
