@@ -455,8 +455,12 @@ def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
     ``on_progress()`` is called after every attempt — landed OR errored —
     so the hang watchdog can distinguish a slow-but-erroring tunnel
     (progress: let the step-down retries run) from a wedged one."""
-    from tmhpvsim_tpu.engine import Simulation
+    import contextlib
 
+    from tmhpvsim_tpu.engine import Simulation
+    from tmhpvsim_tpu.obs.trace import get_tracer
+
+    tracer = get_tracer()
     n_total = n_blocks * n_rounds + 1
     variants = {} if variants is None else variants
     sims = {}
@@ -471,8 +475,15 @@ def _run_variants(n_chains: int, n_blocks: int, n_rounds: int,
         nb, nr = (1, 1) if probe else (n_blocks, n_rounds)
         try:
             prev_best = _best_rate()
-            sim = Simulation(_make_cfg(n_chains, nb * nr + 1, **kw))
-            c_s, dt, rate = _timed_reduce_run(sim, nb, nr)
+            # the span brackets construct+compile+timed rounds: if the
+            # tunnel wedges, the flight dump shows WHICH variant hung
+            # (the open span never closes; the previous ones did)
+            span = (tracer.span(f"variant:{name}", "bench",
+                                n_chains=n_chains)
+                    if tracer else contextlib.nullcontext())
+            with span:
+                sim = Simulation(_make_cfg(n_chains, nb * nr + 1, **kw))
+                c_s, dt, rate = _timed_reduce_run(sim, nb, nr)
             # compare/store the SAME rounded value everywhere: headline()
             # picks best_name by the stored rate, and a raw-vs-rounded
             # mismatch here could retain a sim whose name the pick
@@ -546,9 +557,46 @@ def _salvage_cpu_headline(tpu_errors=None, timeout_s: float = 900.0) -> bool:
     return True
 
 
+#: where the watchdog's flight-recorder slice lands (same directory the
+#: battery script collects artifacts from)
+FLIGHT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "flight_watchdog.json")
+
+
+def _dump_flight_recorder(reason: str, path: str = FLIGHT_PATH) -> bool:
+    """Dump the process tracer's last-30-s window before a hard exit.
+
+    The rc=3 salvage paths end in ``os._exit`` — no unwinding, no atexit
+    — so this is the only record of what the harness was doing when the
+    tunnel wedged.  Best-effort by design: a broken dump must never
+    pre-empt the salvage output itself."""
+    try:
+        from tmhpvsim_tpu.obs.trace import get_tracer
+
+        tracer = get_tracer()
+        if not tracer or not len(tracer):
+            return False
+        tracer.dump_flight(path)
+        print(f"# flight recorder ({reason}): last-30-s trace in {path}",
+              file=sys.stderr)
+        return True
+    except Exception as e:
+        print(f"# flight recorder dump failed: {e}", file=sys.stderr)
+        return False
+
+
 def headline() -> None:
     platform, fallback = _probe_or_fallback()
     import jax
+
+    # per-variant spans land in the process tracer so a wedged-tunnel
+    # watchdog exit can dump what was in flight (see _dump_flight_recorder)
+    try:
+        from tmhpvsim_tpu.obs.trace import Tracer, set_tracer
+
+        set_tracer(Tracer())
+    except Exception as e:
+        print(f"# tracer init failed: {e}", file=sys.stderr)
 
     shared_variants: dict = {}
     monitor_state = {"last_progress": time.monotonic(),
@@ -569,6 +617,9 @@ def headline() -> None:
         import threading
 
         def _wedged():
+            # first thing, before any salvage that could itself hang: the
+            # flight recorder is the wedge's only post-mortem evidence
+            _dump_flight_recorder("TPU variants phase exceeded deadline")
             # snapshot first: the main thread mutates this dict
             snap = dict(shared_variants)
             # probe entries don't count as landed (same rule as _ok_full:

@@ -168,5 +168,14 @@ run_json benchmarks/BENCH_config3.json  config3  --config 3
 echo "--- bench_trend start $(date -u +%FT%TZ)" >> "$LOG"
 python tools/bench_trend.py >> "$LOG" 2>&1 \
   || echo "--- bench_trend: REGRESSION OR ERROR rc=$?" >> "$LOG"
+# trace sanity (non-fatal): any flight-recorder dump a wedged phase left
+# behind (bench.py rc=3 salvage) must be loadable Chrome-trace JSON —
+# an invalid dump is itself evidence of a tracer bug worth the log line
+for trace_file in benchmarks/flight_watchdog.json benchmarks/*.trace.json; do
+  [ -f "$trace_file" ] || continue
+  echo "--- trace_stats $trace_file $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/trace_stats.py "$trace_file" >> "$LOG" 2>&1 \
+    || echo "--- trace_stats: INVALID TRACE $trace_file rc=$?" >> "$LOG"
+done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

@@ -21,9 +21,10 @@ from tmhpvsim_tpu.runtime import broker as broker_mod
 
 
 class FakeMessage:
-    def __init__(self, body, timestamp=None):
+    def __init__(self, body, timestamp=None, headers=None):
         self.body = body
         self.timestamp = timestamp
+        self.headers = headers
         self.processed = False
 
     def process(self):
@@ -245,6 +246,35 @@ def test_posix_timestamp_coerced_to_datetime(fake_aio_pika):
 
     _run(scenario())
     assert got == [(t0, 42.0)]
+
+
+def test_meta_rides_amqp_headers(fake_aio_pika):
+    """metersim's seq/pub_us stamps travel in AMQP *headers*, never the
+    body: the body stays a bare JSON float for reference consumers, and
+    subscribe(with_meta=True) surfaces the headers (or None)."""
+    mod, log = fake_aio_pika
+    t0 = dt.datetime(2019, 9, 5, 12, 0, 0)
+    got = []
+
+    async def scenario():
+        async with broker_mod.AmqpTransport("amqp://host/", "meter") as pub:
+            async with broker_mod.AmqpTransport("amqp://host/",
+                                                "meter") as sub:
+                async def consume():
+                    async for item in sub.subscribe(with_meta=True):
+                        got.append(item)
+                        if len(got) == 2:
+                            return
+
+                task = asyncio.ensure_future(consume())
+                await asyncio.sleep(0)
+                await pub.publish(100.0, t0, meta={"seq": 0, "pub_us": 5})
+                await pub.publish(200.5, t0)
+                await asyncio.wait_for(task, timeout=5)
+
+    _run(scenario())
+    assert got[0] == (t0, 100.0, {"seq": 0, "pub_us": 5})
+    assert got[1] == (t0, 200.5, None)
 
 
 def test_apps_join_over_fake_amqp(fake_aio_pika, tmp_path):

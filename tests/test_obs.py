@@ -450,17 +450,31 @@ class TestCliSmoke:
         assert lines[-1]["metrics"]["counters"]["engine.blocks_total"] >= 1
         assert sum(1 for _ in open(out)) == 181  # header + 180 rows
 
-    def test_cli_rejects_metrics_on_asyncio_backend(self, tmp_path):
+    def test_cli_asyncio_backend_emits_observability(self, tmp_path):
+        """The streaming (asyncio) backend accepts --metrics/--run-report
+        too (it used to reject them): a bounded run with no producer
+        still flushes metric snapshots and a schema-valid report whose
+        app is the streaming consumer."""
         from click.testing import CliRunner
 
         from tmhpvsim_tpu.cli import pvsim
 
-        r = CliRunner().invoke(pvsim, [
-            str(tmp_path / "o.csv"), "--metrics",
-            str(tmp_path / "m.jsonl"),
-        ])
-        assert r.exit_code != 0
-        assert "--backend=jax" in r.output
+        m_path = str(tmp_path / "m.jsonl")
+        r_path = str(tmp_path / "r.json")
+        with use_registry(MetricsRegistry()):  # isolate rows_written == 0
+            r = CliRunner().invoke(pvsim, [
+                str(tmp_path / "o.csv"), "--no-realtime", "--seed", "1",
+                "--duration", "2", "--amqp-url", "local://obs-cli",
+                "--start", "2019-09-05 10:00:00",
+                "--metrics", m_path, "--run-report", r_path,
+            ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        doc = validate_report(json.load(open(r_path)))
+        assert doc["app"] == "pvsim.stream"
+        assert doc["streaming"] is not None
+        assert doc["streaming"]["rows_written"] == 0  # no producer ran
+        lines = [json.loads(ln) for ln in open(m_path)]
+        assert lines and lines[-1]["event"] == "end"
 
 
 # ---------------------------------------------------------------------------

@@ -370,10 +370,18 @@ class TestReportSchema:
             sim.run_reduced()
             return sim.run_report()
 
-    def test_v2_round_trips_through_validator(self):
+    def test_current_schema_round_trips_through_validator(self):
         doc = self._doc()
-        assert doc["schema_version"] == 2
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION
         validate_report(json.loads(json.dumps(doc)))
+
+    def test_v2_documents_still_validate(self):
+        """PR-3 builds wrote v2 docs (telemetry, no streaming section);
+        the v3 validator must keep accepting them."""
+        doc = self._doc()
+        doc["schema_version"] = 2
+        doc.pop("streaming", None)
+        validate_report(doc)
 
     def test_v1_documents_still_validate(self):
         """PR-2 readers wrote v1 docs without a telemetry section; this

@@ -11,13 +11,16 @@ warned about (metersim.py:76-77).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import datetime as _dt
 import logging
+import time as _time
 from typing import Optional
 
 import numpy as np
 
 from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs.trace import Tracer
 from tmhpvsim_tpu.runtime import asyncretry, fixedclock, forever
 from tmhpvsim_tpu.runtime.broker import make_transport
 
@@ -98,7 +101,8 @@ async def read_meter_values_jax(queue: asyncio.Queue, realtime: bool,
         sec += 1
 
 
-async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
+async def send_queue_to_transport(queue: asyncio.Queue, url, exchange,
+                                  tracer: Optional[Tracer] = None) -> None:
     """Publisher loop with forever-retry (metersim.py:13-47).
 
     A value dequeued when publish fails is held across the reconnect and
@@ -106,21 +110,37 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
     ``asyncio.shield``, metersim.py:43-45) — and ``task_done`` always
     matches its ``get``, so a bounded run's ``queue.join()`` cannot hang on
     a failed publish.
+
+    Every payload is additively stamped with a ``seq`` and the
+    publisher's monotonic publish time (``pub_us``, µs) so an
+    instrumented consumer can measure publish→join latency and spot
+    gaps; the stamp rides out-of-band of the JSON float body
+    (runtime/broker.py), so reference consumers are unaffected.  The
+    held-across-reconnect value keeps its seq but is re-stamped with the
+    actual (re)publish time.
     """
     pending = None
+    seq = 0
     m_pub = obs_metrics.get_registry().counter(
         "metersim.values_published_total"
     )
 
     @asyncretry(delay=5, attempts=forever)
     async def run():
-        nonlocal pending
+        nonlocal pending, seq
         async with make_transport(url, exchange) as transport:
             while True:
                 if pending is None:
-                    pending = await queue.get()
-                time, value = pending
-                await transport.publish(value, time)
+                    time, value = await queue.get()
+                    pending = (seq, time, value)
+                    seq += 1
+                n, time, value = pending
+                meta = {"seq": n, "pub_us": _time.monotonic_ns() // 1000}
+                if tracer:
+                    with tracer.span("publish", "broker", seq=n):
+                        await transport.publish(value, time, meta=meta)
+                else:
+                    await transport.publish(value, time, meta=meta)
                 m_pub.inc()
                 pending = None
                 queue.task_done()
@@ -130,10 +150,17 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange) -> None:
 
 async def metersim_main(amqp_url, exchange, realtime, seed=None,
                         duration_s=None, start=None,
-                        backend: str = "asyncio") -> None:
+                        backend: str = "asyncio",
+                        trace: Optional[str] = None) -> None:
     """App orchestrator (metersim.py:64-77): producer + publisher tasks.
     ``backend='jax'`` swaps the per-second numpy producer for the
-    device-batched one; the transport/publisher side is identical."""
+    device-batched one; the transport/publisher side is identical.
+
+    ``trace`` names a Chrome-trace JSON (obs/trace.py): publish spans
+    land in the ring, the full ring is exported there on exit, and an
+    unhandled exception dumps the last-30-s flight slice to
+    ``trace + '.crash.json'`` before re-raising."""
+    tracer = Tracer() if trace else None
     queue: asyncio.Queue = asyncio.Queue()
     if backend == "jax":
         read = asyncio.create_task(
@@ -145,7 +172,7 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
             read_meter_values(queue, realtime, rng, duration_s, start)
         )
     send = asyncio.create_task(send_queue_to_transport(queue, amqp_url,
-                                                       exchange))
+                                                       exchange, tracer))
     try:
         done, _ = await asyncio.wait(
             {read, send}, return_when=asyncio.FIRST_COMPLETED
@@ -154,6 +181,13 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
             t.result()
         # bounded run: wait for the queue to drain before stopping the sender
         await queue.join()
+    except asyncio.CancelledError:
+        raise
+    except BaseException:
+        if tracer:
+            with contextlib.suppress(Exception):
+                tracer.dump_flight(trace + ".crash.json")
+        raise
     finally:
         for t in (read, send):
             t.cancel()
@@ -161,3 +195,6 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
             logger.warning(
                 "%d sampled meter_values have not been sent", queue.qsize()
             )
+        if tracer:
+            with contextlib.suppress(Exception):
+                tracer.export(trace, process_name="metersim")

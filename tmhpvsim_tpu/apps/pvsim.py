@@ -15,14 +15,18 @@ comes out orders of magnitude faster (SURVEY.md §2.4).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import datetime as _dt
 import logging
+import time as _time
 from collections import namedtuple
 from typing import Optional
 
 import numpy as np
 
 from tmhpvsim_tpu.config import ModelOptions, Site
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs.trace import Tracer
 from tmhpvsim_tpu.runtime import SynchronizingFunnel, asyncretry, fixedclock, \
     forever
 from tmhpvsim_tpu.runtime.broker import make_transport
@@ -33,9 +37,81 @@ logger = logging.getLogger(__name__)
 Data = namedtuple("Data", ["meter", "pv"])
 
 
+class _StreamStats:
+    """Per-message latency accounting for the streaming backend.
+
+    ``publish→join`` uses the publisher's monotonic stamp (``pub_us`` in
+    the message meta, metersim.py): meaningful when producer and
+    consumer share a process (the local:// deployment and every e2e
+    test); across hosts the clocks are unrelated and the value is
+    clamped at 0 — the join→csv leg and the funnel counters stay exact
+    everywhere.  Both pending maps are bounded so evicted/never-joined
+    timestamps cannot leak memory on an unbounded run.
+    """
+
+    _MAX_PENDING = 20_000
+
+    def __init__(self, registry):
+        self.h_pub_join = registry.histogram("streaming.publish_to_join_s")
+        self.h_join_csv = registry.histogram("streaming.join_to_csv_s")
+        self.c_rows = registry.counter("pvsim.rows_written_total")
+        self._pub_us: dict = {}
+        self._join_ns: dict = {}
+
+    @staticmethod
+    def _cap(d: dict, cap: int) -> None:
+        while len(d) >= cap:
+            d.pop(next(iter(d)))  # insertion order ~ oldest timestamp
+
+    def on_consume(self, t, meta: Optional[dict]) -> None:
+        if meta and isinstance(meta.get("pub_us"), (int, float)):
+            self._cap(self._pub_us, self._MAX_PENDING)
+            self._pub_us[t] = meta["pub_us"]
+
+    def on_join(self, t) -> None:
+        now_ns = _time.monotonic_ns()
+        pub = self._pub_us.pop(t, None)
+        if pub is not None:
+            self.h_pub_join.observe(max(0.0, now_ns / 1e3 - pub) / 1e6)
+        self._cap(self._join_ns, self._MAX_PENDING)
+        self._join_ns[t] = now_ns
+
+    def on_row(self, t) -> None:
+        j = self._join_ns.pop(t, None)
+        if j is not None:
+            self.h_join_csv.observe(
+                max(0.0, (_time.monotonic_ns() - j) / 1e9))
+        self.c_rows.inc()
+
+
+class _JoinFront:
+    """Queue facade handed to the funnel in place of the raw output
+    queue: the funnel awaits ``put`` on completed records only, so this
+    is exactly the join-complete instant — stamp it (latency + trace
+    event) and forward.  The writer keeps consuming the real queue."""
+
+    __slots__ = ("_queue", "_stream", "_tracer")
+
+    def __init__(self, queue: asyncio.Queue,
+                 stream: Optional[_StreamStats] = None,
+                 tracer: Optional[Tracer] = None):
+        self._queue = queue
+        self._stream = stream
+        self._tracer = tracer
+
+    async def put(self, item) -> None:
+        t, _rec = item
+        if self._stream is not None:
+            self._stream.on_join(t)
+        if self._tracer:
+            self._tracer.instant("join", "funnel", t=str(t))
+        await self._queue.put(item)
+
+
 async def read_pv_values(funnel: SynchronizingFunnel, realtime: bool,
                          seed=None, duration_s=None,
-                         start: Optional[_dt.datetime] = None) -> None:
+                         start: Optional[_dt.datetime] = None,
+                         tracer: Optional[Tracer] = None) -> None:
     """1 Hz PV loop feeding the funnel (pvsim.py:21-41)."""
     from tmhpvsim_tpu.engine.golden import GoldenPVModel
 
@@ -47,20 +123,38 @@ async def read_pv_values(funnel: SynchronizingFunnel, realtime: bool,
     async for time in fixedclock(rate=1, realtime=realtime, start=start,
                                  duration_s=duration_s):
         time = time.replace(microsecond=0)
-        await funnel.put(time, pv=model.next(time))
+        value = model.next(time)
+        if tracer:
+            # the span includes any backpressure wait inside put — that
+            # wait IS the interesting part of a stalled-join timeline
+            with tracer.span("funnel.put", "pv"):
+                await funnel.put(time, pv=value)
+        else:
+            await funnel.put(time, pv=value)
 
 
 async def read_transport(funnel: SynchronizingFunnel, url, exchange,
-                         counter: Optional[dict] = None) -> None:
+                         counter: Optional[dict] = None,
+                         stream: Optional[_StreamStats] = None,
+                         tracer: Optional[Tracer] = None) -> None:
     """Meter consumer with forever-retry (pvsim.py:43-70)."""
 
     @asyncretry(delay=5, attempts=forever)
     async def run():
         async with make_transport(url, exchange) as transport:
-            async for time, value in transport.subscribe():
+            async for time, value, meta in transport.subscribe(
+                    with_meta=True):
                 if counter is not None:
                     counter["meter"] = counter.get("meter", 0) + 1
-                await funnel.put(time, meter=value)
+                if stream is not None:
+                    stream.on_consume(time, meta)
+                if tracer:
+                    tracer.instant("consume", "stream",
+                                   seq=(meta or {}).get("seq"))
+                    with tracer.span("funnel.put", "stream"):
+                        await funnel.put(time, meter=value)
+                else:
+                    await funnel.put(time, meter=value)
 
     await run()
 
@@ -83,7 +177,9 @@ async def _no_meter_watchdog(counter: dict, url, timeout_s: float = 10.0):
         )
 
 
-async def write_file(filename: str, queue: asyncio.Queue) -> None:
+async def write_file(filename: str, queue: asyncio.Queue,
+                     stream: Optional[_StreamStats] = None,
+                     tracer: Optional[Tracer] = None) -> None:
     """CSV sink, line-buffered for tail-ability (pvsim.py:72-84)."""
     import csv
 
@@ -92,29 +188,64 @@ async def write_file(filename: str, queue: asyncio.Queue) -> None:
         writer.writerow(["time"] + list(Data._fields) + ["residual load"])
         while True:
             time, data = await queue.get()
-            writer.writerow([time] + list(data) + [data.meter - data.pv])
+            row = [time] + list(data) + [data.meter - data.pv]
+            if tracer:
+                with tracer.span("csv.write", "csv"):
+                    writer.writerow(row)
+            else:
+                writer.writerow(row)
+            if stream is not None:
+                stream.on_row(time)
             queue.task_done()
 
 
 async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
-                     duration_s=None, start=None) -> None:
-    """App orchestrator (pvsim.py:86-101)."""
+                     duration_s=None, start=None,
+                     trace: Optional[str] = None,
+                     metrics_path: Optional[str] = None,
+                     run_report_path: Optional[str] = None) -> None:
+    """App orchestrator (pvsim.py:86-101).
+
+    Streaming observability (obs/): ``trace`` records the consume →
+    funnel-put → join → csv-write timeline into a ring and exports it as
+    Chrome-trace JSON on exit (crash dumps land at
+    ``trace + '.crash.json'``); ``metrics_path`` attaches a sink to the
+    process-default registry; ``run_report_path`` writes a RunReport
+    whose ``streaming`` section carries the publish→join / join→csv
+    latency quantiles and funnel/retry/broker counters.  The tracer is
+    a local instance (not the process default) so two app mains sharing
+    one process — the e2e tests — cannot race on a global swap."""
+    reg = obs_metrics.get_registry()
+    sink = None
+    if metrics_path:
+        sink = obs_metrics.make_sink(metrics_path)
+        reg.add_sink(sink)
+    tracer = Tracer() if trace else None
+    # per-record latency accounting only when some observability output
+    # was asked for: with none of --trace/--metrics/--run-report the
+    # funnel keeps the RAW queue and the hot path pays exactly one
+    # `if tracer:` truth test per record (the ≤1% disabled-cost gate,
+    # tests/test_trace.py)
+    stream = (_StreamStats(reg)
+              if (trace or metrics_path or run_report_path) else None)
     queue: asyncio.Queue = asyncio.Queue()
+    front = (_JoinFront(queue, stream, tracer)
+             if (stream is not None or tracer) else queue)
     # 60 s lookahead: under --no-realtime the local pv loop free-runs; the
     # funnel blocks it from racing ahead of the broker-paced meter stream,
     # which would otherwise evict every pv-only record before its meter
     # value arrives (join starvation; see runtime/funnel.py)
     funnel = SynchronizingFunnel(
-        Data, queue, max_lookahead=_dt.timedelta(seconds=60)
+        Data, front, max_lookahead=_dt.timedelta(seconds=60)
     )
     counter: dict = {}
     watchdog = asyncio.create_task(_no_meter_watchdog(counter, amqp_url))
     tasks = [
         asyncio.create_task(read_pv_values(funnel, realtime, seed,
-                                           duration_s, start)),
+                                           duration_s, start, tracer)),
         asyncio.create_task(read_transport(funnel, amqp_url, exchange,
-                                           counter)),
-        asyncio.create_task(write_file(file, queue)),
+                                           counter, stream, tracer)),
+        asyncio.create_task(write_file(file, queue, stream, tracer)),
     ]
     try:
         done, _ = await asyncio.wait(tasks,
@@ -122,6 +253,15 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
         for t in done:
             t.result()
         await queue.join()
+    except asyncio.CancelledError:
+        raise  # orderly shutdown: no crash artifact
+    except BaseException:
+        if tracer:
+            # the flight recorder's whole point: the last 30 s of
+            # timeline survive an unhandled exception
+            with contextlib.suppress(Exception):
+                tracer.dump_flight(trace + ".crash.json")
+        raise
     finally:
         for t in tasks:
             t.cancel()
@@ -130,6 +270,23 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
             logger.warning(
                 "%d undelivered meter_values have been lost", len(funnel)
             )
+        if tracer:
+            with contextlib.suppress(Exception):
+                tracer.export(trace, process_name="pvsim")
+        if run_report_path:
+            try:
+                from tmhpvsim_tpu.obs.report import RunReport
+
+                rep = RunReport("pvsim.stream")
+                rep.attach_metrics(reg)
+                rep.write(run_report_path)
+            except Exception as e:  # must not mask the run's own outcome
+                logger.warning("run report write failed: %s", e)
+        if sink is not None:
+            reg.flush(event="end")
+            reg.remove_sink(sink)
+            with contextlib.suppress(Exception):
+                sink.close()
 
 
 def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
@@ -147,7 +304,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               telemetry: str = "off",
               telemetry_strict: bool = False,
               metrics_path: Optional[str] = None,
-              run_report_path: Optional[str] = None) -> None:
+              run_report_path: Optional[str] = None,
+              trace: Optional[str] = None) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -181,6 +339,13 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     (obs/telemetry.py) and runs the drift sentinel per block;
     ``telemetry_strict`` escalates sentinel WARNs to DriftError.  The
     sentinel's verdict lands in the report's ``telemetry`` section.
+
+    ``trace`` records host-side per-block instants into the streaming
+    tracer's ring (obs/trace.py) and exports Chrome-trace JSON there on
+    exit; the pid is the real os.getpid(), so a jax.profiler device
+    trace from ``profile_dir`` merges next to it in Perfetto as a
+    separate process row.  A crashing run dumps the last-30-s flight
+    slice to ``trace + '.crash.json'`` first.
     """
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import read_manifest
@@ -189,6 +354,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     registry = obs_metrics.MetricsRegistry()
     if metrics_path:
         registry.add_sink(obs_metrics.make_sink(metrics_path))
+    tracer = Tracer() if trace else None
     # the Simulation binds the process-default registry at construction,
     # so the per-run registry must be installed around the whole run
     with obs_metrics.use_registry(registry):
@@ -200,10 +366,19 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 profile_dir=profile_dir, output=output,
                 prng_impl=prng_impl, block_impl=block_impl, tune=tune,
                 telemetry=telemetry, telemetry_strict=telemetry_strict,
+                trace=trace, tracer=tracer,
             )
+        except (Exception, KeyboardInterrupt):
+            if tracer:
+                with contextlib.suppress(Exception):
+                    tracer.dump_flight(trace + ".crash.json")
+            raise
         finally:
             registry.flush(event="end")
             registry.close()
+            if tracer:
+                with contextlib.suppress(Exception):
+                    tracer.export(trace, process_name="pvsim")
     if not run_report_path:
         return
     import jax
@@ -240,7 +415,9 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    block_impl: str = "auto",
                    tune: str = "off",
                    telemetry: str = "off",
-                   telemetry_strict: bool = False):
+                   telemetry_strict: bool = False,
+                   trace: Optional[str] = None,
+                   tracer: Optional[Tracer] = None):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
     the wrapper can assemble the run report from its config/plan/timer."""
     import contextlib
@@ -298,6 +475,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         tune=tune,
         telemetry=telemetry,
         telemetry_strict=telemetry_strict,
+        trace=trace,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation
@@ -334,7 +512,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             state, acc = tree["state"], tree["acc"]
             logger.info("resuming reduce run from %s at block %d",
                         checkpoint, start_block)
-        trace = device_trace(profile_dir) if profile_dir else \
+        dtrace = device_trace(profile_dir) if profile_dir else \
             contextlib.nullcontext()
         # under a slabbing plan each on_block tick covers one slab-sized
         # block (engine/slab.py), not the full chain batch
@@ -344,6 +522,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
 
         def on_block(bi, state, acc):
             timer.tick()
+            if tracer:
+                tracer.instant("block", "engine", block=bi)
             reg.flush(event="block")
             if checkpoint:
                 # host_local_tree: on a pod slice each host saves only its
@@ -352,7 +532,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                           sim.host_local_tree({"state": state, "acc": acc}),
                           bi + 1, cfg)
 
-        with trace:
+        with dtrace:
             reduced = sim.run_reduced(state=state, acc=acc,
                                       start_block=start_block,
                                       on_block=on_block)
@@ -428,6 +608,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             start=start_block,
         ):
             timer.tick()
+            if tracer:
+                tracer.instant("block", "engine", block=bi)
             reg.flush(event="block")
             if realtime:
                 yield from _paced(blk)
@@ -442,9 +624,9 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
 
     tzname = (cfg.site_grid.timezone if cfg.site_grid is not None
               else cfg.site.timezone)
-    trace = device_trace(profile_dir) if profile_dir else \
+    dtrace = device_trace(profile_dir) if profile_dir else \
         contextlib.nullcontext()
-    with trace:
+    with dtrace:
         if write_trace:
             write_csv(file, blocks(), chain=chain, tz=ZoneInfo(tzname),
                       append=start_block > 0)

@@ -51,6 +51,11 @@ def _common_options(f):
     f = click.option("--start", default=None,
                      help="Simulation start time 'YYYY-MM-DD HH:MM:SS' "
                           "(default: now)")(f)
+    f = click.option("--trace", "trace", default=None,
+                     help="Record a streaming event timeline and export "
+                          "Chrome-trace JSON here on exit (open in "
+                          "Perfetto / chrome://tracing); crashes dump the "
+                          "last 30 s to PATH.crash.json (obs/trace.py)")(f)
     return f
 
 
@@ -118,13 +123,14 @@ def fanoutbroker(host, port, verbose):
               help="asyncio: per-second numpy sampling (reference); jax: "
                    "device-batched blocks feeding the same publisher")
 def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
-             backend):
+             trace, backend):
     """1 Hz electricity-demand producer (reference metersim.py:79-95)."""
     from tmhpvsim_tpu.apps.metersim import metersim_main
 
     _setup_logging(verbose)
     asyncrun(metersim_main(amqp_url, exchange, realtime, seed, duration_s,
-                           _parse_start(start), backend=backend))
+                           _parse_start(start), backend=backend,
+                           trace=trace))
 
 
 @click.command()
@@ -197,23 +203,23 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
               help="escalate drift-sentinel WARNs (NaN/Inf, reference "
                    "band escape) to a hard error")
 @click.option("--metrics", "metrics_path", default=None,
-              help="Stream per-block metric snapshots to this file: .prom "
-                   "= Prometheus text exposition (atomic rewrite), "
-                   "anything else = JSONL append (jax backend; obs/)")
+              help="Stream metric snapshots to this file: .prom = "
+                   "Prometheus text exposition (atomic rewrite), anything "
+                   "else = JSONL append — per block on the jax backend, "
+                   "at end of run on asyncio (obs/)")
 @click.option("--run-report", "run_report_path", default=None,
-              help="Write the schema-versioned RunReport JSON (config, "
-                   "resolved plan, device, compile/steady timing, "
-                   "headline rate) here after the run (jax backend)")
+              help="Write the schema-versioned RunReport JSON here after "
+                   "the run: config/plan/timing on the jax backend; the "
+                   "asyncio backend's report carries the 'streaming' "
+                   "section (join latency quantiles, funnel/broker/retry "
+                   "counters)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
-          start, backend, n_chains, chain, sharded, checkpoint, block_s,
-          site_grid_spec, sites_csv, profile_dir, output, prng_impl,
-          block_impl, tune, telemetry, telemetry_strict, metrics_path,
-          run_report_path):
+          start, trace, backend, n_chains, chain, sharded, checkpoint,
+          block_s, site_grid_spec, sites_csv, profile_dir, output,
+          prng_impl, block_impl, tune, telemetry, telemetry_strict,
+          metrics_path, run_report_path):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
-    if (metrics_path or run_report_path) and backend != "jax":
-        raise click.UsageError("--metrics/--run-report require "
-                               "--backend=jax")
     if (site_grid_spec or sites_csv) and backend != "jax":
         raise click.UsageError("--site-grid/--sites-csv require "
                                "--backend=jax")
@@ -270,13 +276,16 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   telemetry=telemetry,
                   telemetry_strict=telemetry_strict,
                   metrics_path=metrics_path,
-                  run_report_path=run_report_path)
+                  run_report_path=run_report_path,
+                  trace=trace)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main
 
     asyncrun(pvsim_main(file, amqp_url, exchange, realtime, seed, duration_s,
-                        _parse_start(start)))
+                        _parse_start(start), trace=trace,
+                        metrics_path=metrics_path,
+                        run_report_path=run_report_path))
 
 
 @click.group()

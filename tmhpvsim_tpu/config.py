@@ -379,3 +379,11 @@ class SimConfig:
     #: escalate drift-sentinel WARNs (NaN/Inf appearance, reference-band
     #: escape) to obs.sentinel.DriftError
     telemetry_strict: bool = False
+
+    #: streaming-trace output path (obs/trace.py): per-block host-side
+    #: instants land in the tracer ring and export as Chrome-trace JSON
+    #: here on exit.  Pure host-side observability — never enters the
+    #: traced graph and is NOT part of the checkpoint config echo
+    #: (engine/checkpoint.py uses an explicit key list), so toggling it
+    #: across a resume is safe.
+    trace: Optional[str] = None

@@ -1,6 +1,7 @@
-"""Observability subsystem: metrics registry, run reports, profiler.
+"""Observability subsystem: metrics, reports, profiler, telemetry,
+drift sentinel, streaming tracer.
 
-Three host-side modules (nothing here ever runs inside jit):
+Host-side modules (plus one device-side fold):
 
 * :mod:`~tmhpvsim_tpu.obs.metrics` — low-overhead counters / gauges /
   histograms with pluggable sinks (JSONL, Prometheus text exposition);
@@ -9,7 +10,15 @@ Three host-side modules (nothing here ever runs inside jit):
 * :mod:`~tmhpvsim_tpu.obs.profiler` — block timing, ``jax.profiler``
   trace annotations, and platform-guarded device traces (the round-5
   retraction happened because a CPU-fallback trace was committed as
-  device evidence; the guard makes that impossible to miss again).
+  device evidence; the guard makes that impossible to miss again);
+* :mod:`~tmhpvsim_tpu.obs.telemetry` — the in-graph numerics
+  accumulator that rides the device scan carry (the one part of obs that
+  DOES run inside jit; lazily imported here because it needs jax);
+* :mod:`~tmhpvsim_tpu.obs.sentinel` — the drift sentinel comparing
+  leading-block means against the float64 golden models
+  (``DriftSentinel``, ``DriftError``);
+* :mod:`~tmhpvsim_tpu.obs.trace` — the asyncio-task-aware streaming
+  event tracer + flight recorder (Chrome-trace JSON export).
 
 ``engine/profiling.py`` remains as a compatibility shim re-exporting
 the profiler names.
@@ -35,3 +44,24 @@ from tmhpvsim_tpu.obs.report import (  # noqa: F401
     RunReport,
     validate_report,
 )
+from tmhpvsim_tpu.obs.sentinel import (  # noqa: F401
+    DriftError,
+    DriftSentinel,
+)
+from tmhpvsim_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    set_tracer,
+    use_tracer,
+)
+
+
+def __getattr__(name):
+    # obs.telemetry imports jax at module scope (it builds jit-resident
+    # accumulators); the runtime layers import this package from
+    # jax-free contexts, so the submodule loads lazily on first touch
+    if name == "telemetry":
+        import importlib
+
+        return importlib.import_module("tmhpvsim_tpu.obs.telemetry")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -129,6 +129,35 @@ class Histogram:
         }
 
 
+def quantile_from_snapshot(snap: Optional[dict], q: float) -> Optional[float]:
+    """Quantile estimate from a :meth:`Histogram.snapshot` dict by linear
+    interpolation within the cumulative buckets (Prometheus
+    ``histogram_quantile`` semantics), clamped to the observed [min, max]
+    so a handful of sub-bucket latencies cannot report a bucket-bound
+    worth of latency.  None when the histogram is empty/absent."""
+    if not snap or not snap.get("count"):
+        return None
+    count = snap["count"]
+    target = q * count
+    lo_bound, lo_cum = 0.0, 0
+    value = None
+    for bound, cum in snap.get("buckets", ()):
+        if cum >= target:
+            frac = (target - lo_cum) / max(1, cum - lo_cum)
+            value = lo_bound + frac * (bound - lo_bound)
+            break
+        lo_bound, lo_cum = bound, cum
+    if value is None:  # beyond the last finite bucket (+Inf territory)
+        value = snap.get("max")
+    if value is None:
+        return None
+    if snap.get("min") is not None:
+        value = max(value, snap["min"])
+    if snap.get("max") is not None:
+        value = min(value, snap["max"])
+    return value
+
+
 class _NullMetric:
     """Shared no-op stand-in handed out by a disabled registry."""
 

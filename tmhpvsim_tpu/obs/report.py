@@ -28,9 +28,13 @@ logger = logging.getLogger(__name__)
 
 #: v1: PR-2 sections.  v2: adds the optional ``telemetry`` section
 #: (drift-sentinel verdict + per-field worst z-scores, obs/sentinel.py).
+#: v3: adds the optional ``streaming`` section (publish→join / join→csv
+#: latency quantiles, funnel pending high-water + eviction / stall /
+#: backpressure counters, retry + broker connect counts — the asyncio
+#: streaming path's aggregate view, obs/trace.py holds the timeline).
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 2
+REPORT_SCHEMA_VERSION = 3
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -55,6 +59,7 @@ _TOP_SCHEMA = {
     "profile": (False, _OPT_DICT),
     "processes": (False, (list, type(None))),
     "telemetry": (False, _OPT_DICT),
+    "streaming": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -197,6 +202,70 @@ def _plan_doc(plan) -> Optional[dict]:
             "source": plan.source}
 
 
+def _latency_doc(snap: Optional[dict]) -> Optional[dict]:
+    """Quantile summary of one latency histogram snapshot."""
+    from tmhpvsim_tpu.obs.metrics import quantile_from_snapshot
+
+    if not snap or not snap.get("count"):
+        return None
+    return {
+        "count": snap["count"],
+        "mean_s": snap.get("mean"),
+        "min_s": snap.get("min"),
+        "max_s": snap.get("max"),
+        "p50_s": quantile_from_snapshot(snap, 0.50),
+        "p90_s": quantile_from_snapshot(snap, 0.90),
+        "p99_s": quantile_from_snapshot(snap, 0.99),
+    }
+
+
+def _sum_prefixed(counters: dict, prefix: str) -> float:
+    return sum(v for k, v in counters.items() if k.startswith(prefix))
+
+
+def _streaming_section(snap: dict) -> Optional[dict]:
+    """The ``streaming`` report section from the well-known metric names
+    the instrumented runtime layers use (funnel, retry, brokers, the
+    pvsim join-latency accounting).  None when the run streamed nothing
+    (pure jax-backend runs keep their reports v2-shaped)."""
+    hists = snap.get("histograms", {})
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    streamed = (
+        "streaming.publish_to_join_s" in hists
+        or "streaming.join_to_csv_s" in hists
+        or any(k.startswith(("funnel.", "broker.", "retry."))
+               for k in list(counters) + list(gauges))
+    )
+    if not streamed:
+        return None
+    return {
+        "publish_to_join": _latency_doc(
+            hists.get("streaming.publish_to_join_s")),
+        "join_to_csv": _latency_doc(hists.get("streaming.join_to_csv_s")),
+        "rows_written": int(counters.get("pvsim.rows_written_total", 0)),
+        "funnel": {
+            "pending_high_water":
+                int(gauges.get("funnel.pending_high_water", 0)),
+            "evictions": int(counters.get("funnel.evicted_total", 0)),
+            "stall_suspends":
+                int(counters.get("funnel.stall_suspends_total", 0)),
+            "backpressure_waits":
+                int(counters.get("funnel.backpressure_waits_total", 0)),
+        },
+        "retry": {
+            "attempts": int(_sum_prefixed(counters, "retry.attempts.")),
+            "exhausted": int(_sum_prefixed(counters, "retry.exhausted.")),
+        },
+        "broker": {
+            "connects": int(counters.get("broker.connects_total", 0)),
+            "reconnects": int(counters.get("broker.reconnects_total", 0)),
+            "published": int(counters.get("broker.published_total", 0)),
+            "delivered": int(counters.get("broker.delivered_total", 0)),
+        },
+    }
+
+
 class RunReport:
     """Incremental builder for one run's report.
 
@@ -221,6 +290,9 @@ class RunReport:
         self.processes: Optional[list] = None
         #: drift-sentinel section (obs/sentinel.py DriftSentinel.report())
         self.telemetry: Optional[dict] = None
+        #: streaming-join section, derived from the well-known streaming
+        #: metric names by :meth:`attach_metrics`
+        self.streaming: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -257,6 +329,9 @@ class RunReport:
                 "pacing_slip_total_s":
                     gauges.get("clock.pacing_slip_total_s", 0.0),
             }
+        streaming = _streaming_section(snap)
+        if streaming is not None:
+            self.streaming = streaming
 
     def doc(self, validate: bool = True) -> dict:
         out = {
@@ -278,6 +353,7 @@ class RunReport:
             "profile": self.profile,
             "processes": self.processes,
             "telemetry": self.telemetry,
+            "streaming": self.streaming,
         }
         return validate_report(out) if validate else out
 

@@ -1,0 +1,210 @@
+"""Structured event tracer + flight recorder for the streaming path.
+
+The batch engine got metrics and run reports in the observability
+rounds; the *streaming* half of the paper's artifact (metersim → broker
+→ funnel → CSV) stayed dark: a stalled join or a reconnect storm was
+invisible until the CSV went quiet.  This module is the timeline side of
+the answer (obs/metrics.py is the aggregate side): monotonic-clock spans
+and instant events with categories, tagged with the *asyncio task* that
+emitted them, kept in a bounded in-memory ring.
+
+Two ways out of the ring:
+
+* :meth:`Tracer.export` — the whole ring as a Chrome-trace-event JSON
+  (``{"traceEvents": [...]}``), loadable in Perfetto
+  (https://ui.perfetto.dev) or ``chrome://tracing``.  Events carry this
+  process's real pid, so a ``jax.profiler`` device trace of the same run
+  (``--profile``) merges as a separate process row by concatenating the
+  two files' ``traceEvents`` lists.
+* :meth:`Tracer.dump_flight` — the last-N-seconds slice, written when
+  something already went wrong: unhandled app exceptions and the
+  bench.py watchdog's rc=3 salvage path dump here so a wedged run
+  finally leaves a timeline behind.  The dump is itself a valid trace
+  file (tools/trace_stats.py validates both).
+
+Cost model: tracing defaults OFF.  Call sites hold an
+``Optional[Tracer]`` and guard with ``if tracer:`` (``__bool__`` is
+``enabled``), so a disabled/absent tracer costs one truth test on the
+hot path; an enabled one costs a dict build + deque append per event
+(the ring never allocates past ``ring_capacity``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+#: default ring size — at the apps' 1 Hz × ~4 events/record this is
+#: hours of history; free-run tests churn it in seconds, which is the
+#: point of a ring
+TRACE_RING_CAPACITY = 65_536
+
+#: seconds of history a flight dump keeps by default
+FLIGHT_WINDOW_S = 30.0
+
+
+def _task_or_thread() -> str:
+    """Track label for the current execution context: the asyncio task
+    name when inside a running loop (the apps are task soups — 'Task-3'
+    tells you nothing less than which coroutine stalled), else the
+    thread name (bench's watchdog monitor, jax worker threads)."""
+    try:
+        task = asyncio.current_task()
+    except RuntimeError:  # no running event loop in this thread
+        task = None
+    if task is not None:
+        return f"task:{task.get_name()}"
+    return f"thread:{threading.current_thread().name}"
+
+
+class _Span:
+    """Context manager recording one complete ("X") event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = self._tracer.now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t = self._tracer
+        ev = {"name": self._name, "cat": self._cat, "ph": "X",
+              "ts": self._t0, "dur": t.now_us() - self._t0,
+              "tid": _task_or_thread()}
+        if self._args:
+            ev["args"] = self._args
+        t._events.append(ev)
+        return False
+
+
+class Tracer:
+    """Bounded ring of Chrome-trace events; see module docstring.
+
+    ``clock`` is injectable for tests (monotonic nanoseconds).  The ring
+    (``collections.deque(maxlen=...)``) is append-safe across threads.
+    """
+
+    def __init__(self, enabled: bool = True,
+                 ring_capacity: int = TRACE_RING_CAPACITY,
+                 clock=time.monotonic_ns):
+        self.enabled = enabled
+        self._clock = clock
+        self._events: deque = deque(maxlen=ring_capacity)
+
+    def __bool__(self) -> bool:
+        return self.enabled
+
+    def now_us(self) -> int:
+        return self._clock() // 1000
+
+    # -- recording -------------------------------------------------------
+
+    def span(self, name: str, cat: str = "app", **args):
+        """Context manager: one complete ("X") event with duration."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        return _Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "app", **args) -> None:
+        """One instant ("i") event, thread-scoped."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": self.now_us(), "tid": _task_or_thread()}
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    def events(self) -> list:
+        """Snapshot of the ring, oldest first."""
+        return list(self._events)
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    # -- export ----------------------------------------------------------
+
+    def export(self, path: str, process_name: str = "tmhpvsim",
+               events: Optional[list] = None) -> dict:
+        """Write the ring (or ``events``) as a Chrome-trace JSON; returns
+        the document.  Atomic tmp+rename: a killed process never leaves a
+        torn trace for the salvage tooling to choke on."""
+        evs = self.events() if events is None else events
+        pid = os.getpid()
+        # string track labels -> small int tids + "thread_name" metadata,
+        # the encoding chrome://tracing and Perfetto expect
+        tids: dict = {}
+        out = []
+        for ev in evs:
+            label = ev.get("tid", "thread:?")
+            tid = tids.setdefault(label, len(tids) + 1)
+            out.append({**ev, "pid": pid, "tid": tid})
+        meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                 "args": {"name": process_name}}]
+        for label, tid in tids.items():
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": label}})
+        doc = {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return doc
+
+    def dump_flight(self, path: str,
+                    last_s: float = FLIGHT_WINDOW_S) -> dict:
+        """Export only the last ``last_s`` seconds of the ring — the
+        crash/watchdog artifact.  A span that *started* before the
+        window but overlaps it is kept (that long span is usually the
+        story)."""
+        cut = self.now_us() - int(last_s * 1e6)
+        evs = [e for e in self.events()
+               if e["ts"] + e.get("dur", 0) >= cut]
+        return self.export(path, events=evs)
+
+
+#: process-default tracer: None means "tracing off everywhere".  Library
+#: code never installs one; apps/bench do when asked to (``--trace``),
+#: and pass Tracer instances explicitly where two app mains share one
+#: process (the e2e tests) — a global swap would race there.
+_default: Optional[Tracer] = None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _default
+
+
+def set_tracer(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    """Install (or clear, with None) the process-default tracer; returns
+    the previous one.  bench.py installs a ring at headline start so the
+    watchdog has something to dump."""
+    global _default
+    prev = _default
+    _default = tracer
+    return prev
+
+
+@contextlib.contextmanager
+def use_tracer(tracer: Optional[Tracer]):
+    """Scoped :func:`set_tracer` (tests)."""
+    prev = set_tracer(tracer)
+    try:
+        yield tracer
+    finally:
+        set_tracer(prev)

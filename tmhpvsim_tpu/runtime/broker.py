@@ -18,7 +18,10 @@ Two interchangeable transports implement those semantics:
   apps run in one process out of the box.
 
 Wire format matches the reference: UTF-8 JSON float body + POSIX-seconds
-timestamp.
+timestamp.  Metadata (``meta``) rides OUT-OF-BAND — the LocalTransport
+Message field, AMQP headers, the tcp wire's optional ``"m"`` key — so
+the body stays a plain JSON float and reference consumers parsing it are
+unaffected by metersim's seq/publish-time stamping (obs/trace.py).
 """
 
 from __future__ import annotations
@@ -27,6 +30,7 @@ import asyncio
 import dataclasses
 import datetime as _dt
 import json
+import weakref
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
 
@@ -34,16 +38,68 @@ from typing import AsyncIterator, Dict, List, Optional, Tuple
 class Message:
     body: bytes
     timestamp: Optional[_dt.datetime]
+    #: additive metadata (e.g. metersim's {"seq": n, "pub_us": mono-µs});
+    #: None on the reference wire shape
+    meta: Optional[dict] = None
 
 
-def encode(value: float, time: _dt.datetime) -> Message:
+def encode(value: float, time: _dt.datetime,
+           meta: Optional[dict] = None) -> Message:
     """JSON float body + timestamp property (metersim.py:38-42)."""
-    return Message(body=json.dumps(value).encode(), timestamp=time)
+    return Message(body=json.dumps(value).encode(), timestamp=time,
+                   meta=meta)
 
 
 def decode(msg: Message) -> Tuple[_dt.datetime, float]:
     """(measurement time, value) — the consumer's view (pvsim.py:66-70)."""
     return msg.timestamp, json.loads(msg.body.decode())
+
+
+def decode_with_meta(msg: Message) -> Tuple[_dt.datetime, float,
+                                            Optional[dict]]:
+    """(time, value, meta) — the instrumented consumer's view."""
+    return msg.timestamp, json.loads(msg.body.decode()), msg.meta
+
+
+#: endpoints (url, exchange) each REGISTRY has seen a connect for —
+#: distinguishes first connects from reconnects without leaking state
+#: across per-run registries (keyed weakly on the registry object)
+_seen_endpoints: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _count_connect(url: str, exchange: str) -> None:
+    """connect/reconnect counters on the current default registry.
+
+    "Reconnect" means: this registry already saw a connect to this
+    (url, exchange).  That is exact for the deployed one-app-per-process
+    shape; when BOTH apps share a process and registry (the e2e tests),
+    the consumer's first connect after the producer's counts as one —
+    an accepted approximation, not worth plumbing a role through every
+    transport."""
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.get_registry()
+    reg.counter("broker.connects_total").inc()
+    try:
+        seen = _seen_endpoints.setdefault(reg, set())
+    except TypeError:
+        return  # non-weakrefable registry stand-in: skip reconnect split
+    if (url, exchange) in seen:
+        reg.counter("broker.reconnects_total").inc()
+    else:
+        seen.add((url, exchange))
+
+
+def _pub_counter():
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.get_registry().counter("broker.published_total")
+
+
+def _deliver_counter():
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.get_registry().counter("broker.delivered_total")
 
 
 # ---------------------------------------------------------------------------
@@ -65,8 +121,15 @@ class _LocalBroker:
         return cls._registry.setdefault(url, cls())
 
     def publish(self, exchange: str, msg: Message) -> None:
+        depth = 0
         for q in self._exchanges.get(exchange, []):
             q.put_nowait(msg)
+            depth = max(depth, q.qsize())
+        if depth:
+            from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.get_registry().gauge(
+                "broker.queue_depth").set(depth)
 
     def bind(self, exchange: str) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
@@ -84,23 +147,33 @@ class LocalTransport:
     """Fanout pub/sub inside one process (``local://`` URLs)."""
 
     def __init__(self, url: str, exchange: str):
+        self._url = url
         self._broker = _LocalBroker.get(url)
         self._exchange = exchange
 
     async def __aenter__(self):
+        _count_connect(self._url, self._exchange)
         return self
 
     async def __aexit__(self, *exc):
         return False
 
-    async def publish(self, value: float, time: _dt.datetime) -> None:
-        self._broker.publish(self._exchange, encode(value, time))
+    async def publish(self, value: float, time: _dt.datetime,
+                      meta: Optional[dict] = None) -> None:
+        self._broker.publish(self._exchange, encode(value, time, meta))
+        _pub_counter().inc()
 
-    async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
+    async def subscribe(self, with_meta: bool = False) -> AsyncIterator:
+        """Yields ``(time, value)``; ``with_meta=True`` yields
+        ``(time, value, meta-or-None)`` (3-tuples are opt-in so the
+        reference-shaped consumers keep their 2-tuple unpacking)."""
         q = self._broker.bind(self._exchange)
+        deliver = _deliver_counter()
         try:
             while True:
-                yield decode(await q.get())
+                msg = await q.get()
+                deliver.inc()
+                yield decode_with_meta(msg) if with_meta else decode(msg)
         finally:
             self._broker.unbind(self._exchange, q)
 
@@ -139,6 +212,7 @@ class AmqpTransport:
         self._exchange = await self._channel.declare_exchange(
             self._exchange_name, ap.ExchangeType.FANOUT
         )
+        _count_connect(self._url, self._exchange_name)
         return self
 
     async def __aexit__(self, *exc):
@@ -146,25 +220,39 @@ class AmqpTransport:
             await self._conn.close()
         return False
 
-    async def publish(self, value: float, time: _dt.datetime) -> None:
+    async def publish(self, value: float, time: _dt.datetime,
+                      meta: Optional[dict] = None) -> None:
         ap = self._aio_pika
+        # meta rides in AMQP headers, NOT the body: the reference
+        # consumer json.loads()es the body as a bare float and must keep
+        # working against a stamping producer
         msg = ap.Message(
             body=json.dumps(value).encode(),
             timestamp=time,
+            headers=meta or None,
         )
         await asyncio.shield(self._exchange.publish(msg, routing_key=""))
+        _pub_counter().inc()
 
-    async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
+    async def subscribe(self, with_meta: bool = False) -> AsyncIterator:
         await self._channel.set_qos(prefetch_count=1)
         queue = await self._channel.declare_queue(exclusive=True)
         await queue.bind(self._exchange)
+        deliver = _deliver_counter()
         async with queue.iterator() as it:
             async for message in it:
                 async with message.process():
                     ts = message.timestamp
                     if isinstance(ts, (int, float)):
                         ts = _dt.datetime.fromtimestamp(ts)
-                    yield ts, json.loads(message.body.decode())
+                    deliver.inc()
+                    value = json.loads(message.body.decode())
+                    if with_meta:
+                        meta = dict(message.headers) \
+                            if message.headers else None
+                        yield ts, value, meta
+                    else:
+                        yield ts, value
 
 
 def make_transport(url: Optional[str], exchange: str):

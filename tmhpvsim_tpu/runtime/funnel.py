@@ -41,9 +41,16 @@ import asyncio
 import heapq
 import logging
 import math
+import time as _time
 from typing import NamedTuple, Optional, Type
 
 logger = logging.getLogger(__name__)
+
+#: eviction warnings are rate-limited to one per this many seconds
+#: (mirrors clock.PacingMonitor): a --no-realtime free-run can evict
+#: thousands of records per second, and per-event visibility lives in
+#: the ``funnel.evicted_total`` counter, not the log
+EVICT_WARN_EVERY_S = 10.0
 
 #: sentinel: "use the default initial-pending cap, clamped under
 #: max_pending" — distinct from an explicit value (validated) or None
@@ -100,6 +107,20 @@ class SynchronizingFunnel:
             )
         self.max_initial_pending = max_initial_pending
         self.n_evicted = 0
+        self._last_evict_warn: Optional[float] = None
+        self._evict_warns_suppressed = 0
+        # instrumentation (obs/metrics.py): binds the process-default
+        # registry at construction, like the engine layers — construct
+        # funnels inside a use_registry scope to isolate a run
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.get_registry()
+        self._g_pending = reg.gauge("funnel.pending_depth")
+        self._g_high_water = reg.gauge("funnel.pending_high_water")
+        self._c_evicted = reg.counter("funnel.evicted_total")
+        self._c_stalls = reg.counter("funnel.stall_suspends_total")
+        self._c_bp_waits = reg.counter("funnel.backpressure_waits_total")
+        self._high_water = 0
         self._newest: dict = {}       # field -> newest time delivered
         self._advanced = asyncio.Event()
         #: per-producer suspension: {other-streams key -> the BINDING
@@ -117,6 +138,11 @@ class SynchronizingFunnel:
                 heapq.heappush(self._age_heap, time)
             self._cache[time] = rec
             await self._evict_if_needed()
+            depth = len(self._cache)
+            self._g_pending.set(depth)
+            if depth > self._high_water:
+                self._high_water = depth
+                self._g_high_water.set(depth)
         else:
             self._cache.pop(time, None)
             # drain stale heap entries now, not only at eviction time: in a
@@ -133,6 +159,7 @@ class SynchronizingFunnel:
             if len(self._age_heap) > 2 * len(self._cache) + 64:
                 self._age_heap = list(self._cache)
                 heapq.heapify(self._age_heap)
+            self._g_pending.set(len(self._cache))
             await self._queue.put((time, rec))
         for f in fields:
             cur = self._newest.get(f)
@@ -157,6 +184,7 @@ class SynchronizingFunnel:
         deadline = loop.time() + self.stall_timeout_s
         first = self._floors(others)
         last_binding = None if first is None else min(first)
+        waited = False
         while True:
             floors = self._floors(others)
             # All decisions key on the BINDING floor (the slowest other
@@ -190,12 +218,16 @@ class SynchronizingFunnel:
             remaining = deadline - loop.time()
             if remaining <= 0:
                 self._suspended[others] = binding
+                self._c_stalls.inc()
                 logger.warning(
                     "funnel backpressure: stream(s) %s made no progress "
                     "for %.0f s (newest: %s); resuming free-run until they "
                     "advance", others, self.stall_timeout_s, self._newest,
                 )
                 return
+            if not waited:
+                waited = True
+                self._c_bp_waits.inc()  # one count per put that blocked
             self._advanced.clear()
             try:
                 await asyncio.wait_for(self._advanced.wait(), remaining)
@@ -227,9 +259,29 @@ class SynchronizingFunnel:
                 break
         self._cache.pop(oldest)
         self.n_evicted += 1
-        if self.n_evicted == 1 or self.n_evicted % 1000 == 0:
-            logger.warning(
-                "funnel cache exceeded %d pending records; evicted %d "
-                "incomplete (one input stream is stalled?)",
-                self.max_pending, self.n_evicted,
-            )
+        self._c_evicted.inc()
+        self._warn_eviction()
+
+    def _warn_eviction(self, now: Optional[float] = None) -> bool:
+        """Rate-limited eviction WARN (at most one per
+        :data:`EVICT_WARN_EVERY_S`, with a suppressed-count suffix —
+        the PacingMonitor pattern).  ``now`` is injectable for tests;
+        returns True when it warned."""
+        if now is None:
+            now = _time.monotonic()
+        if self._last_evict_warn is not None and \
+                now - self._last_evict_warn < EVICT_WARN_EVERY_S:
+            self._evict_warns_suppressed += 1
+            return False
+        suffix = ""
+        if self._evict_warns_suppressed:
+            suffix = (f" ({self._evict_warns_suppressed} similar warnings "
+                      f"suppressed in the last {EVICT_WARN_EVERY_S:.0f} s)")
+        self._last_evict_warn = now
+        self._evict_warns_suppressed = 0
+        logger.warning(
+            "funnel cache exceeded %d pending records; evicted %d "
+            "incomplete (one input stream is stalled?)%s",
+            self.max_pending, self.n_evicted, suffix,
+        )
+        return True

@@ -46,8 +46,12 @@ def asyncretry(func=None, *, attempts=3, delay: float = 0.0,
             asyncretry, attempts=attempts, delay=delay, fallback=fallback
         )
 
+    qualname = func.__qualname__
+
     @functools.wraps(func)
     async def wrapper(*args, **kwargs):
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
         n = 0
         while True:
             try:
@@ -56,7 +60,23 @@ def asyncretry(func=None, *, attempts=3, delay: float = 0.0,
                 raise
             except Exception as exc:
                 n += 1
+                # per-qualname counters against the CURRENT process
+                # default registry (looked up per event, not cached at
+                # decoration: apps swap registries per run)
+                obs_metrics.get_registry().counter(
+                    f"retry.attempts.{qualname}").inc()
                 if attempts is not forever and n >= attempts:
+                    obs_metrics.get_registry().counter(
+                        f"retry.exhausted.{qualname}").inc()
+                    # WARN on exhaustion whichever way it resolves: the
+                    # fallback path would otherwise swallow the failure
+                    # silently (only per-attempt INFO lines exist)
+                    logger.warning(
+                        "%s exhausted %d attempt(s); final failure %s: "
+                        "%s (%s)", qualname, n, type(exc).__name__, exc,
+                        "re-raising" if fallback is propagate
+                        else "applying fallback",
+                    )
                     if fallback is propagate:
                         raise
                     if callable(fallback):

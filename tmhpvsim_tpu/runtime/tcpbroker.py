@@ -28,6 +28,12 @@ Wire protocol (one JSON object per line, UTF-8):
     {"op": "pub", "exchange": E, "v": f, "ts_us": n}  client -> broker
     {"v": f, "ts_us": n}                              broker -> subscriber
 
+An optional ``"m"`` object on pub frames (metersim's additive seq +
+monotonic publish-time stamp, obs/trace.py) is forwarded verbatim to
+subscribers when it is a dict and silently dropped otherwise — old
+brokers/clients that predate the key interoperate either way because
+``"v"``/``"ts_us"`` keep their reference shape.
+
 ``ts_us`` is the measurement's NAIVE wall time encoded as INTEGER
 microseconds since the epoch *as if UTC*: the apps join on naive
 fixedclock datetimes, and pinning the wire encoding to UTC makes
@@ -62,14 +68,20 @@ class _Subscriber:
     stalled consumer back-pressures onto ITS buffer, never the broker."""
 
     def __init__(self, writer: asyncio.StreamWriter):
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
         self.writer = writer
         self.queue: asyncio.Queue = asyncio.Queue()
         self.n_dropped = 0
+        reg = obs_metrics.get_registry()
+        self._c_dropped = reg.counter("tcpbroker.dropped_total")
+        self._g_backlog = reg.gauge("tcpbroker.backlog_depth")
 
     def offer(self, line: bytes) -> None:
         while self.queue.qsize() >= MAX_SUBSCRIBER_BACKLOG:
             self.queue.get_nowait()
             self.n_dropped += 1
+            self._c_dropped.inc()
             if self.n_dropped == 1 or self.n_dropped % 1000 == 0:
                 logger.warning(
                     "tcp broker: subscriber backlog exceeded %d; dropped "
@@ -77,6 +89,7 @@ class _Subscriber:
                     MAX_SUBSCRIBER_BACKLOG, self.n_dropped,
                 )
         self.queue.put_nowait(line)
+        self._g_backlog.set(self.queue.qsize())
 
     async def drain(self) -> None:
         while True:
@@ -163,7 +176,11 @@ class TcpFanoutBroker:
                             line[:100],
                         )
                         continue
-                    out = json.dumps({"v": v, "ts_us": ts}).encode() + b"\n"
+                    frame_out = {"v": v, "ts_us": ts}
+                    m = frame.get("m")
+                    if isinstance(m, dict):  # additive meta passthrough
+                        frame_out["m"] = m
+                    out = json.dumps(frame_out).encode() + b"\n"
                     for s in self._exchanges.get(exchange, ()):  # fanout
                         s.offer(out)
                 elif op == "sub" and sub is None:
@@ -217,9 +234,12 @@ class TcpTransport:
         self._writer: Optional[asyncio.StreamWriter] = None
 
     async def __aenter__(self):
+        from tmhpvsim_tpu.runtime.broker import _count_connect
+
         self._reader, self._writer = await asyncio.open_connection(
             self._host, self._port
         )
+        _count_connect(f"tcp://{self._host}:{self._port}", self._exchange)
         return self
 
     async def __aexit__(self, *exc):
@@ -233,7 +253,10 @@ class TcpTransport:
         self._writer.write(json.dumps(frame).encode() + b"\n")
         await self._writer.drain()
 
-    async def publish(self, value: float, time: _dt.datetime) -> None:
+    async def publish(self, value: float, time: _dt.datetime,
+                      meta: Optional[dict] = None) -> None:
+        from tmhpvsim_tpu.runtime.broker import _pub_counter
+
         # naive wall time -> as-if-UTC epoch (see module docstring: makes
         # the join timezone-independent across hosts); aware datetimes
         # keep their real instant.  Wire encoding is INTEGER microseconds
@@ -243,20 +266,31 @@ class TcpTransport:
         if time.tzinfo is None:
             time = time.replace(tzinfo=_dt.timezone.utc)
         ts_us = round((time - _EPOCH) / _dt.timedelta(microseconds=1))
+        frame = {"op": "pub", "exchange": self._exchange,
+                 "v": value, "ts_us": ts_us}
+        if meta:
+            frame["m"] = meta
         # shielded like the AMQP path (metersim.py:43-45): a cancellation
         # mid-publish must not truncate the frame on the wire
-        await asyncio.shield(self._send({
-            "op": "pub", "exchange": self._exchange,
-            "v": value, "ts_us": ts_us,
-        }))
+        await asyncio.shield(self._send(frame))
+        _pub_counter().inc()
 
-    async def subscribe(self) -> AsyncIterator[Tuple[_dt.datetime, float]]:
+    async def subscribe(self, with_meta: bool = False) -> AsyncIterator:
+        from tmhpvsim_tpu.runtime.broker import _deliver_counter
+
         await self._send({"op": "sub", "exchange": self._exchange})
+        deliver = _deliver_counter()
         while True:
             line = await self._reader.readline()
             if not line:
                 raise ConnectionError("tcp broker closed the connection")
             frame = json.loads(line)
+            deliver.inc()
             # inverse of publish: integer-us as-if-UTC epoch -> naive wall
             t = _EPOCH + _dt.timedelta(microseconds=frame["ts_us"])
-            yield (t.replace(tzinfo=None), frame["v"])
+            if with_meta:
+                m = frame.get("m")
+                yield (t.replace(tzinfo=None), frame["v"],
+                       m if isinstance(m, dict) else None)
+            else:
+                yield (t.replace(tzinfo=None), frame["v"])

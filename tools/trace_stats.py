@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Validate + summarise Chrome-trace JSON files (obs/trace.py exports).
+
+Checks each file against the subset of the Trace Event Format the
+tracer emits — and that Perfetto / chrome://tracing actually require to
+load a file — then prints a per-category table of span counts and
+durations plus instant-event counts:
+
+* top level is an object with a ``traceEvents`` list (the "JSON Object
+  Format"; a bare array is also accepted since the viewers take both);
+* every event is an object with a string ``ph`` and, except for
+  metadata events, a numeric ``ts`` (microseconds);
+* complete spans (``ph == "X"``) carry a numeric ``dur >= 0``;
+* ``pid``/``tid`` are integers when present (string ids are legal in
+  the wild but the tracer never emits them, and Perfetto's track
+  grouping degrades on mixed types);
+* metadata events (``ph == "M"``) carry a string ``name``.
+
+Exit code 0 when every file validates, nonzero otherwise — which is how
+``run_tpu_round5b.sh`` and the tier-1 round-trip test consume it.
+
+No third-party imports: runs anywhere the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: event phases the tracer emits (chrome's full alphabet is larger; an
+#: unknown phase is reported as a warning, not an error, so merged
+#: jax.profiler traces with richer phases still validate)
+KNOWN_PHASES = {"X", "i", "I", "M", "B", "E", "C", "b", "e", "n", "s",
+                "t", "f"}
+
+
+def validate(doc) -> tuple[list, list]:
+    """(errors, events): schema errors for one parsed trace document."""
+    errors: list = []
+    if isinstance(doc, list):            # JSON Array Format
+        events = doc
+    elif isinstance(doc, dict):          # JSON Object Format
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return ["top-level 'traceEvents' is missing or not a list"], []
+    else:
+        return ["top level is neither an object nor an array"], []
+
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or not ph:
+            errors.append(f"{where}: missing/non-string 'ph'")
+            continue
+        if ph == "M":
+            if not isinstance(ev.get("name"), str):
+                errors.append(f"{where}: metadata event without a "
+                              "string 'name'")
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            errors.append(f"{where}: ph={ph!r} without numeric 'ts'")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: complete span without "
+                              f"numeric dur >= 0 (got {dur!r})")
+        for key in ("pid", "tid"):
+            if key in ev and not isinstance(ev[key], int):
+                errors.append(f"{where}: non-integer {key!r} "
+                              f"({ev[key]!r})")
+    return errors, events
+
+
+def summarize(events: list) -> dict:
+    """Per-category stats: span count/total/max duration, instant count."""
+    cats: dict = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") == "M":
+            continue
+        cat = ev.get("cat") if isinstance(ev.get("cat"), str) else "-"
+        c = cats.setdefault(cat, {"spans": 0, "dur_us": 0.0,
+                                  "max_us": 0.0, "instants": 0})
+        if ev.get("ph") == "X":
+            c["spans"] += 1
+            dur = ev.get("dur")
+            if isinstance(dur, (int, float)):
+                c["dur_us"] += dur
+                c["max_us"] = max(c["max_us"], dur)
+        elif ev.get("ph") in ("i", "I"):
+            c["instants"] += 1
+    return cats
+
+
+def _print_summary(name: str, events: list) -> None:
+    cats = summarize(events)
+    print(f"{name}: {len(events)} events, {len(cats)} categories")
+    if not cats:
+        return
+    header = ("category", "spans", "total_ms", "max_ms", "instants")
+    table = [header]
+    for cat in sorted(cats):
+        c = cats[cat]
+        table.append((cat, str(c["spans"]), f"{c['dur_us'] / 1e3:.3f}",
+                      f"{c['max_us'] / 1e3:.3f}", str(c["instants"])))
+    widths = [max(len(line[i]) for line in table)
+              for i in range(len(header))]
+    for line in table:
+        print("  " + "  ".join(c.ljust(w) for c, w in zip(line, widths))
+              .rstrip())
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate + summarise one trace file; True when it passes."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"{name}: INVALID ({e})", file=sys.stderr)
+        return False
+    errors, events = validate(doc)
+    unknown = {ev.get("ph") for ev in events if isinstance(ev, dict)
+               and isinstance(ev.get("ph"), str)} - KNOWN_PHASES
+    if errors:
+        print(f"{name}: INVALID ({len(errors)} schema error(s))",
+              file=sys.stderr)
+        for e in errors[:10]:
+            print(f"  {e}", file=sys.stderr)
+        if len(errors) > 10:
+            print(f"  ... and {len(errors) - 10} more", file=sys.stderr)
+        return False
+    if unknown and not quiet:
+        print(f"{name}: note: unrecognised phase(s) "
+              f"{sorted(unknown)} (accepted)", file=sys.stderr)
+    if not quiet:
+        _print_summary(name, events)
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate Chrome-trace JSON + print per-category "
+                    "span statistics")
+    ap.add_argument("files", nargs="+", help="trace files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the summary table (errors still print)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
