@@ -208,20 +208,30 @@ def _bench_timing(compile_s, steady_wall_s, n_timed_blocks, rate) -> dict:
 
 def _bench_report(app: str, *, config=None, plan=None, timing=None,
                   headline=None, profile=None, slabs=None,
-                  device=None) -> dict | None:
+                  device=None, executor=None) -> dict | None:
     """A validated obs RunReport document, embedded ADDITIVELY in a bench
     artifact as ``doc["run_report"]`` (the legacy ad-hoc fields stay —
     battery scripts key richness decisions off them).  Never raises: a
-    report failure must not cost the benchmark number it describes."""
+    report failure must not cost the benchmark number it describes.
+
+    ``executor`` defaults to the process's warm/cold compile + dispatch
+    counters (schema v4 ``executor`` section, engine/compilecache.py) —
+    process-cumulative at report time, so every mode's artifact shows
+    how much of its compile cost the persistent cache absorbed."""
     from tmhpvsim_tpu.obs.report import RunReport
 
     try:
+        if executor is None:
+            from tmhpvsim_tpu.engine import compilecache
+
+            executor = compilecache.executor_doc()
         rep = RunReport(app, config=config, plan=plan)
         rep.timing = timing
         rep.headline = headline
         rep.profile = profile
         rep.slabs = slabs
         rep.device = device
+        rep.executor = executor
         return rep.doc()
     except Exception as e:
         print(f"# run_report build failed ({app}): {e}", file=sys.stderr)
@@ -394,7 +404,8 @@ def _plan_doc(plan) -> dict:
     """Resolved execution plan as a JSON-able echo (config.Plan fields)."""
     return {"block_impl": plan.block_impl, "scan_unroll": plan.scan_unroll,
             "stats_fusion": plan.stats_fusion,
-            "slab_chains": plan.slab_chains, "source": plan.source}
+            "slab_chains": plan.slab_chains, "source": plan.source,
+            "blocks_per_dispatch": plan.blocks_per_dispatch}
 
 
 def _headline_doc(variants: dict, platform: str, **extra) -> dict:
@@ -1394,10 +1405,12 @@ def repro(k: int) -> None:
     ran = 0
     for i in range(k):
         ran = i + 1
-        # bench processes don't configure the persistent compile cache
-        # (only tests/conftest.py does), so every trial's remote compile
-        # is naturally fresh
-        env = dict(os.environ, TMHPVSIM_BENCH_ONE_VARIANT="scan-threefry")
+        # the compile-variance probe needs a FRESH compile per trial;
+        # bench now enables the persistent compile cache by default
+        # (main()), so each child must explicitly disable it — a cache
+        # hit would measure deserialisation, not compile variance
+        env = dict(os.environ, TMHPVSIM_BENCH_ONE_VARIANT="scan-threefry",
+                   TMHPVSIM_COMPILE_CACHE="off")
         try:
             # Bounded: a wedged-tunnel trial must not hang the probe
             # forever.  The kill does leave a stale tunnel grant that can
@@ -1502,9 +1515,21 @@ def main() -> None:
                     help="in-graph telemetry level for every config this "
                          "invocation runs (obs/telemetry.py; default off "
                          "keeps the headline hot path untouched)")
+    ap.add_argument("--compile-cache", metavar="DIR", default=None,
+                    help="persistent XLA compilation-cache base dir (a "
+                         "per-device-kind subdir is created under it; "
+                         "engine/compilecache.py).  Default: "
+                         "$TMHPVSIM_COMPILE_CACHE, else "
+                         "~/.cache/tmhpvsim_tpu/xla; 'off' disables")
     args = ap.parse_args()
     global TELEMETRY
     TELEMETRY = args.telemetry
+    # default ON: every mode after the first run starts cache-warm, and
+    # the v4 run_report executor section records warm vs cold compiles.
+    # --repro children override via TMHPVSIM_COMPILE_CACHE=off (repro()).
+    from tmhpvsim_tpu.engine import compilecache
+
+    compilecache.configure(args.compile_cache)
     if args.config:
         {"1": config_1, "2": config_2, "3": config_3, "3a": config_3a,
          "4": config_4, "5": config_5}[args.config]()

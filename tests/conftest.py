@@ -51,6 +51,10 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+# The warm-start executor (engine/compilecache.py) resolves its own cache
+# base from this env var; point it at the same repo-local directory so
+# CLI/app tests (in-process and subprocess) never write under ~/.cache.
+os.environ.setdefault("TMHPVSIM_COMPILE_CACHE", _cache_dir)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -140,6 +144,9 @@ _SLOW_LANE = {
     # trace acceptance: disabled-tracer engine arm at 65536 chains plus
     # a 10k-record join-throughput arm
     "test_trace_disabled_overhead_65536_chains",
+    # warm-start executor acceptance: two full-size timed arms (fused vs
+    # per-block dispatch) at 65536 chains on CPU
+    "test_fused_dispatch_no_slower_65536_chains",
 }
 
 
@@ -157,3 +164,36 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(autouse=True)
+def _compilecache_isolation():
+    """Restore the warm-start executor's process-global cache state after
+    every test.
+
+    The persistent-cache layer (engine/compilecache.py) is process-global
+    by design — one active cache dir per process.  In-process app/CLI
+    tests call ``compilecache.configure()``, and without this restore the
+    residue would make EVERY later ``Simulation`` in the suite pay AOT
+    warm-up, and tests that point the cache at a tmp dir would redirect
+    the whole suite's compilation cache away from ``.jax_cache``."""
+    from tmhpvsim_tpu.engine import compilecache
+
+    # NOT the "listener" key: the jax.monitoring listener is append-only
+    # (no unregister API); resetting it to None would make a later
+    # configure() register a duplicate and double-count warm/cold events.
+    saved_state = {k: compilecache._state[k] for k in ("dir", "configured")}
+    saved_cfg = {
+        k: getattr(jax.config, k)
+        for k in ("jax_compilation_cache_dir",
+                  "jax_persistent_cache_min_compile_time_secs",
+                  "jax_persistent_cache_min_entry_size_bytes")
+    }
+    yield
+    dir_changed = (jax.config.jax_compilation_cache_dir
+                   != saved_cfg["jax_compilation_cache_dir"])
+    for k, v in saved_cfg.items():
+        jax.config.update(k, v)
+    compilecache._state.update(saved_state)
+    if dir_changed:
+        compilecache._reset_cache_singleton()

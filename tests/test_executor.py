@@ -1,0 +1,400 @@
+"""Warm-start executor: persistent compile cache + AOT warm-up
+(engine/compilecache.py), donated carries, and multi-block fused
+dispatch (``Plan.blocks_per_dispatch``).
+
+The fused-dispatch bit-identity contract tested here (and documented on
+``Simulation._mega_block_fn``): megablocks are bit-identical to
+per-block dispatch for every reduce statistic and for the scan-family
+producers everywhere.  The one caveat is the WIDE producer's raw
+per-second arrays under the suite's 8-virtual-device CPU config —
+XLA:CPU compiles a fusion embedded in a loop body with different
+vector-epilogue boundaries than the same fusion at a jit root, so
+``pv`` can differ by one ulp at a handful of seconds per block; those
+comparisons use a one-ulp relative tolerance instead of exact equality
+(single-device CPU is exact; the reduce folds absorb the ulps).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine import Simulation, compilecache
+from tmhpvsim_tpu.engine import checkpoint as ckpt
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.obs.report import (
+    REPORT_SCHEMA_VERSION,
+    validate_report,
+)
+
+
+def cfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=1800,
+        n_chains=2,
+        seed=11,
+        block_s=600,
+        dtype="float32",
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def eq_tree(a, b, what):
+    la, ta = jax.tree.flatten(a)
+    lb, tb = jax.tree.flatten(b)
+    assert ta == tb, (what, ta, tb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=what)
+
+
+def ens_arrays(sim):
+    # run_ensemble yields BlockResults lazily; materialise to host now
+    return [(np.asarray(b.meter), np.asarray(b.pv))
+            for b in sim.run_ensemble()]
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache: AOT warm-up populates it, rebuild is all-warm
+# ---------------------------------------------------------------------------
+
+class TestWarmCache:
+    def test_second_build_compiles_zero_times(self, tmp_path):
+        """Against a cache dir populated by the first build's AOT
+        warm-up, a process-equivalent rebuild must deserialise every
+        executable — zero fresh compiles (the ISSUE's acceptance
+        criterion; conftest's autouse fixture restores the suite's
+        ``.jax_cache`` afterwards)."""
+        d = compilecache.configure(str(tmp_path))
+        assert compilecache.is_configured()
+        assert d is not None and d.startswith(str(tmp_path))
+
+        c = cfg(output="reduce", block_impl="scan", duration_s=1200,
+                blocks_per_dispatch=2)
+        reg1 = MetricsRegistry()
+        with use_registry(reg1):
+            sim = Simulation(c)
+        assert sim._k_dispatch == 2
+        s1 = reg1.snapshot()["counters"]
+        n_targets = len(sim.aot_targets())
+        assert n_targets == 2  # scan_acc + the k=2 mega jit
+        assert s1.get("executor.aot_warmup_total", 0) == n_targets
+        assert s1.get("executor.aot_warmup_errors_total", 0) == 0
+        cache_files = [f for _, _, fns in os.walk(str(tmp_path)) for f in fns]
+        assert cache_files, "AOT warm-up left the cache dir empty"
+
+        reg2 = MetricsRegistry()
+        with use_registry(reg2):
+            Simulation(c)
+        s2 = reg2.snapshot()["counters"]
+        assert s2.get("executor.compile_warm_total", 0) == n_targets
+        assert s2.get("executor.compile_cold_total", 0) == 0
+
+        doc = compilecache.executor_doc(reg2)
+        assert doc["compile_warm"] == n_targets
+        assert doc["compile_cold"] == 0
+        assert doc["aot_warmup"] == n_targets
+        assert doc["cache_dir"] == d
+
+    def test_off_spellings_disable(self):
+        assert compilecache.configure("off") is None
+        assert not compilecache.is_configured()
+        assert compilecache.cache_dir() is None
+        # unconfigured -> Simulation build must not pay AOT warm-up
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            Simulation(cfg(output="reduce", block_impl="scan",
+                           duration_s=1200))
+        assert "executor.aot_warmup_total" not in reg.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# multi-block fused dispatch: bit-identity vs per-block dispatch
+# ---------------------------------------------------------------------------
+
+class TestFusedDispatchBitIdentity:
+    @pytest.mark.parametrize("impl", ["wide", "scan", "scan2"])
+    def test_reduce_matches_per_block(self, impl):
+        base = Simulation(cfg(output="reduce", block_impl=impl)).run_reduced()
+        for k in (2, 3):  # k=3 divides the 3 blocks; k=2 leaves a remainder
+            sim = Simulation(cfg(output="reduce", block_impl=impl,
+                                 blocks_per_dispatch=k))
+            assert sim._k_dispatch == k
+            assert sim.plan.blocks_per_dispatch == k
+            eq_tree(base, sim.run_reduced(), f"reduce {impl} k={k}")
+
+    @pytest.mark.parametrize("impl", ["wide", "scan", "scan2"])
+    def test_ensemble_matches_per_block(self, impl):
+        e1 = ens_arrays(Simulation(cfg(output="ensemble", block_impl=impl)))
+        e2 = ens_arrays(Simulation(cfg(output="ensemble", block_impl=impl,
+                                       blocks_per_dispatch=2)))
+        assert len(e1) == len(e2) == 3
+        for i, (x, y) in enumerate(zip(e1, e2)):
+            np.testing.assert_array_equal(x[0], y[0],
+                                          err_msg=f"ens {impl} meter b{i}")
+            if impl == "wide":  # one-ulp CPU epilogue caveat (module doc)
+                np.testing.assert_allclose(x[1], y[1], rtol=3e-7, atol=0,
+                                           err_msg=f"ens {impl} pv b{i}")
+            else:
+                np.testing.assert_array_equal(x[1], y[1],
+                                              err_msg=f"ens {impl} pv b{i}")
+
+    def test_trace_matches_per_block(self):
+        b1 = list(Simulation(cfg()).run_blocks())
+        b2 = list(Simulation(cfg(blocks_per_dispatch=3)).run_blocks())
+        assert len(b1) == len(b2) == 3
+        for x, y in zip(b1, b2):
+            np.testing.assert_array_equal(x.meter, y.meter)
+            np.testing.assert_array_equal(x.epoch, y.epoch)
+            # one-ulp CPU epilogue caveat on the wide producer (module doc)
+            np.testing.assert_allclose(x.pv, y.pv, rtol=3e-7, atol=0)
+
+    def test_reduce_with_telemetry_matches_per_block(self):
+        b = Simulation(cfg(output="reduce", block_impl="scan",
+                           telemetry="light")).run_reduced()
+        g = Simulation(cfg(output="reduce", block_impl="scan",
+                           telemetry="light",
+                           blocks_per_dispatch=3)).run_reduced()
+        eq_tree(b, g, "reduce telemetry k=3")
+
+    def test_on_block_sees_per_block_acc_snapshots(self):
+        """The mega path still surfaces one accumulator snapshot per
+        BLOCK (not per dispatch), each bit-identical to per-block
+        folding.  on_block pytrees are borrowed (run_reduced docstring):
+        the donated carry reuses the buffer a zero-copy np.asarray view
+        would alias, so snapshots must copy with np.array."""
+        snap1, snap2 = [], []
+        Simulation(cfg(output="reduce", block_impl="scan")).run_reduced(
+            on_block=lambda bi, st, acc: snap1.append(
+                jax.tree.map(np.array, acc)))
+        Simulation(cfg(output="reduce", block_impl="scan",
+                       blocks_per_dispatch=3)).run_reduced(
+            on_block=lambda bi, st, acc: snap2.append(
+                jax.tree.map(np.array, acc)))
+        assert len(snap1) == len(snap2) == 3
+        for i, (a, b) in enumerate(zip(snap1, snap2)):
+            eq_tree(a, b, f"on_block snapshot {i}")
+
+    def test_dispatch_counters(self):
+        """k blocks per dispatch -> ceil(n_blocks / k) dispatches, while
+        engine.blocks_total still counts blocks."""
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            Simulation(cfg(output="reduce", block_impl="scan",
+                           blocks_per_dispatch=2)).run_reduced()
+        c = reg.snapshot()["counters"]
+        assert c["engine.blocks_total"] == 3
+        assert c["executor.dispatches_total"] == 2  # mega [0,1] + block 2
+
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            Simulation(cfg(output="reduce",
+                           block_impl="scan")).run_reduced()
+        c = reg.snapshot()["counters"]
+        assert c["engine.blocks_total"] == 3
+        assert c["executor.dispatches_total"] == 3
+
+
+class TestShardedFusedDispatch:
+    def test_sharded_reduce_matches_per_block(self):
+        from tmhpvsim_tpu.parallel.mesh import ShardedSimulation
+
+        b = ShardedSimulation(cfg(output="reduce", block_impl="scan",
+                                  n_chains=8)).run_reduced()
+        g = ShardedSimulation(cfg(output="reduce", block_impl="scan",
+                                  n_chains=8,
+                                  blocks_per_dispatch=3)).run_reduced()
+        eq_tree(b, g, "sharded reduce k=3")
+
+    def test_sharded_ensemble_matches_per_block(self):
+        from tmhpvsim_tpu.parallel.mesh import ShardedSimulation
+
+        e1 = ens_arrays(ShardedSimulation(cfg(output="ensemble",
+                                              block_impl="scan",
+                                              n_chains=8)))
+        e2 = ens_arrays(ShardedSimulation(cfg(output="ensemble",
+                                              block_impl="scan", n_chains=8,
+                                              blocks_per_dispatch=2)))
+        assert len(e1) == len(e2) == 3
+        for i, (x, y) in enumerate(zip(e1, e2)):
+            np.testing.assert_array_equal(x[0], y[0],
+                                          err_msg=f"shard ens meter b{i}")
+            np.testing.assert_array_equal(x[1], y[1],
+                                          err_msg=f"shard ens pv b{i}")
+
+
+# ---------------------------------------------------------------------------
+# buffer donation: caller-held resume pytrees survive the donated paths
+# ---------------------------------------------------------------------------
+
+def _materialize(leaf):
+    if hasattr(leaf, "dtype") and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key):
+        return np.asarray(jax.random.key_data(leaf))
+    return np.asarray(leaf)
+
+
+class TestDonation:
+    def test_caller_held_resume_refs_survive(self):
+        """run_reduced donates its state/accumulator carries, but a
+        caller-provided resume tree must stay readable afterwards (the
+        defensive copy in the dispatch loop, simulation.py) — resume
+        checkpoints are saved from exactly these references."""
+        sim = Simulation(cfg(output="reduce", block_impl="scan"))
+        sim.run_reduced()
+        st = sim.state                      # caller-held device pytrees
+        acc_dev = sim._last_acc
+        acc_np = {k: np.asarray(v) for k, v in acc_dev.items()}
+
+        sim2 = Simulation(cfg(output="reduce", block_impl="scan",
+                              duration_s=3600, blocks_per_dispatch=2))
+        sim2.run_reduced(state=st, acc=acc_dev, start_block=3)
+
+        # every caller-held buffer must still be alive (donation would
+        # raise "Array has been deleted" here) and bit-unchanged
+        jax.tree.map(_materialize, st)
+        for k, v in acc_np.items():
+            np.testing.assert_array_equal(v, np.asarray(acc_dev[k]))
+
+
+# ---------------------------------------------------------------------------
+# checkpointing across megablock boundaries
+# ---------------------------------------------------------------------------
+
+class TestCheckpointMidMegablock:
+    def test_restore_lands_on_correct_block_boundary(self, tmp_path):
+        """With fused dispatch the device state only advances at
+        megablock boundaries, so the app-side save gate
+        (``sim.state_block == bi + 1``) must skip the interior blocks of
+        a megablock and fire exactly at its boundary; resuming from that
+        checkpoint must match an uninterrupted per-block run bit for
+        bit."""
+        c4 = dict(output="reduce", block_impl="scan", duration_s=2400)
+        straight = Simulation(cfg(**c4)).run_reduced()
+
+        path = str(tmp_path / "mega.npz")
+        a = Simulation(cfg(blocks_per_dispatch=3, **c4))  # [0,1,2] + [3]
+        saves = []
+
+        class Stop(Exception):
+            pass
+
+        def save_then_crash(bi, state, acc):
+            if a.state_block == bi + 1:  # the apps/pvsim.py gate
+                ckpt.save(path, {"state": state, "acc": acc}, bi + 1,
+                          a.config)
+                saves.append(bi)
+                raise Stop
+
+        with pytest.raises(Stop):
+            a.run_reduced(on_block=save_then_crash)
+        # gate skipped the megablock interior (bi=0,1) and fired at its
+        # boundary: state_block was 3 throughout the first dispatch
+        assert saves == [2]
+
+        b = Simulation(cfg(blocks_per_dispatch=3, **c4))
+        tree, nb = ckpt.load(path, b.config)
+        assert nb == 3
+        resumed = b.run_reduced(state=tree["state"], acc=tree["acc"],
+                                start_block=nb)
+        eq_tree(straight, resumed, "mid-megablock checkpoint resume")
+
+
+# ---------------------------------------------------------------------------
+# run report: schema v4 round-trip + v1..v3 back-compat
+# ---------------------------------------------------------------------------
+
+class TestReportSchemaV4:
+    def _doc(self):
+        with use_registry(MetricsRegistry()):
+            sim = Simulation(cfg(output="reduce", block_impl="scan",
+                                 telemetry="light", blocks_per_dispatch=2))
+            sim.run_reduced()
+            return sim.run_report()
+
+    def test_v4_round_trips_with_executor_section(self):
+        doc = self._doc()
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 4
+        ex = doc["executor"]
+        assert ex["blocks_per_dispatch"] == 2
+        assert ex["dispatches"] == 2  # 3 blocks, k=2: mega [0,1] + block 2
+        validate_report(json.loads(json.dumps(doc)))
+
+    def test_v3_documents_still_validate(self):
+        """PR-4 builds wrote v3 docs without an executor section; the v4
+        validator must keep accepting them."""
+        doc = self._doc()
+        doc["schema_version"] = 3
+        doc.pop("executor", None)
+        validate_report(doc)
+
+    def test_v2_documents_still_validate(self):
+        doc = self._doc()
+        doc["schema_version"] = 2
+        doc.pop("executor", None)
+        doc.pop("streaming", None)
+        validate_report(doc)
+
+    def test_v1_documents_still_validate(self):
+        doc = self._doc()
+        doc["schema_version"] = 1
+        doc.pop("executor", None)
+        doc.pop("streaming", None)
+        doc.pop("telemetry", None)
+        validate_report(doc)
+
+
+# ---------------------------------------------------------------------------
+# autotune plan-cache back-compat (MIGRATION.md: old entries still load)
+# ---------------------------------------------------------------------------
+
+class TestPlanCacheBackCompat:
+    def test_pre_fused_dispatch_entries_still_load(self):
+        """Plan-cache entries persisted before blocks_per_dispatch
+        existed carry no such key; they must load as per-block
+        dispatch, not raise."""
+        from tmhpvsim_tpu.engine import autotune
+
+        plan = autotune._plan_from_entry({"plan": {
+            "block_impl": "scan", "scan_unroll": 1,
+            "stats_fusion": "fused", "slab_chains": 4096}})
+        assert plan.blocks_per_dispatch == 1
+        assert plan.source == "cache"
+
+    def test_malformed_dispatch_factor_rejected(self):
+        from tmhpvsim_tpu.engine import autotune
+
+        with pytest.raises(ValueError, match="malformed"):
+            autotune._plan_from_entry({"plan": {
+                "block_impl": "scan", "scan_unroll": 1,
+                "stats_fusion": "fused", "slab_chains": 4096,
+                "blocks_per_dispatch": 0}})
+
+
+# ---------------------------------------------------------------------------
+# acceptance (slow lane): fused dispatch is no slower than per-block
+# ---------------------------------------------------------------------------
+
+def test_fused_dispatch_no_slower_65536_chains():
+    """At the headline chain count, k=3 fused dispatch must not run
+    slower than per-block dispatch (both arms timed on their second,
+    compile-free run; 25% slack for timer noise on the shared CPU
+    host)."""
+    import time
+
+    def timed_second_run(k):
+        sim = Simulation(cfg(output="reduce", block_impl="scan",
+                             n_chains=65536, blocks_per_dispatch=k))
+        sim.run_reduced()              # compile + first dispatch
+        t0 = time.perf_counter()
+        sim.run_reduced()
+        return time.perf_counter() - t0
+
+    per_block = timed_second_run(1)
+    fused = timed_second_run(3)
+    assert fused <= per_block * 1.25, (fused, per_block)

@@ -579,11 +579,12 @@ def test_report_schema_v1_v2_still_validate():
     schemas keep validating against the current validator."""
     from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, RunReport
 
-    assert REPORT_SCHEMA_VERSION == 3
+    assert REPORT_SCHEMA_VERSION == 4
     doc = RunReport("test").doc()
     for old in (1, 2):
         legacy = {k: v for k, v in doc.items()
-                  if not (k == "streaming" and old < 3)
+                  if not (k == "executor" and old < 4)
+                  and not (k == "streaming" and old < 3)
                   and not (k == "telemetry" and old < 2)}
         legacy["schema_version"] = old
         validate_report(legacy)

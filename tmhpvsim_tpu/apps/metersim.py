@@ -151,7 +151,8 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange,
 async def metersim_main(amqp_url, exchange, realtime, seed=None,
                         duration_s=None, start=None,
                         backend: str = "asyncio",
-                        trace: Optional[str] = None) -> None:
+                        trace: Optional[str] = None,
+                        compile_cache: Optional[str] = None) -> None:
     """App orchestrator (metersim.py:64-77): producer + publisher tasks.
     ``backend='jax'`` swaps the per-second numpy producer for the
     device-batched one; the transport/publisher side is identical.
@@ -163,6 +164,11 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
     tracer = Tracer() if trace else None
     queue: asyncio.Queue = asyncio.Queue()
     if backend == "jax":
+        # persistent XLA cache: the block producer's jit deserialises
+        # from disk on the second run instead of recompiling
+        from tmhpvsim_tpu.engine import compilecache
+
+        compilecache.configure(compile_cache)
         read = asyncio.create_task(
             read_meter_values_jax(queue, realtime, seed, duration_s, start)
         )

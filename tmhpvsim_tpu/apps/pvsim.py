@@ -305,7 +305,9 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               telemetry_strict: bool = False,
               metrics_path: Optional[str] = None,
               run_report_path: Optional[str] = None,
-              trace: Optional[str] = None) -> None:
+              trace: Optional[str] = None,
+              compile_cache: Optional[str] = None,
+              blocks_per_dispatch: int = 0) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -366,7 +368,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 profile_dir=profile_dir, output=output,
                 prng_impl=prng_impl, block_impl=block_impl, tune=tune,
                 telemetry=telemetry, telemetry_strict=telemetry_strict,
-                trace=trace, tracer=tracer,
+                trace=trace, tracer=tracer, compile_cache=compile_cache,
+                blocks_per_dispatch=blocks_per_dispatch,
             )
         except (Exception, KeyboardInterrupt):
             if tracer:
@@ -387,6 +390,11 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     rep = RunReport("pvsim", config=sim.config, plan=sim.plan)
     rep.set_timing(summary)
     rep.attach_metrics(registry)
+    from tmhpvsim_tpu.engine import compilecache
+
+    ex = compilecache.executor_doc(registry)
+    if ex is not None:  # adds cache_dir to the counter section
+        rep.executor = ex
     rep.headline = {"site_seconds_per_s": summary["site_seconds_per_s"]}
     if getattr(sim, "sentinel", None) is not None:
         rep.telemetry = sim.sentinel.report()
@@ -417,7 +425,9 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    telemetry: str = "off",
                    telemetry_strict: bool = False,
                    trace: Optional[str] = None,
-                   tracer: Optional[Tracer] = None):
+                   tracer: Optional[Tracer] = None,
+                   compile_cache: Optional[str] = None,
+                   blocks_per_dispatch: int = 0):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
     the wrapper can assemble the run report from its config/plan/timer."""
     import contextlib
@@ -458,6 +468,13 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         logger.info("multi-host run (%d processes): output %s",
                     jax.process_count(), file)
 
+    # Persistent compilation cache + AOT warm-up: must be configured
+    # BEFORE the Simulation is constructed (the warm-up hook runs in
+    # __init__).  None resolves env var/default dir; 'off' disables.
+    from tmhpvsim_tpu.engine import compilecache
+
+    compilecache.configure(compile_cache)
+
     if start is None:
         start = _dt.datetime.now().replace(microsecond=0).isoformat(" ")
     if block_s is None:
@@ -476,6 +493,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         telemetry=telemetry,
         telemetry_strict=telemetry_strict,
         trace=trace,
+        blocks_per_dispatch=blocks_per_dispatch,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation
@@ -487,8 +505,9 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
     plan = sim.plan
     logger.info(
         "plan [%s]: block_impl=%s scan_unroll=%d stats_fusion=%s "
-        "slab_chains=%d", plan.source, plan.block_impl, plan.scan_unroll,
-        plan.stats_fusion, plan.slab_chains,
+        "slab_chains=%d blocks_per_dispatch=%d", plan.source,
+        plan.block_impl, plan.scan_unroll, plan.stats_fusion,
+        plan.slab_chains, plan.blocks_per_dispatch,
     )
     if checkpoint and plan.slab_chains < cfg.n_chains:
         # a slabbed run has no single resumable state pytree; checkpointed
@@ -525,7 +544,13 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             if tracer:
                 tracer.instant("block", "engine", block=bi)
             reg.flush(event="block")
-            if checkpoint:
+            # state_block gate: under a fused multi-block dispatch
+            # (blocks_per_dispatch > 1) sim.state only advances at
+            # megablock boundaries — saving mid-megablock would pair
+            # block bi's accumulator with a later state.  state_block ==
+            # bi + 1 holds exactly when `state` IS the state after block
+            # bi (always true per-block).
+            if checkpoint and sim.state_block == bi + 1:
                 # host_local_tree: on a pod slice each host saves only its
                 # chain slice (the per-host file this process owns)
                 ckpt.save(checkpoint,
@@ -617,8 +642,11 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                 yield blk
             # control returns here after write_csv wrote (and line-flushed)
             # this block's rows — only then is the checkpoint advanced, so
-            # a crash can duplicate work but never lose rows
-            if checkpoint:
+            # a crash can duplicate work but never lose rows.  The
+            # state_block gate (see reduce mode above) keeps saves on
+            # megablock boundaries under blocks_per_dispatch > 1, where
+            # sim.state is ahead of mid-megablock bi values.
+            if checkpoint and sim.state_block == bi + 1:
                 ckpt.save(checkpoint, sim.host_local_tree(sim.state),
                           bi + 1, cfg)
 

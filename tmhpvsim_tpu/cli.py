@@ -122,15 +122,24 @@ def fanoutbroker(host, port, verbose):
               default="asyncio",
               help="asyncio: per-second numpy sampling (reference); jax: "
                    "device-batched blocks feeding the same publisher")
+@click.option("--compile-cache", "compile_cache", default=None,
+              metavar="DIR",
+              help="Persistent XLA compilation-cache base directory (jax "
+                   "backend; a per-device-kind subdir is created under "
+                   "it).  Unset: $TMHPVSIM_COMPILE_CACHE, else "
+                   "~/.cache/tmhpvsim_tpu/xla; 'off' disables "
+                   "(engine/compilecache.py)")
 def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
-             trace, backend):
+             trace, backend, compile_cache):
     """1 Hz electricity-demand producer (reference metersim.py:79-95)."""
     from tmhpvsim_tpu.apps.metersim import metersim_main
 
     _setup_logging(verbose)
+    if compile_cache is not None and backend != "jax":
+        raise click.UsageError("--compile-cache requires --backend=jax")
     asyncrun(metersim_main(amqp_url, exchange, realtime, seed, duration_s,
                            _parse_start(start), backend=backend,
-                           trace=trace))
+                           trace=trace, compile_cache=compile_cache))
 
 
 @click.command()
@@ -213,11 +222,28 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "asyncio backend's report carries the 'streaming' "
                    "section (join latency quantiles, funnel/broker/retry "
                    "counters)")
+@click.option("--compile-cache", "compile_cache", default=None,
+              metavar="DIR",
+              help="Persistent XLA compilation-cache base directory (jax "
+                   "backend; a per-device-kind subdir is created under "
+                   "it, and the resolved plan's block functions are "
+                   "AOT-warmed into it at build time).  Unset: "
+                   "$TMHPVSIM_COMPILE_CACHE, else "
+                   "~/.cache/tmhpvsim_tpu/xla; 'off' disables "
+                   "(engine/compilecache.py)")
+@click.option("--blocks-per-dispatch", "blocks_per_dispatch", type=int,
+              default=0,
+              help="Blocks fused into one device dispatch (jax backend): "
+                   "0 = auto (per-block, or the autotuner's probed choice "
+                   "under --tune); K > 1 runs K blocks as one jitted scan "
+                   "— bit-identical results, fewer host round-trips "
+                   "(config.SimConfig.blocks_per_dispatch)")
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, trace, backend, n_chains, chain, sharded, checkpoint,
           block_s, site_grid_spec, sites_csv, profile_dir, output,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
-          metrics_path, run_report_path):
+          metrics_path, run_report_path, compile_cache,
+          blocks_per_dispatch):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     if (site_grid_spec or sites_csv) and backend != "jax":
@@ -238,6 +264,11 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--tune requires --backend=jax")
     if (telemetry != "off" or telemetry_strict) and backend != "jax":
         raise click.UsageError("--telemetry requires --backend=jax")
+    if compile_cache is not None and backend != "jax":
+        raise click.UsageError("--compile-cache requires --backend=jax")
+    if blocks_per_dispatch != 0 and backend != "jax":
+        raise click.UsageError("--blocks-per-dispatch requires "
+                               "--backend=jax")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
@@ -277,7 +308,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   telemetry_strict=telemetry_strict,
                   metrics_path=metrics_path,
                   run_report_path=run_report_path,
-                  trace=trace)
+                  trace=trace, compile_cache=compile_cache,
+                  blocks_per_dispatch=blocks_per_dispatch)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

@@ -246,6 +246,17 @@ class Plan:
     #: entries never persist it (engine/autotune.py re-applies the
     #: config's request on every cache hit).
     telemetry: str = "off"
+    #: blocks executed per device dispatch (the multi-block fused
+    #: dispatch, engine/simulation.py): K consecutive blocks run as one
+    #: outer lax.scan inside a single jit, eliminating K-1 host
+    #: round-trips per dispatch.  Always >= 1 here (SimConfig's 0 = auto
+    #: is resolved by engine/autotune.py).  Purely a dispatch-granularity
+    #: knob: per-block accumulator snapshots and telemetry deltas are
+    #: stacked out of the scan, so checkpoints, the drift sentinel and
+    #: trace instants keep their per-block boundaries and the outputs are
+    #: bit-identical to per-block dispatch (tested in
+    #: tests/test_executor.py).
+    blocks_per_dispatch: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -336,6 +347,16 @@ class SimConfig:
     #: (measured on TPU v5e: the split path writes + re-reads ~566 MB per
     #: 65536x1080 block).  'auto' picks fused on accelerators, split on CPU.
     stats_fusion: str = "auto"
+
+    #: blocks per device dispatch for reduce/ensemble/trace loops: K
+    #: consecutive blocks run as one outer lax.scan inside a single jit
+    #: (engine/simulation.py), so the host pays one dispatch + one sync
+    #: per K blocks instead of per block.  0 = auto (resolve statically
+    #: to 1; under ``tune='auto'``/``'force'`` the autotuner probes it as
+    #: a grid axis).  Values >= 1 are used as-is.  Output is
+    #: bit-identical to per-block dispatch; checkpoints land on megablock
+    #: boundaries (apps gate saves on ``Simulation.state_block``).
+    blocks_per_dispatch: int = 0
 
     #: runtime autotuning of the performance knobs (engine/autotune.py).
     #: 'off'   -> resolve 'auto' knobs statically (backend heuristics; the

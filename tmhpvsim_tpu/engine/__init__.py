@@ -1,4 +1,5 @@
 """Blockwise simulation engine (single-host orchestration layer)."""
 
+from tmhpvsim_tpu.engine import compilecache  # noqa: F401
 from tmhpvsim_tpu.engine.simulation import Simulation, BlockResult  # noqa: F401
 from tmhpvsim_tpu.engine.slab import SlabScheduler  # noqa: F401

@@ -54,12 +54,23 @@ CANDIDATE_UNROLLS = (1, 4, 8, 12)
 #: slab sizes; None means n_chains (no slabbing).  65536 is the measured
 #: single-chip sweet spot, 16384 a guard for smaller-VMEM parts.
 CANDIDATE_SLAB_CHAINS = (None, 65536, 16384)
+#: blocks fused per device dispatch (engine/simulation.py
+#: ``blocks_per_dispatch``), probed as a fourth grid axis when
+#: ``SimConfig.blocks_per_dispatch`` is left 0 (auto)
+CANDIDATE_BLOCKS_PER_DISPATCH = (1, 4)
 
 #: steady blocks timed per probe (after the one compile/warm-up block)
 PROBE_TIMED_BLOCKS = 2
 
 #: probes performed by this process (tests assert cache hits via this)
 PROBE_COUNT = 0
+
+#: compile seconds of the most recent real probe — cache-WARM when the
+#: persistent compile cache (engine/compilecache.py) is configured, so
+#: the plan-cache entry records what a warm start actually costs.
+#: probe_grid copies it into each candidate record; None after a
+#: monkeypatched/fake probe.
+LAST_PROBE_COMPILE_S = None
 
 
 # ---------------------------------------------------------------------------
@@ -113,6 +124,9 @@ def static_plan(config: SimConfig) -> Plan:
         slab_chains=config.n_chains,
         source="static",
         telemetry=_resolve_telemetry(config),
+        # 0 (auto) resolves to per-block dispatch without measurement;
+        # the fused dispatch only enters statically when pinned
+        blocks_per_dispatch=max(1, config.blocks_per_dispatch),
     )
 
 
@@ -123,25 +137,40 @@ def static_plan(config: SimConfig) -> Plan:
 
 def time_reduce_blocks(sim, n_blocks: int, n_rounds: int = 1,
                        profile_dir=None, expect_platform=None):
-    """(compile_s, best_steady_s, rate): one warm-up block, then n_rounds x
-    n_blocks timed reduce-mode blocks through the public step_acc path,
-    best round kept (the tunnel TPU's throughput varies ~2x between
-    otherwise identical runs).  ``sim.n_blocks`` must cover
-    1 + n_blocks*n_rounds blocks; rate is simulated site-seconds per wall
-    second.  ``expect_platform`` arms the device-trace platform guard
-    when ``profile_dir`` is set (obs/profiler.py)."""
+    """(compile_s, best_steady_s, rate): one warm-up dispatch, then
+    n_rounds x n_blocks timed reduce-mode dispatches through the public
+    step_acc path, best round kept (the tunnel TPU's throughput varies
+    ~2x between otherwise identical runs).  A sim resolved to
+    ``blocks_per_dispatch=k > 1`` is timed the way it actually runs —
+    each dispatch is one ``step_acc_multi`` megablock covering k blocks,
+    and the rate credits all of them — so ``sim.n_blocks`` must cover
+    ``k * (1 + n_blocks*n_rounds)`` blocks; rate is simulated
+    site-seconds per wall second.  ``expect_platform`` arms the
+    device-trace platform guard when ``profile_dir`` is set
+    (obs/profiler.py)."""
     import contextlib
 
     import jax
 
     from tmhpvsim_tpu.engine.simulation import InputPrefetcher
 
+    k = max(1, getattr(sim, "_k_dispatch", 1))
     sim.state = sim.init_state()
     acc = sim.init_reduce_acc()
     pf = InputPrefetcher(sim, 0, sim.n_blocks)
+
+    def dispatch(bi, acc):
+        if k == 1:
+            inputs, _ = pf.get(bi)
+            sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+        else:
+            ins = [pf.get(b)[0] for b in range(bi, bi + k)]
+            out = sim.step_acc_multi(sim.state, ins, acc)
+            sim.state, acc = out[0], out[1]
+        return acc
+
     t_c = time.perf_counter()
-    inputs, _ = pf.get(0)
-    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+    acc = dispatch(0, acc)
     jax.block_until_ready(acc)
     compile_s = time.perf_counter() - t_c
 
@@ -152,22 +181,21 @@ def time_reduce_blocks(sim, n_blocks: int, n_rounds: int = 1,
         trace = device_trace(profile_dir, expect_platform=expect_platform)
 
     best = float("inf")
-    bi = 1
+    bi = k
     try:
         with trace:
             for _ in range(n_rounds):
                 t0 = time.perf_counter()
                 for _ in range(n_blocks):
-                    inputs, _ = pf.get(bi)
-                    bi += 1
-                    sim.state, acc = sim.step_acc(sim.state, inputs, acc)
+                    acc = dispatch(bi, acc)
+                    bi += k
                 jax.block_until_ready(acc)
                 best = min(best, time.perf_counter() - t0)
     finally:
         pf.close()
     n = sim.config.n_chains
     bs = sim.config.block_s
-    return compile_s, best, n * bs * n_blocks / best
+    return compile_s, best, n * bs * n_blocks * k / best
 
 
 def probe_plan(config: SimConfig, plan: Plan,
@@ -181,9 +209,11 @@ def probe_plan(config: SimConfig, plan: Plan,
     timed path as bench.py's variants.  The candidate Simulation goes out
     of scope before the next candidate compiles, freeing its device
     buffers (HBM-residency poisoning, module docstring)."""
+    global LAST_PROBE_COMPILE_S
     from tmhpvsim_tpu.engine.simulation import Simulation
 
     n = min(config.n_chains, plan.slab_chains)
+    k = max(1, plan.blocks_per_dispatch)
     pcfg = dataclasses.replace(
         config,
         tune="off",
@@ -191,7 +221,9 @@ def probe_plan(config: SimConfig, plan: Plan,
         n_chains_total=None,
         chain_offset=0,
         site_grid=slice_grid(config.site_grid, 0, n),
-        duration_s=config.block_s * (n_timed + 1),
+        # k blocks per dispatch: the probe must cover one warm-up
+        # dispatch plus n_timed timed ones (time_reduce_blocks)
+        duration_s=config.block_s * k * (n_timed + 1),
         output="reduce",
     )
     from tmhpvsim_tpu.obs import metrics as obs_metrics
@@ -200,7 +232,8 @@ def probe_plan(config: SimConfig, plan: Plan,
     obs_metrics.get_registry().counter("autotune.probes_total").inc()
     sim = Simulation(pcfg, plan=dataclasses.replace(plan, slab_chains=n))
     with annotate("tmhpvsim/autotune.probe"):
-        _, _, rate = time_reduce_blocks(sim, n_timed, 1)
+        compile_s, _, rate = time_reduce_blocks(sim, n_timed, 1)
+    LAST_PROBE_COMPILE_S = compile_s
     del sim  # free device buffers before the next candidate compiles
     return rate
 
@@ -219,13 +252,20 @@ def candidate_plans(config: SimConfig, slabs: bool = True) -> list:
         n = config.n_chains if s is None else min(s, config.n_chains)
         if n > 0 and n not in slab_sizes:
             slab_sizes.append(n)
+    # fourth axis: blocks fused per dispatch — probed only when the
+    # config leaves it 0 (auto); an explicit pin is respected like a
+    # pinned block_impl
+    kds = (CANDIDATE_BLOCKS_PER_DISPATCH if config.blocks_per_dispatch == 0
+           else (max(1, config.blocks_per_dispatch),))
     telemetry = _resolve_telemetry(config)
     return [
         Plan(block_impl=impl, scan_unroll=u, stats_fusion=fusion,
-             slab_chains=slab, source="probe", telemetry=telemetry)
+             slab_chains=slab, source="probe", telemetry=telemetry,
+             blocks_per_dispatch=kd)
         for impl in impls
         for u in CANDIDATE_UNROLLS
         for slab in slab_sizes
+        for kd in kds
     ]
 
 
@@ -236,7 +276,7 @@ def probe_grid(config: SimConfig, slabs: bool = True) -> tuple:
     skipped; if every candidate fails the static plan is returned so a
     broken probe environment degrades to the historical behaviour instead
     of killing the run."""
-    global PROBE_COUNT
+    global PROBE_COUNT, LAST_PROBE_COMPILE_S
     best = None
     records = []
     for plan in candidate_plans(config, slabs=slabs):
@@ -246,7 +286,9 @@ def probe_grid(config: SimConfig, slabs: bool = True) -> tuple:
             "scan_unroll": plan.scan_unroll,
             "stats_fusion": plan.stats_fusion,
             "slab_chains": plan.slab_chains,
+            "blocks_per_dispatch": plan.blocks_per_dispatch,
         }
+        LAST_PROBE_COMPILE_S = None
         try:
             rate = probe_plan(config, plan)
         except Exception as e:
@@ -255,10 +297,13 @@ def probe_grid(config: SimConfig, slabs: bool = True) -> tuple:
             records.append(rec)
             continue
         rec["rate"] = round(rate, 1)
+        if LAST_PROBE_COMPILE_S is not None:
+            # cache-warm when the persistent compile cache is on
+            rec["compile_s"] = round(LAST_PROBE_COMPILE_S, 3)
         records.append(rec)
-        logger.info("autotune probe impl=%s unroll=%d slab=%d: %.3g "
-                    "site-s/s", plan.block_impl, plan.scan_unroll,
-                    plan.slab_chains, rate)
+        logger.info("autotune probe impl=%s unroll=%d slab=%d kd=%d: "
+                    "%.3g site-s/s", plan.block_impl, plan.scan_unroll,
+                    plan.slab_chains, plan.blocks_per_dispatch, rate)
         if best is None or rate > best[1]:
             best = (plan, rate)
     if best is None:
@@ -312,10 +357,14 @@ def _plan_from_entry(entry: dict) -> Plan:
         stats_fusion=str(p["stats_fusion"]),
         slab_chains=int(p["slab_chains"]),
         source="cache",
+        # entries persisted before the fused dispatch existed have no
+        # blocks_per_dispatch key; they keep meaning per-block dispatch
+        blocks_per_dispatch=int(p.get("blocks_per_dispatch", 1)),
     )
     if plan.block_impl not in ("wide", "scan", "scan2") or \
             plan.stats_fusion not in ("fused", "split") or \
-            plan.scan_unroll < 1 or plan.slab_chains < 1:
+            plan.scan_unroll < 1 or plan.slab_chains < 1 or \
+            plan.blocks_per_dispatch < 1:
         raise ValueError(f"malformed cached plan {p!r}")
     return plan
 
@@ -326,16 +375,28 @@ def _store_plan(path: str, key: str, plan: Plan, candidates: list) -> None:
     logged, not raised — the plan is already resolved."""
     try:
         cache = _load_cache(path)
-        cache[key] = {
+        entry = {
             "plan": {
                 "block_impl": plan.block_impl,
                 "scan_unroll": plan.scan_unroll,
                 "stats_fusion": plan.stats_fusion,
                 "slab_chains": plan.slab_chains,
+                "blocks_per_dispatch": plan.blocks_per_dispatch,
             },
             "candidates": candidates,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
+        # surface the winner's (cache-warm) compile time at entry level
+        for c in candidates:
+            if (c.get("block_impl") == plan.block_impl
+                    and c.get("scan_unroll") == plan.scan_unroll
+                    and c.get("slab_chains") == plan.slab_chains
+                    and c.get("blocks_per_dispatch",
+                              1) == plan.blocks_per_dispatch
+                    and c.get("compile_s") is not None):
+                entry["compile_s"] = c["compile_s"]
+                break
+        cache[key] = entry
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                    suffix=".tmp")
@@ -383,11 +444,19 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
         if entry is not None:
             try:
                 # cache entries never persist telemetry (not a tuned
-                # knob); re-apply this config's request
-                return dataclasses.replace(
+                # knob); re-apply this config's request.  An explicit
+                # blocks_per_dispatch pin (>= 1) also overrides whatever
+                # an earlier auto probe persisted under this key.
+                plan = dataclasses.replace(
                     _plan_from_entry(entry),
                     telemetry=_resolve_telemetry(config),
                 )
+                if config.blocks_per_dispatch >= 1:
+                    plan = dataclasses.replace(
+                        plan,
+                        blocks_per_dispatch=config.blocks_per_dispatch,
+                    )
+                return plan
             except (KeyError, TypeError, ValueError) as e:
                 logger.warning("ignoring malformed autotune cache entry "
                                "for %s: %s", key, e)
@@ -414,6 +483,7 @@ def broadcast_plan(plan: Plan) -> Plan:
     enc = np.asarray([
         impls.index(plan.block_impl), plan.scan_unroll,
         plan.slab_chains, fusions.index(plan.stats_fusion),
+        plan.blocks_per_dispatch,
     ], dtype=np.int32)
     out = np.asarray(multihost_utils.broadcast_one_to_all(enc))
     source = plan.source if jax.process_index() == 0 else "broadcast"
@@ -425,6 +495,7 @@ def broadcast_plan(plan: Plan) -> Plan:
         source=source,
         # not broadcast: every process resolved the same config locally
         telemetry=plan.telemetry,
+        blocks_per_dispatch=int(out[4]),
     )
 
 

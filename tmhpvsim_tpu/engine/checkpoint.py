@@ -40,7 +40,10 @@ RNG_STREAM_VERSION = 3
 def _config_echo(config) -> dict:
     """The full run configuration as JSON-able data — including site and
     model options, whose silent divergence across a resume would change
-    physics/branch selection mid-trace."""
+    physics/branch selection mid-trace.  Performance knobs (block_impl,
+    scan_unroll, slab_chains, blocks_per_dispatch, ...) are deliberately
+    NOT echoed: every plan produces bit-identical trajectories, so a
+    resume may run under a different plan than the run that saved."""
     return {
         "start": config.start,
         "duration_s": config.duration_s,

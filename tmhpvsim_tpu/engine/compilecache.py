@@ -1,0 +1,235 @@
+"""Warm-start executor: persistent compilation cache + AOT plan warm-up.
+
+BENCH_r05 measured ~0.29 s steady blocks against 66.8-79.6 s compiles per
+variant on TPU v5e — a single cold compile exceeds the whole <60 s
+target budget.  This module removes that cost from every run after the
+first:
+
+* :func:`configure` enables JAX's on-disk compilation cache under a
+  per-device-kind subdirectory (a v5e executable is useless to a CPU
+  process and vice versa), with the entry-size/compile-time floors
+  lowered so EVERY executable is persisted and the warm/cold counters
+  below are exact, not sampled.
+* A process-global ``jax.monitoring`` listener maps the cache's
+  hit/miss events onto the metrics registry
+  (``executor.compile_warm_total`` / ``executor.compile_cold_total``).
+  The registry is resolved at event time, so per-run
+  ``obs.metrics.use_registry()`` isolation sees its own counts.
+* :func:`warm_up` AOT-compiles (``fn.lower(*abstract).compile()``) the
+  resolved :class:`~tmhpvsim_tpu.config.Plan`'s block functions from
+  abstract shapes at ``Simulation`` build time, populating the disk
+  cache before the first real dispatch.  ``Simulation.__init__`` calls
+  this automatically — but only when the cache has been configured, so
+  plain library use pays nothing.
+* :func:`executor_doc` snapshots the counters into the run report's
+  ``executor`` section (schema v4, obs/report.py).
+
+Cache-dir precedence: explicit argument > ``TMHPVSIM_COMPILE_CACHE`` >
+``$XDG_CACHE_HOME/tmhpvsim_tpu/xla`` (``~/.cache`` fallback).  The
+values ``off``/``none``/``0``/empty disable the cache entirely.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+#: environment override for the cache base directory (also honours the
+#: ``off`` spellings below) — lets the battery script and ``bench.py``
+#: child processes steer or disable the cache without new plumbing
+ENV_VAR = "TMHPVSIM_COMPILE_CACHE"
+
+#: spellings of "no cache" accepted by configure()/the env var/--compile-cache
+OFF_VALUES = frozenset({"off", "none", "0", ""})
+
+# process-global state: the persistent cache is a jax.config property,
+# so there is exactly one active cache dir per process
+_state = {"dir": None, "configured": False, "listener": None}
+
+
+def default_dir() -> str:
+    """``$XDG_CACHE_HOME/tmhpvsim_tpu/xla`` (mirrors autotune.cache_path)."""
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    return os.path.join(root, "tmhpvsim_tpu", "xla")
+
+
+def _device_kind_slug() -> str:
+    """Filesystem-safe slug of the primary device kind ('tpu-v5e',
+    'cpu', ...); 'unknown' when no backend is reachable."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    except Exception:  # no backend / not yet initialisable
+        kind = None
+    slug = "".join(
+        c if (c.isalnum() or c in "-_.") else "-" for c in (kind or "").lower()
+    ).strip("-")
+    return slug or "unknown"
+
+
+def cache_dir() -> Optional[str]:
+    """The active per-device-kind cache directory (None when disabled)."""
+    return _state["dir"]
+
+
+def is_configured() -> bool:
+    return _state["configured"]
+
+
+def _on_event(event: str, **kwargs) -> None:
+    # jax.monitoring fires these on the persistent-cache paths:
+    #   cache_hits   -> executable deserialised from disk (warm compile)
+    #   cache_misses -> freshly compiled and stored (cold compile)
+    # Resolve the registry at EVENT time so use_registry() scopes work.
+    if event == "/jax/compilation_cache/cache_hits":
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.get_registry().counter("executor.compile_warm_total").inc()
+    elif event == "/jax/compilation_cache/cache_misses":
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.get_registry().counter("executor.compile_cold_total").inc()
+
+
+def _install_listener() -> None:
+    if _state["listener"] is not None:
+        return
+    import jax
+
+    jax.monitoring.register_event_listener(_on_event)
+    _state["listener"] = _on_event
+
+
+def configure(base_dir: Optional[str] = None) -> Optional[str]:
+    """Enable the persistent compilation cache; returns the resolved
+    per-device-kind directory, or None when disabled.
+
+    ``base_dir`` precedence: explicit argument > :data:`ENV_VAR` >
+    :func:`default_dir`.  Any :data:`OFF_VALUES` spelling disables the
+    cache (and un-configures a previously configured one, so tests can
+    restore a clean state).
+    """
+    import jax
+
+    if base_dir is None:
+        base_dir = os.environ.get(ENV_VAR)
+        if base_dir is None:
+            base_dir = default_dir()
+    if str(base_dir).strip().lower() in OFF_VALUES:
+        if _state["configured"]:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _reset_cache_singleton()
+        _state["dir"] = None
+        _state["configured"] = False
+        return None
+
+    d = os.path.join(
+        os.path.abspath(os.path.expanduser(str(base_dir))), _device_kind_slug()
+    )
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # Floor removal: by default JAX only persists executables above a
+    # compile-time/entry-size threshold, which would make fast CPU test
+    # kernels invisible to the cache and the warm/cold counters wrong.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _reset_cache_singleton()
+    _install_listener()
+    _state["dir"] = d
+    _state["configured"] = True
+    logger.info("persistent compilation cache at %s", d)
+    return d
+
+
+def _reset_cache_singleton() -> None:
+    """Drop jax's in-process cache object so a dir change takes effect.
+
+    The on-disk cache is lazily materialised ONCE per process from
+    ``jax_compilation_cache_dir``; without this reset, a process that
+    already compiled something (and thereby initialised the cache
+    against the old dir — or against no dir at all) would silently keep
+    writing to the old location after :func:`configure`."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # pragma: no cover - private-API drift guard
+        logger.warning("compilation-cache reset unavailable: %s", e)
+
+
+def maybe_warm_up(sim) -> Optional[dict]:
+    """AOT warm-up hook for ``Simulation.__init__``: no-op unless
+    :func:`configure` has enabled the cache in this process."""
+    if not _state["configured"]:
+        return None
+    return warm_up(sim)
+
+
+def warm_up(sim) -> dict:
+    """AOT-compile the simulation's resolved block functions.
+
+    Iterates ``sim.aot_targets()`` — the (name, jitted fn, abstract
+    args) triples of the jits the resolved output mode will actually
+    dispatch — and runs ``fn.lower(*args).compile()`` on each.  The
+    compiled executables land in the persistent disk cache (AOT
+    compilation does not feed the jit call path's in-memory cache; its
+    value is that the first real dispatch deserialises instead of
+    compiling).  Per-target failures are non-fatal: warm-up is an
+    optimisation, never a correctness gate.
+    """
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.get_registry()
+    t0 = time.perf_counter()
+    compiled = 0
+    errors = 0
+    targets = []
+    try:
+        targets = list(sim.aot_targets())
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("AOT target enumeration failed: %s", e)
+        errors += 1
+    for name, fn, args in targets:
+        try:
+            fn.lower(*args).compile()
+            compiled += 1
+        except Exception as e:
+            errors += 1
+            logger.warning("AOT warm-up of %s failed: %s", name, e)
+    wall = time.perf_counter() - t0
+    if compiled:
+        reg.counter("executor.aot_warmup_total").inc(compiled)
+    if errors:
+        reg.counter("executor.aot_warmup_errors_total").inc(errors)
+    reg.gauge("executor.aot_warmup_s").add(wall)
+    return {
+        "targets": len(targets),
+        "compiled": compiled,
+        "errors": errors,
+        "wall_s": wall,
+    }
+
+
+def executor_doc(registry=None) -> Optional[dict]:
+    """Executor section for a run report (schema v4): warm/cold compile
+    counts, dispatch counts and AOT warm-up stats from ``registry``
+    (default: the process registry).  None when nothing executor-related
+    was recorded and no cache is configured — callers can attach it
+    unconditionally."""
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs import report as obs_report
+
+    reg = registry if registry is not None else obs_metrics.get_registry()
+    doc = obs_report.executor_section(reg.snapshot())
+    if doc is None and not _state["configured"]:
+        return None
+    doc = doc or {}
+    doc.setdefault("compile_warm", 0)
+    doc.setdefault("compile_cold", 0)
+    doc["cache_dir"] = _state["dir"]
+    return doc

@@ -81,6 +81,14 @@ def _round_up(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+#: identity jit WITHOUT donation: XLA may not alias a non-donated input
+#: to an output, so this returns fresh buffers.  The run loops pass
+#: caller-provided resume pytrees through it before the first donating
+#: dispatch, so donation never invalidates a reference the caller still
+#: holds (tests/test_executor.py).
+_copy_jit = jax.jit(lambda tree: tree)
+
+
 class InputPrefetcher:
     """Overlap host-side block precompute with device compute.
 
@@ -228,8 +236,11 @@ class Simulation:
         self._k_chains, _ = jax.random.split(root)
         self._block_jit = jax.jit(self._block_step, donate_argnums=0)
         self._stats_jit = jax.jit(self._block_stats)
+        # donate meter/pv too: the block arrays are dead after the fold
+        # (the tel path computes its fold BEFORE this jit), so their
+        # O(n_chains x block_s) buffers are reusable immediately
         self._stats_acc_jit = jax.jit(self._block_stats_acc,
-                                      donate_argnums=3)
+                                      donate_argnums=(0, 1, 3))
         #: reduce-mode fused path: producer + stats + merge in ONE jit so
         #: the (n_chains, block_s) meter/pv arrays never reach HBM (see
         #: SimConfig.stats_fusion); state and accumulator are donated so
@@ -276,6 +287,40 @@ class Simulation:
                 self._block_step_scan2_acc_tel, donate_argnums=(0, 2)
             )
             self._wide_tel_jit = jax.jit(self._wide_telemetry)
+        #: multi-block fused dispatch factor (Plan.blocks_per_dispatch):
+        #: K consecutive blocks run as one outer lax.scan in a single
+        #: jit, so the host pays one dispatch per K blocks.  getattr:
+        #: plans rebuilt from pre-v4 autotune cache entries may predate
+        #: the field.
+        self._k_dispatch = max(1, int(getattr(self.plan,
+                                              "blocks_per_dispatch", 1)))
+        #: memoized mega jits keyed by (kind, k) — the final partial
+        #: group of a run compiles a second (smaller-k) variant, so at
+        #: most two compiled shapes exist per kind per run
+        self._mega_jits = {}
+        #: block index B such that ``self.state`` is the state AFTER
+        #: block B-1 — i.e. blocks [0, B) are folded into it.  Under
+        #: multi-block dispatch the state only advances at megablock
+        #: boundaries while per-block results/callbacks still fire, so
+        #: checkpoint writers MUST gate saves on
+        #: ``sim.state_block == block_index + 1`` (apps/pvsim.py does).
+        self.state_block = 0
+        self._m_dispatch = self.metrics.counter("executor.dispatches_total")
+        self.metrics.gauge("executor.blocks_per_dispatch").set(
+            self._k_dispatch)
+        if not getattr(self, "_defer_warm_start", False):
+            self._warm_start()
+
+    def _warm_start(self) -> None:
+        """AOT plan warm-up (engine/compilecache.py): pre-lower and
+        compile the resolved plan's block functions so the persistent
+        compile cache is populated before the first real dispatch.
+        No-op unless ``compilecache.configure()`` ran in this process.
+        The sharded subclass sets ``_defer_warm_start`` and calls this
+        after rebinding its jits to the shard_map builds."""
+        from tmhpvsim_tpu.engine import compilecache
+
+        compilecache.maybe_warm_up(self)
 
     # ------------------------------------------------------------------
     # chain state
@@ -668,7 +713,9 @@ class Simulation:
                                residual=m - p)
 
         return self._iter_blocks(state, start_block, make,
-                                 block_jit=series_jit)
+                                 block_jit=series_jit,
+                                 mega_kind="series" if use_scan
+                                 else "trace")
 
     @staticmethod
     def _repl_view(arr) -> np.ndarray:
@@ -1094,42 +1141,355 @@ class Simulation:
         return state, acc
 
     # ------------------------------------------------------------------
+    # multi-block fused dispatch (Plan.blocks_per_dispatch > 1)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _is_block_arr(leaf) -> bool:
+        """Host-input leaves that vary per block and ride the mega scan
+        as stacked xs.  np.generic matters: numpy SCALARS (the minute
+        offset ``mlo``, the sampler window origins in ``win``) are not
+        ndarray instances but are strongly-typed per-block values —
+        treating them as constants would bake block 0's windows into
+        every block of the dispatch."""
+        return isinstance(leaf, (np.ndarray, np.generic, jax.Array))
+
+    def _split_inputs(self, ins):
+        """(xs, const) of a K-group of per-block ``host_inputs`` trees.
+
+        Array leaves stack with a leading K axis and become the outer
+        scan's xs; a scan slice of a stacked numpy scalar is a ()
+        strongly-typed value — exactly the aval the per-block jits see.
+        The remaining python-scalar leaves (shared-site geometry
+        constants like surface_tilt/albedo) ride as a separate
+        call-time ARGUMENT tree of the mega jit, so they trace as the
+        same weak-typed scalar tracers the per-block jits see.  Neither
+        stacking them (a strong float64 array — changes promotion) nor
+        baking them as closure constants (XLA constant-folds the
+        downstream transposition algebra and reassociates — observed
+        one-ulp pv differences vs the per-block path) preserves
+        bit-exactness.  Non-array leaves must be block-invariant — they
+        are site constants by construction, and this asserts it.
+        """
+        keep_const = \
+            lambda l: None if self._is_block_arr(l) else l  # noqa: E731
+        const = jax.tree.map(keep_const, ins[0])
+        for other in ins[1:]:
+            oc = jax.tree.map(keep_const, other)
+            if oc != const:
+                raise AssertionError(
+                    "non-array host-input leaves vary across the dispatch "
+                    f"group: {oc!r} != {const!r} — cannot bake them as "
+                    "mega-jit constants")
+        xs = jax.tree.map(
+            lambda *ls: np.stack(ls) if self._is_block_arr(ls[0]) else None,
+            *ins)
+        return xs, const
+
+    @staticmethod
+    def _merge_inputs(x, const):
+        """Re-assemble one block's input tree inside the mega scan body:
+        ``x`` is the scanned slice (None holes at constant positions),
+        ``const`` the baked constants (None holes at array positions)."""
+        return jax.tree.map(lambda c, v: v if c is None else c,
+                            const, x, is_leaf=lambda n: n is None)
+
+    def _mega_block_fn(self, kind: str):
+        """The RAW (untraced) per-block function the mega scan body runs
+        — the very computation the per-block jits wrap, so K-block
+        dispatch is bit-identical to per-block dispatch on the scan
+        family and for every reduce statistic (tested in
+        tests/test_executor.py).  One caveat on the WIDE producer's raw
+        per-second arrays (trace mode, wide ensemble): multi-device
+        XLA:CPU compiles a fusion embedded in a loop body with different
+        vector-epilogue boundaries than the same fusion at a jit root,
+        so pv can differ by one ulp at a handful of seconds per block
+        (observed only under ``--xla_force_host_platform_device_count``;
+        single-device CPU is exact, TPU tiling is context-independent).
+        The reduce folds absorb those ulps, which is why the reduce
+        contract stays exact even on the wide impl.
+        Kinds: 'acc' (reduce), 'acc_tel' (reduce + telemetry: returns a
+        third per-block TelemetryAcc delta), 'trace' (the wide
+        producer), 'series' (the scan-family ensemble step)."""
+        if kind == "acc":
+            if self._impl == "scan2":
+                return self._block_step_scan2_acc
+            if self._impl == "scan":
+                return self._block_step_scan_acc
+            if self._use_fused:
+                return self._step_acc_fused
+
+            def wide_split(state, inputs, acc):
+                # producer + fold composed in one trace: same float
+                # semantics as the split jits (XLA fusion does not
+                # reassociate; asserted for the fused topology in the
+                # slow lane)
+                state, meter, pv = self._block_step(state, inputs)
+                return state, self._block_stats_acc(
+                    meter, pv, inputs["block_idx"]["t"], acc)
+
+            return wide_split
+        if kind == "acc_tel":
+            if self._impl == "scan2":
+                return self._block_step_scan2_acc_tel
+            if self._impl == "scan":
+                return self._block_step_scan_acc_tel
+
+            def wide_tel(state, inputs, acc):
+                state, meter, pv = self._block_step(state, inputs)
+                t = inputs["block_idx"]["t"]
+                ta = self._wide_telemetry(meter, pv, t)
+                return state, self._block_stats_acc(meter, pv, t, acc), ta
+
+            return wide_tel
+        if kind == "trace":
+            return self._block_step
+        if kind == "series":
+            return (self._block_step_scan2_series if self._impl == "scan2"
+                    else self._block_step_scan_series)
+        raise ValueError(f"unknown mega-dispatch kind {kind!r}")
+
+    def _build_mega_acc(self, k: int, tel: bool):
+        """Jitted K-block reduce dispatch: outer lax.scan carrying
+        (state, acc), per-block accumulator snapshots (and telemetry
+        deltas) stacked out as ys so block boundaries stay observable.
+        State and accumulator are donated — the carries never need a
+        second HBM copy.  ``const`` is the block-invariant scalar tree
+        from ``_split_inputs``, an argument (not a closure) so its
+        python floats trace exactly as on the per-block path.
+        Overridden sharded: parallel/mesh.py puts the shard_map OUTSIDE
+        the scan."""
+        fn = self._mega_block_fn("acc_tel" if tel else "acc")
+
+        def mega(state, xs, acc, const):
+            def body(carry, x):
+                st, a = carry
+                inputs = self._merge_inputs(x, const)
+                if tel:
+                    st, a, ta = fn(st, inputs, a)
+                    return (st, a), (a, ta)
+                st, a = fn(st, inputs, a)
+                return (st, a), a
+
+            (state, acc), ys = jax.lax.scan(body, (state, acc), xs)
+            return state, acc, ys
+
+        return jax.jit(mega, donate_argnums=(0, 2))
+
+    def _build_mega_blocks(self, kind: str, k: int):
+        """Jitted K-block trace/series dispatch: outer scan carrying the
+        state, per-block (a, b) outputs stacked with a leading K axis
+        (sliced per block on the host side of ``_iter_blocks``).
+        ``const`` is an argument for the same bit-exactness reason as in
+        ``_build_mega_acc``."""
+        fn = self._mega_block_fn(kind)
+
+        def mega(state, xs, const):
+            def body(st, x):
+                st, a, b = fn(st, self._merge_inputs(x, const))
+                return st, (a, b)
+
+            state, (a_k, b_k) = jax.lax.scan(body, state, xs)
+            return state, a_k, b_k
+
+        return jax.jit(mega, donate_argnums=0)
+
+    def _mega_dispatch(self, kind: str, ins):
+        """(jitted mega fn, stacked xs, const scalar tree) for one group
+        of per-block input trees.  Jits are memoized per
+        (kind, len(ins)); const rides every call (block-invariant, see
+        ``_split_inputs``)."""
+        k = len(ins)
+        xs, const = self._split_inputs(ins)
+        key = (kind, k)
+        if key not in self._mega_jits:
+            if kind in ("acc", "acc_tel"):
+                self._mega_jits[key] = self._build_mega_acc(
+                    k, tel=(kind == "acc_tel"))
+            else:
+                self._mega_jits[key] = self._build_mega_blocks(kind, k)
+        return self._mega_jits[key], xs, const
+
+    def step_acc_multi(self, state, inputs_seq, acc):
+        """K reduce-mode blocks as ONE device dispatch (the multi-block
+        fused counterpart of :meth:`step_acc`): eliminates K-1 host
+        round-trips while the stacked per-block accumulator snapshots
+        (and telemetry deltas) keep every block boundary observable —
+        checkpoints, the drift sentinel and on_block callbacks see exact
+        block-boundary values.  Returns (state, acc, accs) — or
+        (state, acc, accs, tels) under telemetry — where accs/tels
+        leaves carry a leading len(inputs_seq) axis."""
+        tel_on = self._telemetry != "off"
+        mega, xs, const = self._mega_dispatch(
+            "acc_tel" if tel_on else "acc", list(inputs_seq))
+        state, acc, ys = mega(state, xs, acc, const)
+        if tel_on:
+            accs, tels = ys
+            return state, acc, accs, tels
+        return state, acc, ys
+
+    def aot_targets(self):
+        """(name, jitted fn, abstract args) triples of the jits the
+        resolved plan + output mode will actually dispatch — the AOT
+        warm-up surface (engine/compilecache.py ``warm_up``).  Args are
+        abstract (eval_shape + ShapeDtypeStructs of one real
+        ``host_inputs`` call), so enumeration never allocates
+        chain-sized buffers; python-scalar input leaves stay raw, which
+        lowers them as the same weak-typed scalars the live call passes.
+        """
+        state_abs = jax.eval_shape(self.init_state)
+        inputs, _ = self.host_inputs(0)
+        inputs_abs = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(np.shape(l),
+                                           np.asarray(l).dtype)
+            if self._is_block_arr(l) else l, inputs)
+        mode = self.config.output
+        tel_on = self._telemetry != "off"
+        out = []
+        if mode == "reduce":
+            acc_abs = jax.eval_shape(self.init_reduce_acc)
+            if self._impl == "scan2":
+                out.append(("scan2_acc",
+                            self._scan2_acc_tel_jit if tel_on
+                            else self._scan2_acc_jit,
+                            (state_abs, inputs_abs, acc_abs)))
+            elif self._impl == "scan":
+                out.append(("scan_acc",
+                            self._scan_acc_tel_jit if tel_on
+                            else self._scan_acc_jit,
+                            (state_abs, inputs_abs, acc_abs)))
+            elif self._use_fused and not tel_on:
+                out.append(("fused_acc", self._fused_acc_jit,
+                            (state_abs, inputs_abs, acc_abs)))
+            else:
+                _, m_abs, p_abs = jax.eval_shape(self._block_step,
+                                                 state_abs, inputs_abs)
+                t_abs = inputs_abs["block_idx"]["t"]
+                out.append(("block", self._block_jit,
+                            (state_abs, inputs_abs)))
+                if tel_on:
+                    out.append(("wide_tel", self._wide_tel_jit,
+                                (m_abs, p_abs, t_abs)))
+                out.append(("stats_acc", self._stats_acc_jit,
+                            (m_abs, p_abs, t_abs, acc_abs)))
+        elif mode == "ensemble":
+            if self._impl == "scan2":
+                out.append(("scan2_series", self._scan2_series_jit,
+                            (state_abs, inputs_abs)))
+            elif self._impl == "scan":
+                out.append(("scan_series", self._scan_series_jit,
+                            (state_abs, inputs_abs)))
+            else:
+                _, m_abs, p_abs = jax.eval_shape(self._block_step,
+                                                 state_abs, inputs_abs)
+                out.append(("block", self._block_jit,
+                            (state_abs, inputs_abs)))
+                out.append(("series", self._series_jit, (m_abs, p_abs)))
+        else:  # trace
+            out.append(("block", self._block_jit, (state_abs, inputs_abs)))
+        if self._k_dispatch > 1 and self.n_blocks >= self._k_dispatch:
+            out.extend(self._mega_aot_targets(inputs, state_abs, mode,
+                                              tel_on))
+        return out
+
+    def _mega_aot_targets(self, inputs, state_abs, mode, tel_on):
+        """AOT targets for the full-K mega jit of the configured output
+        mode (the final partial group, if any, compiles lazily — a small
+        one-off)."""
+        k = self._k_dispatch
+        kind = {"reduce": "acc_tel" if tel_on else "acc",
+                "ensemble": "series" if self._use_scan else "trace",
+                "trace": "trace"}[mode]
+        # K copies of block 0's inputs: right shapes/dtypes/constants
+        # for building + lowering; the stacked values are discarded,
+        # const's raw python scalars lower as the weak-typed scalars
+        # the live call passes
+        mega, _, const = self._mega_dispatch(kind, [inputs] * k)
+        xs_abs = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((k,) + np.shape(l),
+                                           np.asarray(l).dtype)
+            if self._is_block_arr(l) else None, inputs)
+        if kind in ("acc", "acc_tel"):
+            acc_abs = jax.eval_shape(self.init_reduce_acc)
+            return [(f"mega_{kind}[{k}]", mega,
+                     (state_abs, xs_abs, acc_abs, const))]
+        return [(f"mega_{kind}[{k}]", mega, (state_abs, xs_abs, const))]
+
+    # ------------------------------------------------------------------
     # run loops
     # ------------------------------------------------------------------
 
     def _iter_blocks(self, state, start_block: int, make_result,
-                     block_jit=None) -> Iterator[BlockResult]:
+                     block_jit=None, mega_kind: str = "trace"
+                     ) -> Iterator[BlockResult]:
         """THE per-block loop, shared by every trace-shaped mode (single
         and sharded run_blocks, run_ensemble in both formulations):
         init/place state, run the producer jit — ``block_jit`` overrides
         the default wide producer, any (state, inputs) -> (state, a, b)
         jit fits — trim grid padding, delegate the gather to
-        ``make_result(off, epoch, a, b, n_valid)``."""
+        ``make_result(off, epoch, a, b, n_valid)``.
+
+        With ``Plan.blocks_per_dispatch > 1``, K blocks run as one mega
+        jit (``mega_kind`` selects the per-block body matching
+        ``block_jit``) and the stacked per-block outputs are sliced into
+        the same ``make_result`` calls.  ``self.state`` then only
+        advances at megablock boundaries; consumers that checkpoint it
+        after a yielded block MUST gate on ``self.state_block ==
+        block_index + 1`` (apps/pvsim.py does)."""
         cfg = self.config
         jit = self._block_jit if block_jit is None else block_jit
         state = self.init_state() if state is None \
-            else self._place_resume(self._check_resume_layout(state))
+            else _copy_jit(self._place_resume(
+                self._check_resume_layout(state)))
         self.state = state
+        self.state_block = start_block
         pf = InputPrefetcher(self, start_block, self.n_blocks)
         # No dispatch-ahead here: consumers checkpoint ``self.state`` after
         # processing the yielded block (apps/pvsim.py), so the state must
-        # always correspond to the LAST YIELDED block.  Host/device overlap
-        # comes from the input prefetcher + async jax dispatch instead.
+        # always correspond to the last yielded MEGABLOCK.  Host/device
+        # overlap comes from the input prefetcher + async jax dispatch.
         self.timer.reset_clock()
+        k = self._k_dispatch
         try:
-            for bi in range(start_block, self.n_blocks):
-                inputs, epoch = pf.get(bi)
-                with annotate("tmhpvsim/block_step"):
-                    self.state, a, b = jit(self.state, inputs)
-                off = bi * cfg.block_s
-                n_valid = min(cfg.block_s, cfg.duration_s - off)
-                result = make_result(off, np.asarray(epoch[:n_valid]),
-                                     a, b, n_valid)
-                # the gather in make_result synchronised, so the tick
-                # bounds this block's dispatch+compute+gather wall
-                self.timer.tick()
-                self._m_blocks.inc()
-                yield result
+            bi = start_block
+            while bi < self.n_blocks:
+                kk = min(k, self.n_blocks - bi)
+                if kk == 1:
+                    inputs, epoch = pf.get(bi)
+                    with annotate("tmhpvsim/block_step"):
+                        self.state, a, b = jit(self.state, inputs)
+                    off = bi * cfg.block_s
+                    n_valid = min(cfg.block_s, cfg.duration_s - off)
+                    result = make_result(off, np.asarray(epoch[:n_valid]),
+                                         a, b, n_valid)
+                    self.state_block = bi + 1
+                    # the gather in make_result synchronised, so the tick
+                    # bounds this block's dispatch+compute+gather wall
+                    self.timer.tick()
+                    self._m_blocks.inc()
+                    self._m_dispatch.inc()
+                    yield result
+                else:
+                    got = [pf.get(b) for b in range(bi, bi + kk)]
+                    mega, xs, const = self._mega_dispatch(
+                        mega_kind, [g[0] for g in got])
+                    with annotate("tmhpvsim/mega_step"):
+                        self.state, a_k, b_k = mega(self.state, xs, const)
+                    self.state_block = bi + kk
+                    results = []
+                    for j in range(kk):
+                        off = (bi + j) * cfg.block_s
+                        n_valid = min(cfg.block_s, cfg.duration_s - off)
+                        results.append(make_result(
+                            off, np.asarray(got[j][1][:n_valid]),
+                            a_k[j], b_k[j], n_valid))
+                    # every make_result gathered, so one tick bounds the
+                    # whole dispatch+compute+gather wall of the K blocks
+                    self.timer.tick(n_blocks=kk)
+                    self._m_blocks.inc(kk)
+                    self._m_dispatch.inc()
+                    yield from results
+                bi += kk
         finally:
             pf.close()
 
@@ -1157,7 +1517,13 @@ class Simulation:
         Returns dict of (n_chains,) numpy arrays, one per ``REDUCE_STATS``
         entry.  ``on_block(block_index, state, acc)`` is called after each
         block's dispatch with that block's pytrees (timing/checkpoint
-        hooks).  ``acc``/``start_block`` resume a checkpointed run: the
+        hooks).  The pytrees are BORROWED — the accumulator carry is
+        donated to the next fold, which invalidates retained device
+        references and reuses the underlying buffer (a zero-copy
+        ``np.asarray`` view taken in the callback silently changes
+        value).  Consume them during the callback (``ckpt.save`` does)
+        or copy with ``np.array``.  ``acc``/``start_block`` resume a
+        checkpointed run: the
         accumulator is part of the saved state, so a resumed reduce run
         folds on where it left off (apps/pvsim.py).  Subclasses redirect
         the per-block work by overriding ``step_acc``, resume placement
@@ -1184,32 +1550,60 @@ class Simulation:
                 self._last_acc = reduced
                 return reduced
         state = self.init_state() if state is None \
-            else self._place_resume(self._check_resume_layout(state))
+            else _copy_jit(self._place_resume(
+                self._check_resume_layout(state)))
         self.state = state
+        self.state_block = start_block
+        # _copy_jit: the dispatch loop donates state and acc into every
+        # jit; a resumed caller's own reference must survive the run
         acc = self.init_reduce_acc() if acc is None \
-            else self._place_resume(self._check_resume_layout(
-                acc, self.init_reduce_acc, "acc"))
+            else _copy_jit(self._place_resume(self._check_resume_layout(
+                acc, self.init_reduce_acc, "acc")))
         self._last_acc = acc  # device-side, for ensemble_stats()
         pf = InputPrefetcher(self, start_block, self.n_blocks)
         self.timer.reset_clock()
+        k = self._k_dispatch
+        tel_on = self._telemetry != "off"
         try:
-            for bi in range(start_block, self.n_blocks):
-                inputs, _ = pf.get(bi)
-                with annotate("tmhpvsim/block_step"):
-                    self.state, acc = self.step_acc(self.state, inputs,
-                                                    acc)
+            bi = start_block
+            while bi < self.n_blocks:
+                kk = min(k, self.n_blocks - bi)
+                if kk == 1:
+                    inputs, _ = pf.get(bi)
+                    with annotate("tmhpvsim/block_step"):
+                        self.state, acc = self.step_acc(self.state,
+                                                        inputs, acc)
+                    accs = tels = None
+                else:
+                    ins = [pf.get(b)[0] for b in range(bi, bi + kk)]
+                    with annotate("tmhpvsim/mega_step"):
+                        out = self.step_acc_multi(self.state, ins, acc)
+                    self.state, acc, accs = out[0], out[1], out[2]
+                    tels = out[3] if tel_on else None
+                self.state_block = bi + kk
                 self._last_acc = acc
-                # async dispatch: per-block ticks measure dispatch-to-
+                # async dispatch: per-dispatch ticks measure dispatch-to-
                 # dispatch, which backpressure makes honest over a run
                 # (same semantics as the app-level timers)
-                self.timer.tick()
-                self._m_blocks.inc()
-                # BEFORE on_block: a strict sentinel raise must keep a
-                # poisoned block out of checkpoints/sinks
-                if self._telemetry != "off":
-                    self._observe_telemetry(bi)
-                if on_block is not None:
-                    on_block(bi, self.state, acc)
+                self.timer.tick(n_blocks=kk)
+                self._m_blocks.inc(kk)
+                self._m_dispatch.inc()
+                for j in range(kk):
+                    bj = bi + j
+                    # block-boundary accumulator snapshot: acc itself
+                    # per-block, a stacked-ys slice mid-megablock
+                    acc_j = acc if accs is None else \
+                        jax.tree.map(lambda a, _j=j: a[_j], accs)
+                    # BEFORE on_block: a strict sentinel raise must keep
+                    # a poisoned block out of checkpoints/sinks
+                    if tel_on:
+                        if tels is not None:
+                            self._tel_last = jax.tree.map(
+                                lambda a, _j=j: a[_j], tels)
+                        self._observe_telemetry(bj)
+                    if on_block is not None:
+                        on_block(bj, self.state, acc_j)
+                bi += kk
         finally:
             pf.close()
         return {k: self._host_view(v) for k, v in acc.items()}

@@ -72,7 +72,10 @@ class SlabScheduler:
     def _make_sim(self, cfg: SimConfig):
         from tmhpvsim_tpu.engine.simulation import Simulation
 
-        # per-slab plan: same resolved knobs, slabbing consumed
+        # per-slab plan: same resolved knobs, slabbing consumed.  The
+        # replace also carries blocks_per_dispatch, so each slab runs
+        # the same fused dispatch as the resolved plan; on_block still
+        # fires once per block, keeping the global counter exact.
         plan = dataclasses.replace(self.plan, slab_chains=cfg.n_chains)
         return Simulation(cfg, plan=plan)
 

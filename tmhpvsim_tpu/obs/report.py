@@ -32,9 +32,13 @@ logger = logging.getLogger(__name__)
 #: latency quantiles, funnel pending high-water + eviction / stall /
 #: backpressure counters, retry + broker connect counts — the asyncio
 #: streaming path's aggregate view, obs/trace.py holds the timeline).
+#: v4: adds the optional ``executor`` section (warm/cold compile counts
+#: from the persistent compilation cache, dispatch counts and the
+#: blocks-per-dispatch factor, AOT warm-up stats — engine/compilecache.py)
+#: and the ``blocks_per_dispatch`` field to the plan echo.
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 3
+REPORT_SCHEMA_VERSION = 4
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -60,6 +64,7 @@ _TOP_SCHEMA = {
     "processes": (False, (list, type(None))),
     "telemetry": (False, _OPT_DICT),
     "streaming": (False, _OPT_DICT),
+    "executor": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -199,6 +204,10 @@ def _plan_doc(plan) -> Optional[dict]:
             "scan_unroll": plan.scan_unroll,
             "stats_fusion": plan.stats_fusion,
             "slab_chains": plan.slab_chains,
+            # getattr: plan dicts rebuilt from pre-v4 documents / old
+            # autotune cache entries may predate the field
+            "blocks_per_dispatch": int(getattr(plan, "blocks_per_dispatch",
+                                               1)),
             "source": plan.source}
 
 
@@ -266,6 +275,33 @@ def _streaming_section(snap: dict) -> Optional[dict]:
     }
 
 
+def executor_section(snap: dict) -> Optional[dict]:
+    """The ``executor`` report section (schema v4) from the well-known
+    ``executor.*`` metric names the warm-start layer records
+    (engine/compilecache.py listener + the Simulation dispatch loops).
+    None when the run recorded nothing executor-related (older-style
+    runs keep their reports free of the section)."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    if not any(k.startswith("executor.")
+               for k in list(counters) + list(gauges)):
+        return None
+    out = {
+        "compile_warm": int(counters.get("executor.compile_warm_total", 0)),
+        "compile_cold": int(counters.get("executor.compile_cold_total", 0)),
+        "dispatches": int(counters.get("executor.dispatches_total", 0)),
+        "aot_warmup": int(counters.get("executor.aot_warmup_total", 0)),
+        "aot_warmup_errors":
+            int(counters.get("executor.aot_warmup_errors_total", 0)),
+    }
+    if "executor.aot_warmup_s" in gauges:
+        out["aot_warmup_s"] = float(gauges["executor.aot_warmup_s"])
+    if "executor.blocks_per_dispatch" in gauges:
+        out["blocks_per_dispatch"] = \
+            int(gauges["executor.blocks_per_dispatch"])
+    return out
+
+
 class RunReport:
     """Incremental builder for one run's report.
 
@@ -293,6 +329,11 @@ class RunReport:
         #: streaming-join section, derived from the well-known streaming
         #: metric names by :meth:`attach_metrics`
         self.streaming: Optional[dict] = None
+        #: warm-start executor section (schema v4): compile cache
+        #: warm/cold counts + dispatch stats, derived from the
+        #: ``executor.*`` metric names by :meth:`attach_metrics` (or set
+        #: directly from ``engine.compilecache.executor_doc()``)
+        self.executor: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -332,6 +373,11 @@ class RunReport:
         streaming = _streaming_section(snap)
         if streaming is not None:
             self.streaming = streaming
+        executor = executor_section(snap)
+        if executor is not None:
+            # preserve fields the caller set directly (e.g. cache_dir
+            # from engine.compilecache.executor_doc())
+            self.executor = {**executor, **(self.executor or {})}
 
     def doc(self, validate: bool = True) -> dict:
         out = {
@@ -354,6 +400,7 @@ class RunReport:
             "processes": self.processes,
             "telemetry": self.telemetry,
             "streaming": self.streaming,
+            "executor": self.executor,
         }
         return validate_report(out) if validate else out
 

@@ -82,6 +82,10 @@ class ShardedSimulation(Simulation):
     shape; there is no cross-chain reduction in the per-chain outputs.
     """
 
+    #: the base __init__ must not AOT-warm the unsharded jits this
+    #: subclass is about to replace — _warm_start runs after the rebinds
+    _defer_warm_start = True
+
     def __init__(self, config: SimConfig, mesh: Optional[Mesh] = None,
                  plan=None):
         mesh = mesh if mesh is not None else make_mesh()
@@ -129,6 +133,7 @@ class ShardedSimulation(Simulation):
                 self._block_step_scan2_acc_tel
             )
             self._wide_tel_jit = self._build_sharded_wide_tel()
+        self._warm_start()
 
     def init_state(self):
         return super().init_state(sharding=chain_sharding(self.mesh))
@@ -162,7 +167,9 @@ class ShardedSimulation(Simulation):
             out_specs=spec_c,
             check_vma=False,
         )
-        return jax.jit(mapped, donate_argnums=3)
+        # meter/pv donated alongside the accumulator, mirroring the
+        # parent's split-path jit (the tel fold runs before this jit)
+        return jax.jit(mapped, donate_argnums=(0, 1, 3))
 
     def _build_sharded_fused_acc(self):
         """Reduce-mode fused topology under shard_map (see
@@ -276,6 +283,75 @@ class ShardedSimulation(Simulation):
             check_vma=False,
         )
         return jax.jit(mapped)
+
+    def _build_mega_acc(self, k, tel):
+        """Sharded multi-block fused dispatch, reduce path: the shard_map
+        sits OUTSIDE the outer ``lax.scan`` so the whole K-block
+        megablock is one SPMD program per shard — still zero in-loop
+        collectives on the acc path, and under telemetry the per-block
+        deltas take the same one-psum-per-block tree as the per-block
+        wrapper (``_build_sharded_scan_acc_tel``), just issued from
+        inside the scan body.  Stacked per-block acc snapshots come back
+        chain-sharded on axis 1; stacked tel deltas are replicated."""
+        from tmhpvsim_tpu.parallel import distributed
+
+        fn = self._mega_block_fn("acc_tel" if tel else "acc")
+
+        def mega(state, xs, acc, const):
+            def body(carry, x):
+                st, a = carry
+                inputs = self._merge_inputs(x, const)
+                if tel:
+                    st, a, ta = fn(st, inputs, a)
+                    return (st, a), (
+                        a, distributed.psum_telemetry(ta, CHAIN_AXIS))
+                st, a = fn(st, inputs, a)
+                return (st, a), a
+
+            (state, acc), ys = jax.lax.scan(body, (state, acc), xs)
+            return state, acc, ys
+
+        spec_c, spec_r = P(CHAIN_AXIS), P()
+        spec_k = P(None, CHAIN_AXIS)  # (k, chains, ...) stacked snapshots
+        mapped = shard_map(
+            mega, mesh=self.mesh,
+            in_specs=(spec_c, spec_r, spec_c, spec_r),
+            out_specs=(spec_c, spec_c,
+                       (spec_k, spec_r) if tel else spec_k),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 2))
+
+    def _build_mega_blocks(self, kind, k):
+        """Sharded multi-block fused dispatch, ensemble/trace path.
+        ``series`` psums each block's local per-second sums inside the
+        scan body (fleet totals replicated, as in
+        ``_build_sharded_scan_series``); ``trace`` keeps the raw
+        chain-sharded meter/pv stacks and leaves the psum to the
+        per-block ``_trace_ensemble`` call on each slice."""
+        fn = self._mega_block_fn(kind)
+        series = kind == "series"
+
+        def mega(state, xs, const):
+            def body(st, x):
+                st, a, b = fn(st, self._merge_inputs(x, const))
+                if series:
+                    a = jax.lax.psum(a, CHAIN_AXIS)
+                    b = jax.lax.psum(b, CHAIN_AXIS)
+                return st, (a, b)
+
+            state, (a_k, b_k) = jax.lax.scan(body, state, xs)
+            return state, a_k, b_k
+
+        spec_c = P(CHAIN_AXIS)
+        out_ab = P() if series else P(None, CHAIN_AXIS)
+        mapped = shard_map(
+            mega, mesh=self.mesh,
+            in_specs=(spec_c, P(), P()),
+            out_specs=(spec_c, out_ab, out_ab),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=0)
 
     def step_reduced(self, state, inputs):
         """One sharded reduce-mode block: ``step_acc`` into a fresh sharded
