@@ -148,6 +148,10 @@ def _probe_or_fallback() -> tuple[str, bool]:
 #: stay the untouched hot path.
 TELEMETRY = "off"
 
+#: process-wide fleet-analytics level, same contract as TELEMETRY
+#: (--analytics; obs/analytics.py).
+ANALYTICS = "off"
+
 
 def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
               **kw):
@@ -167,6 +171,7 @@ def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
         prng_impl="threefry2x32",
         block_impl="auto",      # scan-fused on accelerators
         telemetry=TELEMETRY,
+        analytics=ANALYTICS,
     )
     base.update(kw)
     return SimConfig(**base)
@@ -1515,6 +1520,11 @@ def main() -> None:
                     help="in-graph telemetry level for every config this "
                          "invocation runs (obs/telemetry.py; default off "
                          "keeps the headline hot path untouched)")
+    ap.add_argument("--analytics", choices=["off", "risk", "full"],
+                    default="off",
+                    help="on-device fleet-analytics level for every config "
+                         "this invocation runs (obs/analytics.py; default "
+                         "off keeps the headline hot path untouched)")
     ap.add_argument("--compile-cache", metavar="DIR", default=None,
                     help="persistent XLA compilation-cache base dir (a "
                          "per-device-kind subdir is created under it; "
@@ -1522,8 +1532,9 @@ def main() -> None:
                          "$TMHPVSIM_COMPILE_CACHE, else "
                          "~/.cache/tmhpvsim_tpu/xla; 'off' disables")
     args = ap.parse_args()
-    global TELEMETRY
+    global TELEMETRY, ANALYTICS
     TELEMETRY = args.telemetry
+    ANALYTICS = args.analytics
     # default ON: every mode after the first run starts cache-warm, and
     # the v4 run_report executor section records warm vs cold compiles.
     # --repro children override via TMHPVSIM_COMPILE_CACHE=off (repro()).

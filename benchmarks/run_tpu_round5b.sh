@@ -184,5 +184,16 @@ for trace_file in benchmarks/flight_watchdog.json benchmarks/*.trace.json; do
   python tools/trace_stats.py "$trace_file" >> "$LOG" 2>&1 \
     || echo "--- trace_stats: INVALID TRACE $trace_file rc=$?" >> "$LOG"
 done
+# fleet-analytics sanity (non-fatal): any bench doc that carried a
+# RunReport fleet section must carry a WELL-FORMED one — a section that
+# fails the shape check means the analytics fold wrote something
+# obs/analytics.summarize never emits, worth the log line even though
+# the battery's own runs default to --analytics off
+for bench_doc in benchmarks/BENCH_*.json benchmarks/SWEEP_*.jsonl; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- fleet_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/fleet_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- fleet_report: MALFORMED FLEET SECTION $bench_doc rc=$?" >> "$LOG"
+done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

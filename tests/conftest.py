@@ -141,6 +141,8 @@ _SLOW_LANE = {
     "test_metrics_overhead_65536_chains",
     # telemetry acceptance: same shape, light-vs-off arms
     "test_telemetry_overhead_65536_chains",
+    # fleet-analytics acceptance: same shape, risk-vs-off arms
+    "test_analytics_overhead_65536_chains",
     # trace acceptance: disabled-tracer engine arm at 65536 chains plus
     # a 10k-record join-throughput arm
     "test_trace_disabled_overhead_65536_chains",

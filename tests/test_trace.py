@@ -184,6 +184,17 @@ class TestQuantiles:
         assert quantile_from_snapshot(snap, 0.01) >= 2.0
         assert quantile_from_snapshot(snap, 0.999) <= 50.0
 
+    def test_quantile_bucketless_json_snapshot(self):
+        """Snapshots rebuilt from JSON may carry ``buckets: null`` — a
+        valid "nothing bucketed" answer (falls back to the observed
+        max), never a TypeError."""
+        snap = {"count": 5, "sum": 10.0, "buckets": None,
+                "min": 1.0, "max": 4.0}
+        assert quantile_from_snapshot(snap, 0.5) == 4.0
+        # ... and with no max recorded either, None — not an exception
+        assert quantile_from_snapshot({"count": 3, "buckets": None},
+                                      0.9) is None
+
     def test_quantile_nonzero_when_all_positive(self):
         h = MetricsRegistry().histogram("h")
         h.observe(0.005)
@@ -579,11 +590,12 @@ def test_report_schema_v1_v2_still_validate():
     schemas keep validating against the current validator."""
     from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, RunReport
 
-    assert REPORT_SCHEMA_VERSION == 4
+    assert REPORT_SCHEMA_VERSION == 5
     doc = RunReport("test").doc()
     for old in (1, 2):
         legacy = {k: v for k, v in doc.items()
-                  if not (k == "executor" and old < 4)
+                  if not (k == "fleet" and old < 5)
+                  and not (k == "executor" and old < 4)
                   and not (k == "streaming" and old < 3)
                   and not (k == "telemetry" and old < 2)}
         legacy["schema_version"] = old

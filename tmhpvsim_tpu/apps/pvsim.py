@@ -303,6 +303,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               tune: str = "off",
               telemetry: str = "off",
               telemetry_strict: bool = False,
+              analytics: str = "off",
               metrics_path: Optional[str] = None,
               run_report_path: Optional[str] = None,
               trace: Optional[str] = None,
@@ -342,6 +343,13 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     ``telemetry_strict`` escalates sentinel WARNs to DriftError.  The
     sentinel's verdict lands in the report's ``telemetry`` section.
 
+    ``analytics`` ('off'|'risk'|'full', reduce mode only) folds the
+    fleet-risk accumulator into the same block step (obs/analytics.py:
+    residual quantile sketch, exceedance curve, loss-of-load
+    probability, ramp extrema; 'full' adds per-regime conditional
+    means).  The merged fleet summary lands in the report's ``fleet``
+    section (schema v5).
+
     ``trace`` records host-side per-block instants into the streaming
     tracer's ring (obs/trace.py) and exports Chrome-trace JSON there on
     exit; the pid is the real os.getpid(), so a jax.profiler device
@@ -368,6 +376,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 profile_dir=profile_dir, output=output,
                 prng_impl=prng_impl, block_impl=block_impl, tune=tune,
                 telemetry=telemetry, telemetry_strict=telemetry_strict,
+                analytics=analytics,
                 trace=trace, tracer=tracer, compile_cache=compile_cache,
                 blocks_per_dispatch=blocks_per_dispatch,
             )
@@ -398,6 +407,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     rep.headline = {"site_seconds_per_s": summary["site_seconds_per_s"]}
     if getattr(sim, "sentinel", None) is not None:
         rep.telemetry = sim.sentinel.report()
+    if hasattr(sim, "fleet_summary"):
+        fleet_sec = sim.fleet_summary()
+        if fleet_sec is not None:
+            rep.fleet = fleet_sec
     if profile_dir:
         rep.profile = read_manifest(profile_dir)
     if jax.process_count() > 1:
@@ -424,6 +437,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    tune: str = "off",
                    telemetry: str = "off",
                    telemetry_strict: bool = False,
+                   analytics: str = "off",
                    trace: Optional[str] = None,
                    tracer: Optional[Tracer] = None,
                    compile_cache: Optional[str] = None,
@@ -492,6 +506,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         tune=tune,
         telemetry=telemetry,
         telemetry_strict=telemetry_strict,
+        analytics=analytics,
         trace=trace,
         blocks_per_dispatch=blocks_per_dispatch,
     )

@@ -211,6 +211,14 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
 @click.option("--telemetry-strict", is_flag=True, default=False,
               help="escalate drift-sentinel WARNs (NaN/Inf, reference "
                    "band escape) to a hard error")
+@click.option("--analytics", type=click.Choice(["off", "risk", "full"]),
+              default="off",
+              help="on-device fleet-risk analytics (jax backend, reduce "
+                   "mode): risk = residual quantile sketch, exceedance "
+                   "curve, loss-of-load probability and ramp extrema on "
+                   "the device scan carry, surfaced as the RunReport "
+                   "'fleet' section; full adds per-regime conditional "
+                   "means; off pays nothing (obs/analytics.py)")
 @click.option("--metrics", "metrics_path", default=None,
               help="Stream metric snapshots to this file: .prom = "
                    "Prometheus text exposition (atomic rewrite), anything "
@@ -242,7 +250,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, trace, backend, n_chains, chain, sharded, checkpoint,
           block_s, site_grid_spec, sites_csv, profile_dir, output,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
-          metrics_path, run_report_path, compile_cache,
+          analytics, metrics_path, run_report_path, compile_cache,
           blocks_per_dispatch):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
@@ -264,6 +272,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--tune requires --backend=jax")
     if (telemetry != "off" or telemetry_strict) and backend != "jax":
         raise click.UsageError("--telemetry requires --backend=jax")
+    if analytics != "off" and backend != "jax":
+        raise click.UsageError("--analytics requires --backend=jax")
     if compile_cache is not None and backend != "jax":
         raise click.UsageError("--compile-cache requires --backend=jax")
     if blocks_per_dispatch != 0 and backend != "jax":
@@ -306,6 +316,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   block_impl=block_impl, tune=tune,
                   telemetry=telemetry,
                   telemetry_strict=telemetry_strict,
+                  analytics=analytics,
                   metrics_path=metrics_path,
                   run_report_path=run_report_path,
                   trace=trace, compile_cache=compile_cache,

@@ -257,6 +257,12 @@ class Plan:
     #: bit-identical to per-block dispatch (tested in
     #: tests/test_executor.py).
     blocks_per_dispatch: int = 1
+    #: resolved fleet-analytics level: 'off' | 'risk' | 'full'
+    #: (obs/analytics.py).  Not a tuned knob — carried on the Plan so the
+    #: engine builds its jits from one resolved object; autotune cache
+    #: entries never persist it (engine/autotune.py re-applies the
+    #: config's request on every cache hit).
+    analytics: str = "off"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -400,6 +406,32 @@ class SimConfig:
     #: escalate drift-sentinel WARNs (NaN/Inf appearance, reference-band
     #: escape) to obs.sentinel.DriftError
     telemetry_strict: bool = False
+
+    #: on-device fleet analytics (obs/analytics.py): 'off' (analytics
+    #: structurally absent from the traced graph — byte-identical HLO to
+    #: a build without it), 'risk' (residual-load quantile sketch,
+    #: exceedance curve, loss-of-load probability, ramp-rate extremes —
+    #: all integer-count/extremum leaves, so sharded/slabbed/fused runs
+    #: merge bit-identically), or 'full' (risk + per-cloud-regime
+    #: conditional means of meter/pv/residual).  Reduce mode only; other
+    #: output modes ignore it.  Results surface as the RunReport
+    #: ``fleet`` section and ``device.fleet.*`` metrics.
+    analytics: str = "off"
+
+    #: interior bins of the residual quantile sketch (per-quantile rank
+    #: error is bounded by one bin's mass; 2048 is ~0.1 % on the
+    #: reference run)
+    analytics_bins: int = 2048
+
+    #: loss-of-load capacity [W]; None -> 0.8 * meter_max_w
+    analytics_capacity_w: Optional[float] = None
+
+    #: consecutive exceedance seconds before loss of load registers
+    analytics_lolp_k: int = 60
+
+    #: exceedance threshold grid [W], strictly ascending; None -> the
+    #: 1/8..7/8 fractions of meter_max_w
+    analytics_thresholds: Optional[tuple] = None
 
     #: streaming-trace output path (obs/trace.py): per-block host-side
     #: instants land in the tracer ring and export as Chrome-trace JSON

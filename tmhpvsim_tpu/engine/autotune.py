@@ -100,6 +100,15 @@ def _resolve_telemetry(config: SimConfig) -> str:
     return level
 
 
+def _resolve_analytics(config: SimConfig) -> str:
+    level = getattr(config, "analytics", "off")
+    if level not in ("off", "risk", "full"):
+        raise ValueError(
+            f"analytics must be 'off', 'risk' or 'full', got {level!r}"
+        )
+    return level
+
+
 def _resolve_impl(config: SimConfig) -> str:
     import jax
 
@@ -124,6 +133,7 @@ def static_plan(config: SimConfig) -> Plan:
         slab_chains=config.n_chains,
         source="static",
         telemetry=_resolve_telemetry(config),
+        analytics=_resolve_analytics(config),
         # 0 (auto) resolves to per-block dispatch without measurement;
         # the fused dispatch only enters statically when pinned
         blocks_per_dispatch=max(1, config.blocks_per_dispatch),
@@ -258,10 +268,11 @@ def candidate_plans(config: SimConfig, slabs: bool = True) -> list:
     kds = (CANDIDATE_BLOCKS_PER_DISPATCH if config.blocks_per_dispatch == 0
            else (max(1, config.blocks_per_dispatch),))
     telemetry = _resolve_telemetry(config)
+    analytics = _resolve_analytics(config)
     return [
         Plan(block_impl=impl, scan_unroll=u, stats_fusion=fusion,
              slab_chains=slab, source="probe", telemetry=telemetry,
-             blocks_per_dispatch=kd)
+             analytics=analytics, blocks_per_dispatch=kd)
         for impl in impls
         for u in CANDIDATE_UNROLLS
         for slab in slab_sizes
@@ -443,13 +454,14 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
         entry = _load_cache(path).get(key)
         if entry is not None:
             try:
-                # cache entries never persist telemetry (not a tuned
-                # knob); re-apply this config's request.  An explicit
-                # blocks_per_dispatch pin (>= 1) also overrides whatever
-                # an earlier auto probe persisted under this key.
+                # cache entries never persist telemetry/analytics (not
+                # tuned knobs); re-apply this config's request.  An
+                # explicit blocks_per_dispatch pin (>= 1) also overrides
+                # whatever an earlier auto probe persisted under this key.
                 plan = dataclasses.replace(
                     _plan_from_entry(entry),
                     telemetry=_resolve_telemetry(config),
+                    analytics=_resolve_analytics(config),
                 )
                 if config.blocks_per_dispatch >= 1:
                     plan = dataclasses.replace(
@@ -464,7 +476,8 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
     if plan.source == "probe":  # don't cache the all-failed fallback
         _store_plan(path, key, plan, candidates)
     return dataclasses.replace(plan,
-                               telemetry=_resolve_telemetry(config))
+                               telemetry=_resolve_telemetry(config),
+                               analytics=_resolve_analytics(config))
 
 
 def broadcast_plan(plan: Plan) -> Plan:
@@ -495,6 +508,7 @@ def broadcast_plan(plan: Plan) -> Plan:
         source=source,
         # not broadcast: every process resolved the same config locally
         telemetry=plan.telemetry,
+        analytics=plan.analytics,
         blocks_per_dispatch=int(out[4]),
     )
 

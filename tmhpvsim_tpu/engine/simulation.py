@@ -50,6 +50,7 @@ import numpy as np
 
 from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
+from tmhpvsim_tpu.obs import analytics as flt
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs import telemetry as tel
 from tmhpvsim_tpu.obs.profiler import BlockTimer, annotate
@@ -287,6 +288,30 @@ class Simulation:
                 self._block_step_scan2_acc_tel, donate_argnums=(0, 2)
             )
             self._wide_tel_jit = jax.jit(self._wide_telemetry)
+        #: on-device fleet analytics (obs/analytics.py): same build
+        #: discipline as telemetry — analytics jits exist only when the
+        #: level is on, the off-path jits are never touched, and each
+        #: tel x analytics combination has its own fused block step so
+        #: the carry stays a single scan
+        self._analytics = getattr(self.plan, "analytics", "off")
+        self._fleet_last = None
+        self._fleet_total = None
+        self._fleet_params = None
+        if self._analytics != "off":
+            self._fleet_params = flt.params_from_config(self.config)
+            if self._telemetry != "off":
+                self._scan_acc_tel_fleet_jit = jax.jit(
+                    self._block_step_scan_acc_tel_fleet,
+                    donate_argnums=(0, 2))
+                self._scan2_acc_tel_fleet_jit = jax.jit(
+                    self._block_step_scan2_acc_tel_fleet,
+                    donate_argnums=(0, 2))
+            else:
+                self._scan_acc_fleet_jit = jax.jit(
+                    self._block_step_scan_acc_fleet, donate_argnums=(0, 2))
+                self._scan2_acc_fleet_jit = jax.jit(
+                    self._block_step_scan2_acc_fleet, donate_argnums=(0, 2))
+            self._wide_fleet_jit = jax.jit(self._wide_fleet)
         #: multi-block fused dispatch factor (Plan.blocks_per_dispatch):
         #: K consecutive blocks run as one outer lax.scan in a single
         #: jit, so the host pays one dispatch per K blocks.  getattr:
@@ -995,6 +1020,173 @@ class Simulation:
         return tel.fold_wide(ta, self._telemetry, meter=meter, pv=pv,
                              t=t, duration_s=self.config.duration_s)
 
+    def _make_acc_fleet_body(self, step):
+        """Fleet-analytics variant of ``_make_acc_body``: the same
+        statistics fold (duplicated verbatim, same reasoning as
+        ``_make_acc_tel_body``) plus the FleetAcc fold on a second carry
+        passenger.  ``step`` must come from
+        ``_scan_block_setup(..., with_extras=True)`` (the 'covered'
+        regime mask; at level 'risk' it is DCE'd)."""
+        cfg = self.config
+        dtype = self.dtype
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        level = self._analytics
+        params = self._fleet_params
+
+        def body(carry, x):
+            (rc, st), fa = carry
+            rc, meter, ac, extras = step(rc, x)
+            residual = meter - ac
+            valid = x["t"] < cfg.duration_s      # scalar: padding mask
+            vz = jnp.where(valid, 1.0, 0.0).astype(dtype)
+            st = {
+                "pv_sum": st["pv_sum"] + ac * vz,
+                "pv_max": jnp.maximum(st["pv_max"],
+                                      jnp.where(valid, ac, -big)),
+                "meter_sum": st["meter_sum"] + meter * vz,
+                "residual_sum": st["residual_sum"] + residual * vz,
+                "residual_min": jnp.minimum(st["residual_min"],
+                                            jnp.where(valid, residual, big)),
+                "residual_max": jnp.maximum(st["residual_max"],
+                                            jnp.where(valid, residual, -big)),
+                "n_seconds": st["n_seconds"] + valid.astype(jnp.int32),
+            }
+            fa = flt.fold_second(
+                fa, level, params, meter=meter, pv=ac, residual=residual,
+                covered=extras["covered"], t=x["t"], valid=valid,
+            )
+            return ((rc, st), fa), None
+
+        return body
+
+    def _make_acc_tel_fleet_body(self, step):
+        """Both passengers at once (telemetry AND analytics on): the
+        stats fold, the TelemetryAcc fold and the FleetAcc fold in one
+        scan body, so the carry stays a single scan."""
+        cfg = self.config
+        dtype = self.dtype
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        tel_level = self._telemetry
+        level = self._analytics
+        params = self._fleet_params
+
+        def body(carry, x):
+            (rc, st), ta, fa = carry
+            rc, meter, ac, extras = step(rc, x)
+            residual = meter - ac
+            valid = x["t"] < cfg.duration_s      # scalar: padding mask
+            vz = jnp.where(valid, 1.0, 0.0).astype(dtype)
+            st = {
+                "pv_sum": st["pv_sum"] + ac * vz,
+                "pv_max": jnp.maximum(st["pv_max"],
+                                      jnp.where(valid, ac, -big)),
+                "meter_sum": st["meter_sum"] + meter * vz,
+                "residual_sum": st["residual_sum"] + residual * vz,
+                "residual_min": jnp.minimum(st["residual_min"],
+                                            jnp.where(valid, residual, big)),
+                "residual_max": jnp.maximum(st["residual_max"],
+                                            jnp.where(valid, residual, -big)),
+                "n_seconds": st["n_seconds"] + valid.astype(jnp.int32),
+            }
+            ta = tel.fold_second(
+                ta, tel_level, meter=meter, pv=ac, csi=extras["csi"],
+                residual=residual, covered=extras["covered"], valid=valid,
+            )
+            fa = flt.fold_second(
+                fa, level, params, meter=meter, pv=ac, residual=residual,
+                covered=extras["covered"], t=x["t"], valid=valid,
+            )
+            return ((rc, st), ta, fa), None
+
+        return body
+
+    def _block_step_scan_acc_fleet(self, state, inputs, acc):
+        """``_block_step_scan_acc`` with the FleetAcc riding the scan
+        carry (plan.analytics != 'off', telemetry off).  Zero-initialised
+        inside the jit — the returned sketches are this block's pure
+        delta, psum-safe — and collapsed to shard-level form once, after
+        the scan (obs/analytics.py)."""
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    with_extras=True)
+        n = state["carry"]["sec"].shape[0]
+        fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
+                           params=self._fleet_params)
+        ((rcarry, acc), fa), _ = jax.lax.scan(
+            self._make_acc_fleet_body(step), ((state["carry"], acc), fa0),
+            xs, unroll=self._unroll,
+        )
+        return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
+                flt.reduce_chainwise(fa))
+
+    def _block_step_scan2_acc_fleet(self, state, inputs, acc):
+        """``_block_step_scan2_acc`` with the FleetAcc riding both scan
+        levels (see ``_block_step_scan_acc_fleet``)."""
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    predraw=False,
+                                                    with_extras=True)
+        inner_body = self._make_acc_fleet_body(step)
+
+        def inner(carry, xs_inner):
+            return jax.lax.scan(inner_body, carry, xs_inner,
+                                unroll=self._unroll)[0], None
+
+        n = state["carry"]["sec"].shape[0]
+        fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
+                           params=self._fleet_params)
+        ((rcarry, acc), fa), _ = self._scan2_outer(
+            state, xs, inner, ((state["carry"], acc), fa0)
+        )
+        return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
+                flt.reduce_chainwise(fa))
+
+    def _block_step_scan_acc_tel_fleet(self, state, inputs, acc):
+        """Both accumulators riding the flat scan (telemetry AND
+        analytics on); returns (state', acc, tel_delta, fleet_delta)."""
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    with_extras=True)
+        n = state["carry"]["sec"].shape[0]
+        ta0 = tel.init_acc(self._telemetry, self.dtype, n_chains=n)
+        fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
+                           params=self._fleet_params)
+        ((rcarry, acc), ta, fa), _ = jax.lax.scan(
+            self._make_acc_tel_fleet_body(step),
+            ((state["carry"], acc), ta0, fa0), xs, unroll=self._unroll,
+        )
+        return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
+                tel.reduce_chainwise(ta), flt.reduce_chainwise(fa))
+
+    def _block_step_scan2_acc_tel_fleet(self, state, inputs, acc):
+        """Both accumulators riding the nested scan; returns
+        (state', acc, tel_delta, fleet_delta)."""
+        xs, step, cc_carry = self._scan_block_setup(state, inputs,
+                                                    predraw=False,
+                                                    with_extras=True)
+        inner_body = self._make_acc_tel_fleet_body(step)
+
+        def inner(carry, xs_inner):
+            return jax.lax.scan(inner_body, carry, xs_inner,
+                                unroll=self._unroll)[0], None
+
+        n = state["carry"]["sec"].shape[0]
+        ta0 = tel.init_acc(self._telemetry, self.dtype, n_chains=n)
+        fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
+                           params=self._fleet_params)
+        ((rcarry, acc), ta, fa), _ = self._scan2_outer(
+            state, xs, inner, ((state["carry"], acc), ta0, fa0)
+        )
+        return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
+                tel.reduce_chainwise(ta), flt.reduce_chainwise(fa))
+
+    def _wide_fleet(self, meter, pv, t):
+        """Fleet fold over the wide impl's materialised block arrays
+        (scalar-form acc; the wide producer never materialises the cloud
+        state, so the 'full' regime leaves stay unobserved)."""
+        fa = flt.init_acc(self._analytics, self.dtype,
+                          params=self._fleet_params)
+        return flt.fold_wide(fa, self._analytics, self._fleet_params,
+                             meter=meter, pv=pv, t=t,
+                             duration_s=self.config.duration_s)
+
     def _scan2_outer(self, state, xs, inner, carry0):
         """The nested ('scan2') outer scan, shared by the reduce and
         ensemble formulations: per-second features are tiled per minute
@@ -1107,6 +1299,8 @@ class Simulation:
 
     def step_acc(self, state, inputs, acc):
         """One reduce-mode block folded into the on-device accumulator."""
+        if self._analytics != "off":
+            return self._step_acc_fleet(state, inputs, acc)
         if self._telemetry != "off":
             return self._step_acc_tel(state, inputs, acc)
         if self._impl == "scan2":
@@ -1138,6 +1332,42 @@ class Simulation:
             acc = self._stats_acc_jit(meter, pv, inputs["block_idx"]["t"],
                                       acc)
         self._tel_last = ta
+        return state, acc
+
+    def _step_acc_fleet(self, state, inputs, acc):
+        """Reduce-mode block with fleet analytics (and possibly
+        telemetry): the scan impls run their dedicated combo jits; the
+        wide impl runs the split producer plus the bulk folds over the
+        materialised arrays BEFORE the (donating) stats jit consumes
+        them.  The block's FleetAcc delta lands in ``self._fleet_last``
+        for the per-block host merge (``_observe_fleet``); the
+        (state, acc) contract of ``step_acc`` is unchanged."""
+        tel_on = self._telemetry != "off"
+        if self._impl == "scan2":
+            if tel_on:
+                state, acc, ta, fa = self._scan2_acc_tel_fleet_jit(
+                    state, inputs, acc)
+                self._tel_last = ta
+            else:
+                state, acc, fa = self._scan2_acc_fleet_jit(
+                    state, inputs, acc)
+        elif self._impl == "scan":
+            if tel_on:
+                state, acc, ta, fa = self._scan_acc_tel_fleet_jit(
+                    state, inputs, acc)
+                self._tel_last = ta
+            else:
+                state, acc, fa = self._scan_acc_fleet_jit(
+                    state, inputs, acc)
+        else:
+            state, meter, pv = self._block_jit(state, inputs)
+            t = inputs["block_idx"]["t"]
+            if tel_on:
+                self._tel_last = self._wide_tel_jit(meter, pv, t)
+            fa = self._wide_fleet_jit(meter, pv, t)
+            # last: _stats_acc_jit donates the meter/pv buffers
+            acc = self._stats_acc_jit(meter, pv, t, acc)
+        self._fleet_last = fa
         return state, acc
 
     # ------------------------------------------------------------------
@@ -1209,8 +1439,11 @@ class Simulation:
         The reduce folds absorb those ulps, which is why the reduce
         contract stays exact even on the wide impl.
         Kinds: 'acc' (reduce), 'acc_tel' (reduce + telemetry: returns a
-        third per-block TelemetryAcc delta), 'trace' (the wide
-        producer), 'series' (the scan-family ensemble step)."""
+        third per-block TelemetryAcc delta), 'acc_fleet' (reduce + fleet
+        analytics: third output is the per-block FleetAcc delta),
+        'acc_tel_fleet' (both: outputs 3 and 4 are the telemetry and
+        fleet deltas), 'trace' (the wide producer), 'series' (the
+        scan-family ensemble step)."""
         if kind == "acc":
             if self._impl == "scan2":
                 return self._block_step_scan2_acc
@@ -1242,6 +1475,34 @@ class Simulation:
                 return state, self._block_stats_acc(meter, pv, t, acc), ta
 
             return wide_tel
+        if kind == "acc_fleet":
+            if self._impl == "scan2":
+                return self._block_step_scan2_acc_fleet
+            if self._impl == "scan":
+                return self._block_step_scan_acc_fleet
+
+            def wide_fleet(state, inputs, acc):
+                state, meter, pv = self._block_step(state, inputs)
+                t = inputs["block_idx"]["t"]
+                fa = self._wide_fleet(meter, pv, t)
+                return state, self._block_stats_acc(meter, pv, t, acc), fa
+
+            return wide_fleet
+        if kind == "acc_tel_fleet":
+            if self._impl == "scan2":
+                return self._block_step_scan2_acc_tel_fleet
+            if self._impl == "scan":
+                return self._block_step_scan_acc_tel_fleet
+
+            def wide_tel_fleet(state, inputs, acc):
+                state, meter, pv = self._block_step(state, inputs)
+                t = inputs["block_idx"]["t"]
+                ta = self._wide_telemetry(meter, pv, t)
+                fa = self._wide_fleet(meter, pv, t)
+                return (state, self._block_stats_acc(meter, pv, t, acc),
+                        ta, fa)
+
+            return wide_tel_fleet
         if kind == "trace":
             return self._block_step
         if kind == "series":
@@ -1249,27 +1510,29 @@ class Simulation:
                     else self._block_step_scan_series)
         raise ValueError(f"unknown mega-dispatch kind {kind!r}")
 
-    def _build_mega_acc(self, k: int, tel: bool):
+    def _build_mega_acc(self, k: int, tel: bool, fleet: bool = False):
         """Jitted K-block reduce dispatch: outer lax.scan carrying
-        (state, acc), per-block accumulator snapshots (and telemetry
-        deltas) stacked out as ys so block boundaries stay observable.
-        State and accumulator are donated — the carries never need a
-        second HBM copy.  ``const`` is the block-invariant scalar tree
-        from ``_split_inputs``, an argument (not a closure) so its
-        python floats trace exactly as on the per-block path.
-        Overridden sharded: parallel/mesh.py puts the shard_map OUTSIDE
-        the scan."""
-        fn = self._mega_block_fn("acc_tel" if tel else "acc")
+        (state, acc), per-block accumulator snapshots (and telemetry /
+        fleet deltas) stacked out as ys so block boundaries stay
+        observable.  State and accumulator are donated — the carries
+        never need a second HBM copy.  ``const`` is the block-invariant
+        scalar tree from ``_split_inputs``, an argument (not a closure)
+        so its python floats trace exactly as on the per-block path.
+        ys shapes per combination: acc | (acc, ta) | (acc, fa) |
+        (acc, ta, fa).  Overridden sharded: parallel/mesh.py puts the
+        shard_map OUTSIDE the scan."""
+        kind = "acc" + ("_tel" if tel else "") + ("_fleet" if fleet else "")
+        fn = self._mega_block_fn(kind)
 
         def mega(state, xs, acc, const):
             def body(carry, x):
                 st, a = carry
                 inputs = self._merge_inputs(x, const)
-                if tel:
-                    st, a, ta = fn(st, inputs, a)
-                    return (st, a), (a, ta)
-                st, a = fn(st, inputs, a)
-                return (st, a), a
+                out = fn(st, inputs, a)
+                st, a = out[0], out[1]
+                if len(out) == 2:
+                    return (st, a), a
+                return (st, a), (a,) + tuple(out[2:])
 
             (state, acc), ys = jax.lax.scan(body, (state, acc), xs)
             return state, acc, ys
@@ -1303,9 +1566,9 @@ class Simulation:
         xs, const = self._split_inputs(ins)
         key = (kind, k)
         if key not in self._mega_jits:
-            if kind in ("acc", "acc_tel"):
+            if kind in ("acc", "acc_tel", "acc_fleet", "acc_tel_fleet"):
                 self._mega_jits[key] = self._build_mega_acc(
-                    k, tel=(kind == "acc_tel"))
+                    k, tel="_tel" in kind, fleet="_fleet" in kind)
             else:
                 self._mega_jits[key] = self._build_mega_blocks(kind, k)
         return self._mega_jits[key], xs, const
@@ -1316,16 +1579,18 @@ class Simulation:
         round-trips while the stacked per-block accumulator snapshots
         (and telemetry deltas) keep every block boundary observable —
         checkpoints, the drift sentinel and on_block callbacks see exact
-        block-boundary values.  Returns (state, acc, accs) — or
-        (state, acc, accs, tels) under telemetry — where accs/tels
-        leaves carry a leading len(inputs_seq) axis."""
+        block-boundary values.  Returns (state, acc, accs), extended
+        with a stacked tels tree under telemetry and a stacked fleets
+        tree under analytics (in that order, each only when on); every
+        stacked leaf carries a leading len(inputs_seq) axis."""
         tel_on = self._telemetry != "off"
-        mega, xs, const = self._mega_dispatch(
-            "acc_tel" if tel_on else "acc", list(inputs_seq))
+        fleet_on = self._analytics != "off"
+        kind = ("acc" + ("_tel" if tel_on else "")
+                + ("_fleet" if fleet_on else ""))
+        mega, xs, const = self._mega_dispatch(kind, list(inputs_seq))
         state, acc, ys = mega(state, xs, acc, const)
-        if tel_on:
-            accs, tels = ys
-            return state, acc, accs, tels
+        if tel_on or fleet_on:
+            return (state, acc) + tuple(ys)
         return state, acc, ys
 
     def aot_targets(self):
@@ -1345,20 +1610,19 @@ class Simulation:
             if self._is_block_arr(l) else l, inputs)
         mode = self.config.output
         tel_on = self._telemetry != "off"
+        fleet_on = self._analytics != "off"
         out = []
         if mode == "reduce":
             acc_abs = jax.eval_shape(self.init_reduce_acc)
-            if self._impl == "scan2":
-                out.append(("scan2_acc",
-                            self._scan2_acc_tel_jit if tel_on
-                            else self._scan2_acc_jit,
+            if self._impl in ("scan", "scan2"):
+                # the one combo jit __init__ actually built for this
+                # tel x analytics combination
+                suffix = (("_tel" if tel_on else "")
+                          + ("_fleet" if fleet_on else ""))
+                jit = getattr(self, f"_{self._impl}_acc{suffix}_jit")
+                out.append((f"{self._impl}_acc", jit,
                             (state_abs, inputs_abs, acc_abs)))
-            elif self._impl == "scan":
-                out.append(("scan_acc",
-                            self._scan_acc_tel_jit if tel_on
-                            else self._scan_acc_jit,
-                            (state_abs, inputs_abs, acc_abs)))
-            elif self._use_fused and not tel_on:
+            elif self._use_fused and not tel_on and not fleet_on:
                 out.append(("fused_acc", self._fused_acc_jit,
                             (state_abs, inputs_abs, acc_abs)))
             else:
@@ -1369,6 +1633,9 @@ class Simulation:
                             (state_abs, inputs_abs)))
                 if tel_on:
                     out.append(("wide_tel", self._wide_tel_jit,
+                                (m_abs, p_abs, t_abs)))
+                if fleet_on:
+                    out.append(("wide_fleet", self._wide_fleet_jit,
                                 (m_abs, p_abs, t_abs)))
                 out.append(("stats_acc", self._stats_acc_jit,
                             (m_abs, p_abs, t_abs, acc_abs)))
@@ -1397,7 +1664,9 @@ class Simulation:
         mode (the final partial group, if any, compiles lazily — a small
         one-off)."""
         k = self._k_dispatch
-        kind = {"reduce": "acc_tel" if tel_on else "acc",
+        fleet_on = self._analytics != "off"
+        kind = {"reduce": ("acc" + ("_tel" if tel_on else "")
+                           + ("_fleet" if fleet_on else "")),
                 "ensemble": "series" if self._use_scan else "trace",
                 "trace": "trace"}[mode]
         # K copies of block 0's inputs: right shapes/dtypes/constants
@@ -1548,6 +1817,11 @@ class Simulation:
                 reduced = sched.run_reduced(on_block=on_block)
                 # host-side accumulator: ensemble_stats folds numpy fine
                 self._last_acc = reduced
+                # hoist the scheduler's merged fleet total (each slab sim
+                # is discarded after its run; the scheduler merge-folds
+                # their totals — associative, so slab order is free)
+                if getattr(sched, "fleet_total", None) is not None:
+                    self._fleet_total = sched.fleet_total
                 return reduced
         state = self.init_state() if state is None \
             else _copy_jit(self._place_resume(
@@ -1564,6 +1838,7 @@ class Simulation:
         self.timer.reset_clock()
         k = self._k_dispatch
         tel_on = self._telemetry != "off"
+        fleet_on = self._analytics != "off"
         try:
             bi = start_block
             while bi < self.n_blocks:
@@ -1573,13 +1848,19 @@ class Simulation:
                     with annotate("tmhpvsim/block_step"):
                         self.state, acc = self.step_acc(self.state,
                                                         inputs, acc)
-                    accs = tels = None
+                    accs = tels = fleets = None
                 else:
                     ins = [pf.get(b)[0] for b in range(bi, bi + kk)]
                     with annotate("tmhpvsim/mega_step"):
                         out = self.step_acc_multi(self.state, ins, acc)
                     self.state, acc, accs = out[0], out[1], out[2]
-                    tels = out[3] if tel_on else None
+                    idx = 3
+                    tels = fleets = None
+                    if tel_on:
+                        tels = out[idx]
+                        idx += 1
+                    if fleet_on:
+                        fleets = out[idx]
                 self.state_block = bi + kk
                 self._last_acc = acc
                 # async dispatch: per-dispatch ticks measure dispatch-to-
@@ -1601,6 +1882,11 @@ class Simulation:
                             self._tel_last = jax.tree.map(
                                 lambda a, _j=j: a[_j], tels)
                         self._observe_telemetry(bj)
+                    if fleet_on:
+                        if fleets is not None:
+                            self._fleet_last = jax.tree.map(
+                                lambda a, _j=j: a[_j], fleets)
+                        self._observe_fleet(bj)
                     if on_block is not None:
                         on_block(bj, self.state, acc_j)
                 bi += kk
@@ -1627,6 +1913,28 @@ class Simulation:
                 strict=getattr(self.config, "telemetry_strict", False),
             )
         self.sentinel.observe_block(bi, summary)
+
+    def _observe_fleet(self, bi: int) -> None:
+        """Per-block fleet flush: fetch the block's sketch delta
+        (piggybacking on the per-block sync), merge it into the
+        host-side run total (int64/float64 — exact past the per-block
+        int32 bound) and publish the running summary under
+        ``device.fleet.*``."""
+        del bi
+        if self._fleet_last is None:
+            return
+        fa = {k: self._repl_view(v) for k, v in self._fleet_last.items()}
+        self._fleet_total = flt.merge_host(self._fleet_total, fa)
+        flt.publish(self.metrics,
+                    flt.summarize(fa, self._fleet_params))
+
+    def fleet_summary(self):
+        """The run-total ``fleet`` report section (obs/analytics.py
+        summarize of the host-merged totals), or None when analytics is
+        off / no block has been observed yet."""
+        if self._fleet_total is None or self._fleet_params is None:
+            return None
+        return flt.summarize(self._fleet_total, self._fleet_params)
 
     def _slab_scheduler(self):
         """The SlabScheduler this run should delegate to, or None when
@@ -1730,6 +2038,9 @@ class Simulation:
         rep.attach_metrics(self.metrics)
         if self.sentinel is not None:
             rep.telemetry = self.sentinel.report()
+        fleet_sec = self.fleet_summary()
+        if fleet_sec is not None:
+            rep.fleet = fleet_sec
         rep.headline = headline if headline is not None else {
             "site_seconds_per_s": summary["site_seconds_per_s"],
         }

@@ -65,6 +65,10 @@ class SlabScheduler:
                 chain_offset=off,
                 site_grid=slice_grid(config.site_grid, off, n),
             ))
+        # merged fleet-analytics total across slabs (None when analytics
+        # is off); every risk leaf merges by exact int sum / extremum so
+        # the slabbed fleet section is bit-identical to the unslabbed one
+        self.fleet_total = None
 
     def __len__(self):
         return len(self.slab_cfgs)
@@ -102,6 +106,11 @@ class SlabScheduler:
             with annotate(f"tmhpvsim/slab{si}"):
                 outs.append(sim.run_reduced(on_block=cb))
             gblock += sim.n_blocks
+            if getattr(sim, "_fleet_total", None) is not None:
+                from tmhpvsim_tpu.obs import analytics
+
+                self.fleet_total = analytics.merge_host(
+                    self.fleet_total, sim._fleet_total)
             g_done.set(si + 1)
             del sim  # free the slab's buffers before the next compiles
         return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}

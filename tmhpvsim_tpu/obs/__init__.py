@@ -14,14 +14,15 @@ Host-side modules (plus one device-side fold):
 * :mod:`~tmhpvsim_tpu.obs.telemetry` — the in-graph numerics
   accumulator that rides the device scan carry (the one part of obs that
   DOES run inside jit; lazily imported here because it needs jax);
+* :mod:`~tmhpvsim_tpu.obs.analytics` — the in-graph fleet-risk
+  accumulator (residual quantile sketch, exceedance curve, LOLP,
+  ramp-rate extrema); same jit-resident carry pattern as telemetry,
+  same lazy import;
 * :mod:`~tmhpvsim_tpu.obs.sentinel` — the drift sentinel comparing
   leading-block means against the float64 golden models
   (``DriftSentinel``, ``DriftError``);
 * :mod:`~tmhpvsim_tpu.obs.trace` — the asyncio-task-aware streaming
   event tracer + flight recorder (Chrome-trace JSON export).
-
-``engine/profiling.py`` remains as a compatibility shim re-exporting
-the profiler names.
 """
 
 from tmhpvsim_tpu.obs.metrics import (  # noqa: F401
@@ -57,11 +58,12 @@ from tmhpvsim_tpu.obs.trace import (  # noqa: F401
 
 
 def __getattr__(name):
-    # obs.telemetry imports jax at module scope (it builds jit-resident
-    # accumulators); the runtime layers import this package from
-    # jax-free contexts, so the submodule loads lazily on first touch
-    if name == "telemetry":
+    # obs.telemetry/obs.analytics import jax at module scope (they build
+    # jit-resident accumulators); the runtime layers import this package
+    # from jax-free contexts, so those submodules load lazily on first
+    # touch
+    if name in ("telemetry", "analytics"):
         import importlib
 
-        return importlib.import_module("tmhpvsim_tpu.obs.telemetry")
+        return importlib.import_module(f"tmhpvsim_tpu.obs.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,511 @@
+"""On-device fleet analytics: risk statistics folded inside the scan.
+
+Where ``obs/telemetry.py`` answers "is the simulation healthy?", this
+module answers the grid operator's question — "what is the risk?" — with
+the same machinery: a ``FleetAcc``, a flat pytree of fixed-size sketches
+riding the scan carry next to the reduce statistics and the
+``TelemetryAcc``, folded per second *inside* the jit so a million-site
+year leaves the device as a few KB of decision-ready numbers instead of
+per-second arrays:
+
+* a **residual-load quantile sketch**: a fixed equi-width histogram of
+  ``residual = meter - pv`` over ``[lo, hi)`` with explicit under/overflow
+  slots plus exact running min/max — :func:`summarize` interpolates
+  p1/p5/p50/p95/p99 from it.  Rank error is bounded by the mass of the
+  quantile's bin: with the default 2048 bins over ``[-meter_max_w,
+  +meter_max_w)`` the reference 1e6-sample acceptance run sits well
+  inside the 0.5 % rank-error budget (tests/test_analytics.py);
+* an **exceedance curve** over a configurable threshold grid: seconds
+  with ``residual > threshold_j`` for each threshold, folded as one
+  searchsorted + scatter-add per second;
+* **loss-of-load probability**: seconds (and distinct events) in which
+  ``residual > capacity_w`` has persisted for ``>= lolp_k`` consecutive
+  seconds, via an in-carry run-length counter;
+* **ramp-rate extremes**: ``max |Δresidual|`` over 1 s / 60 s / 3600 s
+  windows.  Each window keeps one previous-sample ring slot per chain in
+  the carry (the sample grid is every w-th second), so the 3600 s window
+  costs one ``(n_chains,)`` vector, not a 3600-deep ring buffer;
+* at level ``full``: per-Markov-regime (cloud covered / clear)
+  conditional means of meter, pv and residual.
+
+**Exactness contract** (what makes the sketches merge associatively):
+every ``risk``-level leaf is either an int32 count or a running extremum
+— both exactly associative — so slab partitions, ``blocks_per_dispatch``
+mega-blocks and ``psum``/``pmin``/``pmax`` across the mesh
+(``parallel/distributed.psum_fleet``) produce *bit-identical* fleet
+sections regardless of merge order.  Only the ``full``-level
+conditional-mean float sums reassociate (relative error of order
+``block_s * eps``).  int32 bound: one block's per-shard counts stay
+exact while ``n_chains * block_s < 2**31`` (~248k chains at the default
+8640 s block); the host-side run totals (:func:`merge_host`) widen to
+int64 / float64.
+
+Like the TelemetryAcc, the accumulator is zero-initialised *inside* the
+block jit, so each block is a pure per-block delta and mesh psums never
+double-count.  Consequence: the LOLP run-length counter and the ramp
+previous-sample slots reset at block (and slab) boundaries — a loss run
+or ramp pair spanning a boundary is split.  Runs no longer than one
+block match a NumPy oracle exactly (the acceptance test's regime); at
+operational block sizes the seam bias is a conservative undercount of
+order ``lolp_k / block_s``.
+
+Levels: ``off`` (analytics structurally absent from the traced graph —
+byte-identical HLO, asserted by tests), ``risk`` (sketch + exceedance +
+LOLP + ramps), ``full`` (risk + per-regime conditional means).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: valid values for SimConfig.analytics / Plan.analytics / --analytics
+ANALYTICS_LEVELS = ("off", "risk", "full")
+
+#: sample-grid windows [s] for the ramp-rate extrema
+RAMP_WINDOWS = (1, 60, 3600)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetParams:
+    """Static sketch geometry: resolved once per run, baked into the jit.
+
+    Everything here is a compile-time constant of the block step (python
+    floats/tuples closed over by the fold), so two shards/slabs of one
+    run always classify a given residual sample identically — the
+    premise of the bit-identical-merge contract.
+    """
+
+    #: residual histogram support [W): samples outside land in the
+    #: explicit under/overflow slots
+    lo: float
+    hi: float
+    #: interior histogram bins (equi-width over [lo, hi))
+    bins: int
+    #: exceedance thresholds [W], strictly ascending
+    thresholds: tuple
+    #: loss-of-load capacity [W]: residual above this is a loss second
+    capacity_w: float
+    #: consecutive loss seconds before a run counts as loss of load
+    lolp_k: int
+    ramp_windows: tuple = RAMP_WINDOWS
+
+    def __post_init__(self):
+        if not self.hi > self.lo:
+            raise ValueError(f"FleetParams: hi {self.hi} must be > lo {self.lo}")
+        if self.bins < 1:
+            raise ValueError(f"FleetParams: bins {self.bins} must be >= 1")
+        if self.lolp_k < 1:
+            raise ValueError(f"FleetParams: lolp_k {self.lolp_k} must be >= 1")
+        th = tuple(float(t) for t in self.thresholds)
+        if not th:
+            raise ValueError("FleetParams: thresholds must be non-empty")
+        if any(b <= a for a, b in zip(th, th[1:])):
+            raise ValueError(
+                f"FleetParams: thresholds {th} must be strictly ascending")
+        object.__setattr__(self, "thresholds", th)
+        rw = tuple(int(w) for w in self.ramp_windows)
+        if any(w < 1 for w in rw) or any(
+                b <= a for a, b in zip(rw, rw[1:])):
+            raise ValueError(
+                f"FleetParams: ramp_windows {rw} must be strictly "
+                "ascending positive ints")
+        object.__setattr__(self, "ramp_windows", rw)
+
+
+def params_from_config(config) -> FleetParams:
+    """Resolve sketch geometry from a SimConfig.
+
+    Defaults size everything off ``meter_max_w`` (the demand upper
+    bound): residual lives in roughly ``(-pv_max, meter_max_w)``, so the
+    sketch spans ``[-meter_max_w, +meter_max_w)``; the threshold grid is
+    the 1/8..7/8 fractions of max demand; LOLP capacity defaults to 80 %
+    of max demand with a 60 s persistence requirement.
+    """
+    mx = float(config.meter_max_w)
+    th = getattr(config, "analytics_thresholds", None)
+    cap = getattr(config, "analytics_capacity_w", None)
+    return FleetParams(
+        lo=-mx,
+        hi=mx,
+        bins=int(getattr(config, "analytics_bins", 2048)),
+        thresholds=(tuple(th) if th
+                    else tuple(mx * f / 8.0 for f in range(1, 8))),
+        capacity_w=(float(cap) if cap is not None else 0.8 * mx),
+        lolp_k=int(getattr(config, "analytics_lolp_k", 60)),
+    )
+
+
+def init_acc(level: str, dtype=jnp.float32, n_chains=None, *,
+             params: FleetParams) -> dict:
+    """Fresh zeroed FleetAcc pytree for one block.
+
+    Flat dict, mirroring ``telemetry.init_acc``: with ``n_chains`` the
+    extremum/LOLP/ramp/regime leaves are per-chain vectors folded
+    elementwise by :func:`fold_second` (plus carry-only ring slots
+    ``prev_ramp_*`` / ``seen_ramp_*`` / ``lol_run`` that
+    :func:`reduce_chainwise` drops); the histogram and exceedance
+    sketches are shared scatter-add targets either way.  Without
+    ``n_chains`` this is the scalar (shard-level) form that
+    :func:`fold_wide`, ``psum_fleet`` and :func:`summarize` consume.
+    min/max start at +/-finfo.max (not inf — inf survives pmin/pmax but
+    poisons the observed heuristic in :func:`summarize`).
+    """
+    if level not in ("risk", "full"):
+        raise ValueError(f"init_acc: analytics level {level!r} must be "
+                         f"'risk' or 'full'")
+    dt = jnp.dtype(dtype)
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    shape = () if n_chains is None else (int(n_chains),)
+    acc = {
+        "count": jnp.zeros((), jnp.int32),
+        "res_hist": jnp.zeros((params.bins + 2,), jnp.int32),
+        "exceed": jnp.zeros((len(params.thresholds) + 1,), jnp.int32),
+        "min_res": jnp.full(shape, big, dt),
+        "max_res": jnp.full(shape, -big, dt),
+        "lol_seconds": jnp.zeros(shape, jnp.int32),
+        "lol_events": jnp.zeros(shape, jnp.int32),
+    }
+    for w in params.ramp_windows:
+        acc[f"max_ramp_{w}s"] = jnp.full(shape, -big, dt)
+    if n_chains is not None:
+        acc["lol_run"] = jnp.zeros(shape, jnp.int32)
+        for w in params.ramp_windows:
+            acc[f"prev_ramp_{w}s"] = jnp.zeros(shape, dt)
+            acc[f"seen_ramp_{w}s"] = jnp.zeros(shape, jnp.int32)
+    if level == "full":
+        acc["regime_observed"] = jnp.zeros((), jnp.int32)
+        acc["cov_count"] = jnp.zeros(shape, jnp.int32)
+        for f in ("meter", "pv", "residual"):
+            acc[f"sum_{f}"] = jnp.zeros(shape, dt)
+            acc[f"cov_sum_{f}"] = jnp.zeros(shape, dt)
+    return acc
+
+
+def leaf_kinds(acc: dict) -> dict:
+    """Cross-shard reduction kind per leaf: 'min' | 'max' | 'sum'.
+
+    ``regime_observed`` is a seen-flag, not a count: max keeps it 0/1
+    under psum-style merges of any width.
+    """
+    return {
+        k: ("min" if k.startswith("min_")
+            else "max" if k.startswith("max_") or k == "regime_observed"
+            else "sum")
+        for k in acc
+    }
+
+
+def fold_second(acc: dict, level: str, params: FleetParams, *, meter, pv,
+                residual, covered, t, valid) -> dict:
+    """Fold one second of per-chain ``(n_chains,)`` vectors into a
+    **per-chain** acc (``init_acc(..., n_chains=n)``).
+
+    ``t`` is the scalar global second index the scan body already
+    carries (``x["t"]``) — it drives the ramp sample grids.  ``valid``
+    is the scalar duration mask.  A non-finite residual sample drops the
+    whole second from every statistic (``use`` mask); by IEEE semantics
+    a finite residual implies finite meter and pv, so the single mask is
+    sufficient for the conditional means too.
+    """
+    dt = acc["min_res"].dtype
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    r = residual.astype(dt)
+    use = valid & jnp.isfinite(r)
+    uz = use.astype(jnp.int32)
+    out = dict(acc)
+    out["count"] = acc["count"] + uz.sum(dtype=jnp.int32)
+    # residual histogram: clip in float BEFORE the int cast (out-of-range
+    # float->int conversion is target-defined), under/overflow -> slots
+    # 0 / bins+1, interior [lo, hi) -> slots 1..bins
+    inv_w = params.bins / (params.hi - params.lo)
+    b = jnp.clip(jnp.where(use, (r - params.lo) * inv_w, 0.0),
+                 -1.0, float(params.bins))
+    idx = jnp.floor(b).astype(jnp.int32) + 1
+    out["res_hist"] = acc["res_hist"].at[idx].add(uz)
+    # exceedance: slot i counts seconds with exactly i thresholds below
+    # r (searchsorted 'left' == #{th_j < r}); summarize suffix-sums
+    th = jnp.asarray(params.thresholds, dt)
+    rg = jnp.where(use, r, params.lo)
+    slot = jnp.searchsorted(th, rg, side="left").astype(jnp.int32)
+    out["exceed"] = acc["exceed"].at[slot].add(uz)
+    out["min_res"] = jnp.minimum(acc["min_res"], jnp.where(use, r, big))
+    out["max_res"] = jnp.maximum(acc["max_res"], jnp.where(use, r, -big))
+    # loss of load: in-carry run length of consecutive exceedance seconds
+    exc = (r > params.capacity_w) & use
+    run = jnp.where(exc, acc["lol_run"] + 1, 0)
+    out["lol_events"] = acc["lol_events"] + (run == params.lolp_k)
+    out["lol_seconds"] = acc["lol_seconds"] + (run >= params.lolp_k)
+    out["lol_run"] = run
+    # ramp extrema: sample grid S_w = {t : (t+1) % w == 0}; a pair
+    # counts only when BOTH endpoints are usable (seen resets on an
+    # unusable grid sample — identical semantics to fold_wide's slices)
+    for w in params.ramp_windows:
+        at = ((t + 1) % w) == 0 if w > 1 else jnp.asarray(True)
+        prev = acc[f"prev_ramp_{w}s"]
+        seen = acc[f"seen_ramp_{w}s"]
+        d = jnp.abs(r - prev)
+        ok = at & use & (seen > 0)
+        out[f"max_ramp_{w}s"] = jnp.where(
+            ok, jnp.maximum(acc[f"max_ramp_{w}s"], d),
+            acc[f"max_ramp_{w}s"])
+        out[f"prev_ramp_{w}s"] = jnp.where(at & use, r, prev)
+        out[f"seen_ramp_{w}s"] = jnp.where(at, uz, seen)
+    if level == "full":
+        # covered arrives as the model's 0/1 float mask, not bool
+        cov = (covered != 0) & use
+        out["regime_observed"] = jnp.ones_like(acc["regime_observed"])
+        out["cov_count"] = acc["cov_count"] + cov
+        for name, v in (("meter", meter), ("pv", pv), ("residual", r)):
+            v = v.astype(dt)
+            v0 = jnp.where(use, v, jnp.zeros_like(v))
+            out[f"sum_{name}"] = acc[f"sum_{name}"] + v0
+            out[f"cov_sum_{name}"] = acc[f"cov_sum_{name}"] + jnp.where(
+                cov, v, jnp.zeros_like(v))
+    return out
+
+
+def reduce_chainwise(acc: dict) -> dict:
+    """Collapse a per-chain FleetAcc to the scalar (shard-level) form —
+    once per block, after the scan, inside the same jit.  Drops the
+    carry-only ring slots; the result's leaf set matches
+    ``init_acc(level, dtype, params=...)`` so psum dispatch,
+    :func:`merge_host` and :func:`summarize` see one format.
+    """
+    out = {}
+    for k, v in acc.items():
+        if k == "lol_run" or k.startswith(("prev_ramp_", "seen_ramp_")):
+            continue
+        if k.startswith("min_"):
+            out[k] = v.min()
+        elif k.startswith("max_"):
+            out[k] = v.max()
+        elif k in ("count", "res_hist", "exceed", "regime_observed"):
+            out[k] = v  # already shard-level
+        elif v.dtype == jnp.int32:
+            out[k] = v.sum(dtype=jnp.int32)
+        else:
+            out[k] = v.sum()
+    return out
+
+
+def fold_wide(acc: dict, level: str, params: FleetParams, *, meter, pv,
+              t, duration_s) -> dict:
+    """Fold materialised ``(n_chains, T)`` block arrays into a
+    **scalar-form** acc.
+
+    Same per-second classification as :func:`fold_second` (bit-identical
+    int leaves), vectorised: run lengths via a cummax trick, ramp grids
+    as static strided slices.  The wide impl never materialises the
+    Markov cloud state, so the ``full`` regime leaves stay unfolded and
+    ``regime_observed`` stays 0 (:func:`summarize` reports regimes as
+    unobserved) — mirroring telemetry's unobserved csi.
+    """
+    del level
+    dt = acc["min_res"].dtype
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    T = meter.shape[1]
+    r = (meter - pv).astype(dt)
+    valid = t < duration_s                     # (T,)
+    use = valid[None, :] & jnp.isfinite(r)     # (n, T)
+    uz = use.astype(jnp.int32)
+    out = dict(acc)
+    out["count"] = acc["count"] + uz.sum(dtype=jnp.int32)
+    inv_w = params.bins / (params.hi - params.lo)
+    b = jnp.clip(jnp.where(use, (r - params.lo) * inv_w, 0.0),
+                 -1.0, float(params.bins))
+    idx = jnp.floor(b).astype(jnp.int32) + 1
+    out["res_hist"] = acc["res_hist"].at[idx.ravel()].add(uz.ravel())
+    th = jnp.asarray(params.thresholds, dt)
+    rg = jnp.where(use, r, params.lo)
+    slot = jnp.searchsorted(th, rg.ravel(), side="left").astype(jnp.int32)
+    out["exceed"] = acc["exceed"].at[slot].add(uz.ravel())
+    out["min_res"] = jnp.minimum(
+        acc["min_res"], jnp.where(use, r, big).min().astype(dt))
+    out["max_res"] = jnp.maximum(
+        acc["max_res"], jnp.where(use, r, -big).max().astype(dt))
+    # run length ending at column i = i - (last non-loss column <= i)
+    exc = (r > params.capacity_w) & use
+    tidx = jnp.arange(T, dtype=jnp.int32)
+    last_not = jax.lax.cummax(
+        jnp.where(exc, jnp.int32(-1), tidx[None, :]), axis=1)
+    runlen = tidx[None, :] - last_not
+    out["lol_seconds"] = acc["lol_seconds"] + (
+        exc & (runlen >= params.lolp_k)).sum(dtype=jnp.int32)
+    out["lol_events"] = acc["lol_events"] + (
+        exc & (runlen == params.lolp_k)).sum(dtype=jnp.int32)
+    for w in params.ramp_windows:
+        key = f"max_ramp_{w}s"
+        if w >= T:  # no intra-block pair exists at this block size
+            continue
+        at = ((t + 1) % w) == 0 if w > 1 else jnp.ones((T,), bool)
+        d = jnp.abs(r[:, w:] - r[:, :-w])
+        pair_ok = at[w:][None, :] & use[:, w:] & use[:, :-w]
+        cand = jnp.where(pair_ok, d, -big).max().astype(dt)
+        out[key] = jnp.maximum(acc[key], cand)
+    return out
+
+
+def merge_host(total: Optional[dict], delta: dict) -> Optional[dict]:
+    """Host-side run-total merge of (fetched) scalar-form FleetAccs.
+
+    Widens int32 counts to int64 and float sums to float64 so run totals
+    stay exact past the per-block int32 bound; extrema keep their
+    compute dtype (selection is exact at any width).  ``total=None``
+    starts a fresh total from ``delta``.
+    """
+    kinds = leaf_kinds(delta)
+
+    def widen(k, v):
+        v = np.asarray(v)
+        if kinds[k] in ("min", "max"):
+            return v.copy()
+        if v.dtype.kind in "iu":
+            return v.astype(np.int64)
+        return v.astype(np.float64)
+
+    if total is None:
+        return {k: widen(k, v) for k, v in delta.items()}
+    op = {"sum": np.add, "min": np.minimum, "max": np.maximum}
+    return {k: op[kinds[k]](total[k], widen(k, v))
+            for k, v in delta.items()}
+
+
+def _quantile(q: float, cum, edges_lo, edges_hi, counts, mn, mx,
+              count: int) -> float:
+    """Linear-interpolation quantile from cumulative histogram mass.
+
+    Deterministic host float64 math on the (identical) integer counts,
+    so equal sketches give bit-equal quantiles.
+    """
+    target = q * count
+    i = int(np.searchsorted(cum, target, side="left"))
+    i = min(i, len(counts) - 1)
+    below = cum[i] - counts[i]
+    frac = (target - below) / counts[i] if counts[i] else 0.0
+    v = edges_lo[i] + frac * (edges_hi[i] - edges_lo[i])
+    return float(min(max(v, mn), mx))
+
+
+def summarize(acc: dict, params: FleetParams) -> dict:
+    """Host-side reduction of a (fetched or host-merged) scalar-form
+    FleetAcc into the plain-python ``fleet`` report section."""
+    host = {k: np.asarray(v) for k, v in acc.items()}
+    dt = host["min_res"].dtype
+    big = float(np.finfo(dt).max)
+    count = int(host["count"])
+    mn = float(host["min_res"])
+    mx = float(host["max_res"])
+    observed = count > 0 and mn < 0.5 * big and mx > -0.5 * big
+    level = "full" if "cov_count" in host else "risk"
+
+    quantiles = None
+    hist = host["res_hist"].astype(np.int64)
+    if observed:
+        width = (params.hi - params.lo) / params.bins
+        interior_lo = params.lo + width * np.arange(params.bins)
+        # under/overflow slots span [min, lo] and [hi, max] (clamped so
+        # a degenerate all-interior run keeps monotone edges)
+        edges_lo = np.concatenate(
+            [[min(mn, params.lo)], interior_lo, [params.hi]])
+        edges_hi = np.concatenate(
+            [[params.lo], interior_lo + width, [max(mx, params.hi)]])
+        cum = np.cumsum(hist)
+        quantiles = {
+            f"p{int(q * 100)}": _quantile(
+                q, cum, edges_lo, edges_hi, hist, mn, mx, count)
+            for q in (0.01, 0.05, 0.50, 0.95, 0.99)
+        }
+
+    exceed = host["exceed"].astype(np.int64)
+    # slot i = seconds with exactly i thresholds below r, so seconds
+    # with r > th_j = total mass in slots j+1..
+    suffix = np.cumsum(exceed[::-1])[::-1]
+    exceedance = [
+        {"threshold_w": float(th),
+         "seconds": int(suffix[j + 1]),
+         "prob": float(suffix[j + 1] / count) if count else 0.0}
+        for j, th in enumerate(params.thresholds)
+    ]
+
+    loss_s = int(host["lol_seconds"])
+    events = int(host["lol_events"])
+    ramp = {}
+    for w in params.ramp_windows:
+        v = float(host[f"max_ramp_{w}s"])
+        ramp[f"{w}s"] = v if v > -0.5 * big else None
+
+    out = {
+        "level": level,
+        "count": count,
+        "residual": {
+            "min": mn if observed else None,
+            "max": mx if observed else None,
+            "quantiles": quantiles,
+        },
+        "exceedance": exceedance,
+        "lolp": {
+            "capacity_w": float(params.capacity_w),
+            "k_s": int(params.lolp_k),
+            "loss_seconds": loss_s,
+            "events": events,
+            "prob": float(loss_s / count) if count else 0.0,
+        },
+        "ramp": ramp,
+        "sketch": {
+            "bins": int(params.bins),
+            "lo_w": float(params.lo),
+            "hi_w": float(params.hi),
+            "width_w": float((params.hi - params.lo) / params.bins),
+            "underflow": int(hist[0]),
+            "overflow": int(hist[-1]),
+        },
+        "regimes": None,
+    }
+    if level == "full" and int(host["regime_observed"]):
+        cov_n = int(host["cov_count"])
+        clr_n = count - cov_n
+        regimes = {}
+        for name, n in (("covered", cov_n), ("clear", clr_n)):
+            means = {}
+            for f in ("meter", "pv", "residual"):
+                s = float(host[f"cov_sum_{f}"]) if name == "covered" else (
+                    float(host[f"sum_{f}"]) - float(host[f"cov_sum_{f}"]))
+                means[f"{f}_mean"] = s / n if n else None
+            regimes[name] = {"seconds": n, **means}
+        out["regimes"] = regimes
+    return out
+
+
+def publish(registry, summary: dict) -> None:
+    """Flush one block summary into the metrics registry
+    (``device.fleet.*``).  Counters accumulate across blocks; gauges
+    hold the latest block's values."""
+    registry.counter("device.fleet.blocks_total").inc()
+    registry.counter("device.fleet.samples_total").inc(summary["count"])
+    lolp = summary["lolp"]
+    registry.counter("device.fleet.loss_seconds_total").inc(
+        lolp["loss_seconds"])
+    registry.counter("device.fleet.lol_events_total").inc(lolp["events"])
+    registry.gauge("device.fleet.lolp").set(lolp["prob"])
+    res = summary["residual"]
+    for k in ("min", "max"):
+        if res[k] is not None:
+            registry.gauge(f"device.fleet.residual.{k}").set(res[k])
+    for k in ("p50", "p95", "p99"):
+        if res["quantiles"] is not None:
+            registry.gauge(f"device.fleet.residual.{k}").set(
+                res["quantiles"][k])
+    for w, v in summary["ramp"].items():
+        if v is not None:
+            registry.gauge(f"device.fleet.ramp.{w}").set(v)
+
+
+def repl_view(acc: dict, repl_view_fn) -> dict:
+    """Fetch every leaf to host numpy via the sim's replicated-view
+    helper (handles non-addressable sharded arrays)."""
+    return {k: np.asarray(repl_view_fn(v)) for k, v in acc.items()}

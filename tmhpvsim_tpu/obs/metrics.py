@@ -134,14 +134,18 @@ def quantile_from_snapshot(snap: Optional[dict], q: float) -> Optional[float]:
     interpolation within the cumulative buckets (Prometheus
     ``histogram_quantile`` semantics), clamped to the observed [min, max]
     so a handful of sub-bucket latencies cannot report a bucket-bound
-    worth of latency.  None when the histogram is empty/absent."""
+    worth of latency.  None when the histogram is empty/absent — an
+    empty/zero-count/bucketless snapshot is a valid "nothing observed"
+    answer, never an exception (report assembly calls this on whatever
+    the run left behind)."""
     if not snap or not snap.get("count"):
         return None
     count = snap["count"]
     target = q * count
     lo_bound, lo_cum = 0.0, 0
     value = None
-    for bound, cum in snap.get("buckets", ()):
+    # `or ()`: snapshots rebuilt from JSON may carry buckets=null
+    for bound, cum in (snap.get("buckets") or ()):
         if cum >= target:
             frac = (target - lo_cum) / max(1, cum - lo_cum)
             value = lo_bound + frac * (bound - lo_bound)

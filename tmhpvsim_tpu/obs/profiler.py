@@ -1,6 +1,7 @@
 """Block timing, trace annotations, and platform-guarded device traces.
 
-Absorbs ``engine/profiling.py`` (kept there as a re-export shim) and
+Absorbs the late ``engine/profiling.py`` (its re-export shim warned for
+one release and is now removed — see MIGRATION.md) and
 hardens it around the round-5 failure mode: the "device" traces in
 ``benchmarks/profile_r05`` were silently CPU-fallback captures — the
 env-pinned TPU tunnel had flipped the process to CPU before the trace

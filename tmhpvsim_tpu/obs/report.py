@@ -36,9 +36,13 @@ logger = logging.getLogger(__name__)
 #: from the persistent compilation cache, dispatch counts and the
 #: blocks-per-dispatch factor, AOT warm-up stats — engine/compilecache.py)
 #: and the ``blocks_per_dispatch`` field to the plan echo.
+#: v5: adds the optional ``fleet`` section (on-device fleet-risk
+#: analytics: residual quantile sketch, exceedance curve,
+#: loss-of-load probability, ramp-rate extrema, per-regime conditional
+#: means — obs/analytics.py ``summarize``).
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 4
+REPORT_SCHEMA_VERSION = 5
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -65,6 +69,7 @@ _TOP_SCHEMA = {
     "telemetry": (False, _OPT_DICT),
     "streaming": (False, _OPT_DICT),
     "executor": (False, _OPT_DICT),
+    "fleet": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -334,6 +339,9 @@ class RunReport:
         #: ``executor.*`` metric names by :meth:`attach_metrics` (or set
         #: directly from ``engine.compilecache.executor_doc()``)
         self.executor: Optional[dict] = None
+        #: fleet-analytics section (schema v5): the host summary of the
+        #: run's merged FleetAcc (obs/analytics.py ``summarize``)
+        self.fleet: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -401,6 +409,7 @@ class RunReport:
             "telemetry": self.telemetry,
             "streaming": self.streaming,
             "executor": self.executor,
+            "fleet": self.fleet,
         }
         return validate_report(out) if validate else out
 

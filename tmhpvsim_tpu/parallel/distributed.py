@@ -115,6 +115,20 @@ def psum_telemetry(ta: dict, axis_name: str) -> dict:
     return {k: coll[kinds[k]](v, axis_name) for k, v in ta.items()}
 
 
+def psum_fleet(fa: dict, axis_name: str) -> dict:
+    """Mesh-wide reduction of a per-shard FleetAcc (traced, inside
+    shard_map).  Same shape as :func:`psum_telemetry` with the kind
+    dispatch from ``obs.analytics.leaf_kinds``; every ``risk``-level
+    leaf is an int32 count (psum) or extremum (pmin/pmax), so the
+    reduction is exactly associative — the sharded fleet section is
+    bit-identical to the single-device one."""
+    from tmhpvsim_tpu.obs.analytics import leaf_kinds
+
+    coll = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
+    kinds = leaf_kinds(fa)
+    return {k: coll[kinds[k]](v, axis_name) for k, v in fa.items()}
+
+
 def gather_metrics(snapshot: dict) -> list:
     """Every process's metrics snapshot, in process-index order.
 

@@ -133,6 +133,20 @@ class ShardedSimulation(Simulation):
                 self._block_step_scan2_acc_tel
             )
             self._wide_tel_jit = self._build_sharded_wide_tel()
+        if self._analytics != "off":
+            if self._telemetry != "off":
+                self._scan_acc_tel_fleet_jit = \
+                    self._build_sharded_scan_acc_tel_fleet()
+                self._scan2_acc_tel_fleet_jit = \
+                    self._build_sharded_scan_acc_tel_fleet(
+                        self._block_step_scan2_acc_tel_fleet)
+            else:
+                self._scan_acc_fleet_jit = \
+                    self._build_sharded_scan_acc_fleet()
+                self._scan2_acc_fleet_jit = \
+                    self._build_sharded_scan_acc_fleet(
+                        self._block_step_scan2_acc_fleet)
+            self._wide_fleet_jit = self._build_sharded_wide_fleet()
         self._warm_start()
 
     def init_state(self):
@@ -242,6 +256,71 @@ class ShardedSimulation(Simulation):
         )
         return jax.jit(mapped)
 
+    def _build_sharded_scan_acc_fleet(self, fn=None):
+        """Fleet-analytics variant of ``_build_sharded_scan_acc``: each
+        shard folds its own FleetAcc inside the scan, then the per-block
+        sketch deltas psum/pmin/pmax over the mesh
+        (parallel/distributed.psum_fleet) — every risk leaf is an int32
+        count or extremum, so the reduction is exactly associative and
+        the replicated result is bit-identical to a single-device run."""
+        from tmhpvsim_tpu.parallel import distributed
+
+        inner = self._block_step_scan_acc_fleet if fn is None else fn
+
+        def step(state, inputs, acc):
+            state, acc, fa = inner(state, inputs, acc)
+            return state, acc, distributed.psum_fleet(fa, CHAIN_AXIS)
+
+        spec_c, spec_r = P(CHAIN_AXIS), P()
+        mapped = shard_map(
+            step, mesh=self.mesh,
+            in_specs=(spec_c, spec_r, spec_c),
+            out_specs=(spec_c, spec_c, spec_r),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 2))
+
+    def _build_sharded_scan_acc_tel_fleet(self, fn=None):
+        """Both accumulators riding the sharded scan (telemetry AND
+        analytics on): one psum tree each per block, both replicated."""
+        from tmhpvsim_tpu.parallel import distributed
+
+        inner = (self._block_step_scan_acc_tel_fleet if fn is None
+                 else fn)
+
+        def step(state, inputs, acc):
+            state, acc, ta, fa = inner(state, inputs, acc)
+            return (state, acc,
+                    distributed.psum_telemetry(ta, CHAIN_AXIS),
+                    distributed.psum_fleet(fa, CHAIN_AXIS))
+
+        spec_c, spec_r = P(CHAIN_AXIS), P()
+        mapped = shard_map(
+            step, mesh=self.mesh,
+            in_specs=(spec_c, spec_r, spec_c),
+            out_specs=(spec_c, spec_c, spec_r, spec_r),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 2))
+
+    def _build_sharded_wide_fleet(self):
+        """Wide-impl fleet fold under shard_map: per-shard scalar-form
+        fold over the materialised meter/pv arrays, mesh-reduced like
+        the scan variant."""
+        from tmhpvsim_tpu.parallel import distributed
+
+        def fold(meter, pv, t):
+            fa = self._wide_fleet(meter, pv, t)
+            return distributed.psum_fleet(fa, CHAIN_AXIS)
+
+        mapped = shard_map(
+            fold, mesh=self.mesh,
+            in_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
     def _build_sharded_scan_series(self, series_fn=None):
         """Ensemble mode's scan-fused step under shard_map (``series_fn``
         picks the flat or nested variant): each shard scans its chains and
@@ -284,28 +363,39 @@ class ShardedSimulation(Simulation):
         )
         return jax.jit(mapped)
 
-    def _build_mega_acc(self, k, tel):
+    def _build_mega_acc(self, k, tel, fleet=False):
         """Sharded multi-block fused dispatch, reduce path: the shard_map
         sits OUTSIDE the outer ``lax.scan`` so the whole K-block
         megablock is one SPMD program per shard — still zero in-loop
-        collectives on the acc path, and under telemetry the per-block
-        deltas take the same one-psum-per-block tree as the per-block
-        wrapper (``_build_sharded_scan_acc_tel``), just issued from
-        inside the scan body.  Stacked per-block acc snapshots come back
-        chain-sharded on axis 1; stacked tel deltas are replicated."""
+        collectives on the acc path, and under telemetry/analytics the
+        per-block deltas take the same one-psum-per-block tree as the
+        per-block wrappers (``_build_sharded_scan_acc_tel`` /
+        ``_build_sharded_scan_acc_fleet``), just issued from inside the
+        scan body.  Stacked per-block acc snapshots come back
+        chain-sharded on axis 1; stacked tel/fleet deltas are
+        replicated."""
         from tmhpvsim_tpu.parallel import distributed
 
-        fn = self._mega_block_fn("acc_tel" if tel else "acc")
+        kind = "acc" + ("_tel" if tel else "") + ("_fleet" if fleet else "")
+        fn = self._mega_block_fn(kind)
 
         def mega(state, xs, acc, const):
             def body(carry, x):
                 st, a = carry
                 inputs = self._merge_inputs(x, const)
+                out = fn(st, inputs, a)
+                st, a = out[0], out[1]
+                extras = []
+                idx = 2
                 if tel:
-                    st, a, ta = fn(st, inputs, a)
-                    return (st, a), (
-                        a, distributed.psum_telemetry(ta, CHAIN_AXIS))
-                st, a = fn(st, inputs, a)
+                    extras.append(
+                        distributed.psum_telemetry(out[idx], CHAIN_AXIS))
+                    idx += 1
+                if fleet:
+                    extras.append(
+                        distributed.psum_fleet(out[idx], CHAIN_AXIS))
+                if extras:
+                    return (st, a), (a,) + tuple(extras)
                 return (st, a), a
 
             (state, acc), ys = jax.lax.scan(body, (state, acc), xs)
@@ -313,11 +403,12 @@ class ShardedSimulation(Simulation):
 
         spec_c, spec_r = P(CHAIN_AXIS), P()
         spec_k = P(None, CHAIN_AXIS)  # (k, chains, ...) stacked snapshots
+        n_extras = int(tel) + int(fleet)
+        ys_spec = ((spec_k,) + (spec_r,) * n_extras) if n_extras else spec_k
         mapped = shard_map(
             mega, mesh=self.mesh,
             in_specs=(spec_c, spec_r, spec_c, spec_r),
-            out_specs=(spec_c, spec_c,
-                       (spec_k, spec_r) if tel else spec_k),
+            out_specs=(spec_c, spec_c, ys_spec),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 2))

@@ -23,6 +23,12 @@ Accepted document shapes (the repo's bench history spans all four):
   embedded ``run_report.timing`` when present (PR-2 bench docs);
 * a bare obs RunReport document (``kind: tmhpvsim_tpu.run_report``).
 
+The table also carries each row's telemetry/analytics levels (from the
+embedded config echo; pre-instrumentation docs read as 'off') and an
+``ovh%`` column: the instrumented row's steady block wall vs the best
+same-platform uninstrumented row.  ``--json`` emits the rows + gate
+verdict as one JSON document for machine consumers.
+
 No third-party imports: runs anywhere the repo checks out.
 """
 
@@ -76,11 +82,20 @@ def _compile_from_headline(doc: dict) -> float | None:
     return None
 
 
+def _levels(cfg) -> tuple:
+    """(telemetry, analytics) levels from a config echo; pre-PR-3/PR-6
+    documents predate the fields and read as 'off'."""
+    if not isinstance(cfg, dict):
+        cfg = {}
+    return (cfg.get("telemetry") or "off", cfg.get("analytics") or "off")
+
+
 def normalize(path: str) -> dict:
     """One artifact -> a trend row (``failed`` rows carry only a name)."""
     name = os.path.basename(path)
     row = {"name": name, "order": name, "platform": None, "value": None,
-           "compile_s": None, "steady_block_s": None, "failed": True}
+           "compile_s": None, "steady_block_s": None,
+           "telemetry": None, "analytics": None, "failed": True}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -103,22 +118,28 @@ def normalize(path: str) -> dict:
     if doc.get("kind") == REPORT_KIND:            # bare RunReport
         timing = doc.get("timing") or {}
         headline = doc.get("headline") or {}
+        tel, ana = _levels(doc.get("config"))
         row.update(
             failed=False,
             platform=(doc.get("device") or {}).get("platform"),
             value=headline.get("site_seconds_per_s"),
             compile_s=timing.get("compile_s"),
             steady_block_s=timing.get("steady_block_s"),
+            telemetry=tel, analytics=ana,
         )
         return row
 
     if "value" in doc or "variants" in doc:       # headline doc
+        rep = doc.get("run_report")
+        tel, ana = _levels(rep.get("config")
+                           if isinstance(rep, dict) else None)
         row.update(
             failed=False,
             platform=doc.get("platform"),
             value=doc.get("value"),
             compile_s=_compile_from_headline(doc),
             steady_block_s=_steady_from_headline(doc),
+            telemetry=tel, analytics=ana,
         )
         return row
 
@@ -134,14 +155,44 @@ def _fmt(v, unit="") -> str:
     return f"{v:.3f}{unit}" if isinstance(v, float) else f"{v}{unit}"
 
 
+def annotate_overhead(rows: list) -> None:
+    """Attach ``overhead_pct`` to every instrumented row: its steady
+    block wall vs the best same-platform row with BOTH telemetry and
+    analytics off — the table's at-a-glance answer to "what does the
+    in-graph observability cost?".  None when the row is itself
+    uninstrumented, failed, or has no clean-row baseline."""
+    base: dict = {}
+    for r in rows:
+        if r["failed"] or r["steady_block_s"] is None:
+            continue
+        if (r.get("telemetry") or "off") == "off" and \
+                (r.get("analytics") or "off") == "off":
+            p = r["platform"]
+            if p not in base or r["steady_block_s"] < base[p]:
+                base[p] = r["steady_block_s"]
+    for r in rows:
+        r["overhead_pct"] = None
+        if r["failed"] or r["steady_block_s"] is None:
+            continue
+        if (r.get("telemetry") or "off") == "off" and \
+                (r.get("analytics") or "off") == "off":
+            continue
+        b = base.get(r["platform"])
+        if b:
+            r["overhead_pct"] = (r["steady_block_s"] / b - 1.0) * 100.0
+
+
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
-            "steady_block_s", "note")
+            "steady_block_s", "tel", "analytics", "ovh%", "note")
     table = [cols]
     for r in rows:
+        ovh = r.get("overhead_pct")
         table.append((
             r["name"], r["platform"] or "-", _fmt(r["value"]),
             _fmt(r["compile_s"]), _fmt(r["steady_block_s"]),
+            r.get("telemetry") or "-", r.get("analytics") or "-",
+            "-" if ovh is None else f"{ovh:+.1f}",
             r.get("note", ""),
         ))
     widths = [max(len(str(line[i])) for line in table)
@@ -215,6 +266,10 @@ def main(argv=None) -> int:
                     help="allowed steady-state (or throughput) regression "
                          "of the newest round vs the best prior "
                          "same-platform round [%%] (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rows + gate verdict as one JSON "
+                         "document instead of the table (machine "
+                         "consumers; exit code unchanged)")
     args = ap.parse_args(argv)
 
     files = args.files
@@ -227,9 +282,17 @@ def main(argv=None) -> int:
 
     rows = [normalize(p) for p in files]
     rows.sort(key=lambda r: r["order"])
-    print_table(rows)
+    annotate_overhead(rows)
     ok, msg = check_regression(rows, args.max_regress)
-    print(msg)
+    if args.json:
+        print(json.dumps({
+            "rows": rows,
+            "gate": {"ok": ok, "message": msg,
+                     "max_regress_pct": args.max_regress},
+        }, indent=1))
+    else:
+        print_table(rows)
+        print(msg)
     return 0 if ok else 1
 
 

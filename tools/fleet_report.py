@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the ``fleet`` section of run reports.
+
+Accepts any mix of the shapes the repo's tooling writes:
+
+* a bare RunReport JSON (``--run-report``, ``kind ==
+  "tmhpvsim_tpu.run_report"``);
+* a bench doc — one JSON object with an embedded ``run_report`` key
+  (bench.py's per-phase stdout lines / BENCH_*.json);
+* a JSONL stream of either (bench.py batteries append one doc per
+  phase: SWEEP_r05.jsonl and friends).
+
+For every embedded report carrying a ``fleet`` section (schema v5,
+obs/analytics.py ``summarize``), the section is checked against the
+shape ``summarize`` emits — required keys, numeric types, exceedance
+monotonicity, quantile ordering — and printed as a readable risk table.
+
+Exit code 0 when every *present* fleet section validates — reports
+without one (pre-v5 documents, ``--analytics off`` runs) are fine and
+just noted, which is how ``run_tpu_round5b.sh`` consumes this
+non-fatally after each bench doc.  Nonzero means a malformed section:
+the analytics path wrote something ``summarize`` never emits.
+
+No third-party imports: runs anywhere the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+_NUM = (int, float)
+_OPT_NUM = (int, float, type(None))
+
+
+def _check(cond: bool, errors: list, msg: str) -> None:
+    if not cond:
+        errors.append(msg)
+
+
+def validate_fleet(sec) -> list:
+    """Schema errors for one ``fleet`` section (empty list = valid)."""
+    errors: list = []
+    if not isinstance(sec, dict):
+        return [f"fleet section is {type(sec).__name__}, not an object"]
+    for key in ("level", "count", "residual", "exceedance", "lolp",
+                "ramp", "sketch"):
+        if key not in sec:
+            errors.append(f"missing required key {key!r}")
+    if errors:
+        return errors
+    _check(sec["level"] in ("risk", "full"), errors,
+           f"level {sec['level']!r} not 'risk'/'full'")
+    _check(isinstance(sec["count"], int) and sec["count"] >= 0, errors,
+           f"count {sec['count']!r} not a non-negative int")
+
+    res = sec["residual"]
+    if isinstance(res, dict):
+        _check(isinstance(res.get("min"), _OPT_NUM), errors,
+               "residual.min not numeric/null")
+        _check(isinstance(res.get("max"), _OPT_NUM), errors,
+               "residual.max not numeric/null")
+        q = res.get("quantiles")
+        if isinstance(q, dict):
+            vals = []
+            for name in ("p1", "p5", "p50", "p95", "p99"):
+                v = q.get(name)
+                _check(isinstance(v, _NUM), errors,
+                       f"quantile {name} missing/non-numeric")
+                if isinstance(v, _NUM):
+                    vals.append(v)
+            _check(vals == sorted(vals), errors,
+                   f"quantiles not non-decreasing: {vals}")
+        elif q is not None:
+            errors.append("residual.quantiles neither object nor null")
+    else:
+        errors.append("residual is not an object")
+
+    exc = sec["exceedance"]
+    if isinstance(exc, list):
+        secs = []
+        for j, row in enumerate(exc):
+            if not isinstance(row, dict):
+                errors.append(f"exceedance[{j}] not an object")
+                continue
+            for key, types in (("threshold_w", _NUM), ("seconds", int),
+                               ("prob", _NUM)):
+                _check(isinstance(row.get(key), types), errors,
+                       f"exceedance[{j}].{key} missing/mistyped")
+            if isinstance(row.get("seconds"), int):
+                secs.append(row["seconds"])
+        # ascending thresholds => non-increasing exceedance mass
+        _check(all(b <= a for a, b in zip(secs, secs[1:])), errors,
+               f"exceedance seconds not non-increasing: {secs}")
+    else:
+        errors.append("exceedance is not a list")
+
+    lolp = sec["lolp"]
+    if isinstance(lolp, dict):
+        for key, types in (("capacity_w", _NUM), ("k_s", int),
+                           ("loss_seconds", int), ("events", int),
+                           ("prob", _NUM)):
+            _check(isinstance(lolp.get(key), types), errors,
+                   f"lolp.{key} missing/mistyped")
+        if isinstance(lolp.get("prob"), _NUM):
+            _check(0.0 <= lolp["prob"] <= 1.0, errors,
+                   f"lolp.prob {lolp['prob']} outside [0, 1]")
+    else:
+        errors.append("lolp is not an object")
+
+    if isinstance(sec["ramp"], dict):
+        for w, v in sec["ramp"].items():
+            _check(isinstance(v, _OPT_NUM), errors,
+                   f"ramp[{w!r}] not numeric/null")
+    else:
+        errors.append("ramp is not an object")
+
+    sk = sec["sketch"]
+    if isinstance(sk, dict):
+        for key in ("bins", "lo_w", "hi_w", "width_w", "underflow",
+                    "overflow"):
+            _check(isinstance(sk.get(key), _NUM), errors,
+                   f"sketch.{key} missing/non-numeric")
+    else:
+        errors.append("sketch is not an object")
+
+    reg = sec.get("regimes")
+    if reg is not None:
+        if not isinstance(reg, dict):
+            errors.append("regimes neither object nor null")
+        else:
+            for name, row in reg.items():
+                if not isinstance(row, dict) or not isinstance(
+                        row.get("seconds"), int):
+                    errors.append(f"regimes[{name!r}] malformed")
+    return errors
+
+
+def _fmt_w(v) -> str:
+    return "-" if v is None else f"{v:,.1f}"
+
+
+def print_fleet(sec: dict, label: str) -> None:
+    print(f"{label}: fleet risk summary (level={sec['level']}, "
+          f"n={sec['count']:,} chain-seconds)")
+    res = sec["residual"]
+    q = res.get("quantiles") or {}
+    print(f"  residual W  min={_fmt_w(res.get('min'))} "
+          f"p5={_fmt_w(q.get('p5'))} p50={_fmt_w(q.get('p50'))} "
+          f"p95={_fmt_w(q.get('p95'))} p99={_fmt_w(q.get('p99'))} "
+          f"max={_fmt_w(res.get('max'))}")
+    lolp = sec["lolp"]
+    print(f"  lolp        {lolp['prob']:.3e} "
+          f"({lolp['loss_seconds']:,} s / {lolp['events']:,} events; "
+          f"capacity {_fmt_w(lolp['capacity_w'])} W, k={lolp['k_s']} s)")
+    ramps = "  ".join(f"{w}={_fmt_w(v)}" for w, v in sec["ramp"].items())
+    print(f"  ramp W      {ramps}")
+    sk = sec["sketch"]
+    if sk["underflow"] or sk["overflow"]:
+        print(f"  sketch      {int(sk['underflow']):,} under / "
+              f"{int(sk['overflow']):,} over of {int(sk['bins'])} bins "
+              f"[{_fmt_w(sk['lo_w'])}, {_fmt_w(sk['hi_w'])})")
+    rows = [(f"{r['threshold_w']:,.0f}", f"{r['seconds']:,}",
+             f"{r['prob']:.3e}") for r in sec["exceedance"]]
+    if rows:
+        widths = [max(len(r[i]) for r in rows + [("thresh_W", "seconds",
+                                                  "prob")])
+                  for i in range(3)]
+        print("  exceedance  " + "  ".join(
+            h.rjust(w) for h, w in zip(("thresh_W", "seconds", "prob"),
+                                       widths)))
+        for r in rows:
+            print("              " + "  ".join(
+                c.rjust(w) for c, w in zip(r, widths)))
+    reg = sec.get("regimes")
+    if reg:
+        for name, row in reg.items():
+            means = "  ".join(
+                f"{k.removesuffix('_mean')}={_fmt_w(v)}"
+                for k, v in row.items() if k.endswith("_mean"))
+            print(f"  regime      {name}: {row['seconds']:,} s  {means}")
+
+
+def _iter_docs(path: str):
+    """Parsed JSON documents in ``path``: one whole-file document, or
+    one per line (bench batteries write JSONL)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            yield json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+
+
+def _extract_reports(doc):
+    """(label_suffix, report_dict) pairs embedded in one parsed doc."""
+    if not isinstance(doc, dict):
+        return
+    if doc.get("kind") == REPORT_KIND:
+        yield "", doc
+        return
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and rep.get("kind") == REPORT_KIND:
+        label = doc.get("phase") or doc.get("variant") or rep.get("app")
+        yield f"[{label}]" if label else "", rep
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate (and print) every fleet section in one file; True when
+    all present sections pass.  A file with none passes trivially."""
+    name = os.path.basename(path)
+    try:
+        docs = list(_iter_docs(path))
+    except OSError as e:
+        print(f"{name}: UNREADABLE ({e})", file=sys.stderr)
+        return False
+    found = 0
+    ok = True
+    for doc in docs:
+        for suffix, rep in _extract_reports(doc):
+            sec = rep.get("fleet")
+            if sec is None:
+                continue
+            found += 1
+            errors = validate_fleet(sec)
+            if errors:
+                ok = False
+                print(f"{name}{suffix}: INVALID fleet section "
+                      f"({len(errors)} error(s))", file=sys.stderr)
+                for e in errors[:10]:
+                    print(f"  {e}", file=sys.stderr)
+                if len(errors) > 10:
+                    print(f"  ... and {len(errors) - 10} more",
+                          file=sys.stderr)
+            elif not quiet:
+                print_fleet(sec, f"{name}{suffix}")
+    if not found and not quiet:
+        print(f"{name}: no fleet section (analytics off or pre-v5 report)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print RunReport fleet-analytics "
+                    "sections (bare reports, bench docs, or JSONL of "
+                    "either)")
+    ap.add_argument("files", nargs="+", help="report/bench files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the tables (errors still print)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
