@@ -1501,6 +1501,117 @@ def one_variant() -> None:
     print(json.dumps(doc), flush=True)
 
 
+def serve_bench(clients: int, requests_per_client: int) -> None:
+    """Scenario-serving load generator (serve/): one warm in-process
+    server on the local transport, ``clients`` concurrent clients each
+    issuing ``requests_per_client`` sequential queries.  The artifact
+    line records the coalescing ratio (requests per fused dispatch),
+    reply-latency quantiles and the schema-v6 ``serving`` RunReport
+    section — the serving analogue of the block-throughput headline."""
+    import asyncio
+
+    platform, fallback = _probe_or_fallback()
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs.metrics import quantile_from_snapshot
+    from tmhpvsim_tpu.obs.report import serving_section
+    from tmhpvsim_tpu.serve.server import (ScenarioClient, ScenarioServer,
+                                           ServeConfig)
+
+    if platform == "tpu":
+        n_chains, block_s, n_blocks, unroll = 16384, 1080, 2, 8
+    else:
+        # CPU: tiny shape + unroll 1 — the scenario jit's compile time
+        # scales with unroll x vmapped-fold body, and this artifact
+        # measures serving mechanics, not block throughput
+        n_chains, block_s, n_blocks, unroll = 64, 60, 2, 1
+    sim = _make_cfg(n_chains, n_blocks, block_s=block_s,
+                    scan_unroll=unroll)
+    reg = obs_metrics.MetricsRegistry()
+    url = "local://bench-serve"
+    cfg = ServeConfig(sim=sim, url=url, window_s=0.02,
+                      max_batch=max(2, clients), timeout_s=600.0)
+    counts = {"ok": 0, "err": 0}
+
+    async def one_client(ci: int, c: ScenarioClient) -> None:
+        for ri in range(requests_per_client):
+            rep = await c.request(
+                {"demand_scale": 1.0 + 0.05 * ci,
+                 "weather_bias": 1.0 - 0.02 * (ri % 8),
+                 "horizon_s": block_s},
+                mode="reduce", timeout=600.0)
+            counts["ok" if rep.get("ok") else "err"] += 1
+
+    async def run() -> float:
+        server = ScenarioServer(cfg, registry=reg)
+        await server.start()
+        try:
+            async with ScenarioClient(url, cfg.exchange) as warm:
+                # absorb the per-bucket compiles before the timed load
+                await warm.request({"horizon_s": block_s}, timeout=600.0)
+            clis = [ScenarioClient(url, cfg.exchange)
+                    for _ in range(clients)]
+            for c in clis:
+                await c.__aenter__()
+            try:
+                t0 = time.perf_counter()
+                await asyncio.gather(*[one_client(i, c)
+                                       for i, c in enumerate(clis)])
+                return time.perf_counter() - t0
+            finally:
+                for c in clis:
+                    await c.__aexit__(None, None, None)
+        finally:
+            server.begin_drain()
+            await server.stop()
+
+    with obs_metrics.use_registry(reg):
+        wall = asyncio.run(run())
+    snap = reg.snapshot()
+    serving = serving_section(snap) or {}
+    occ = serving.get("occupancy") or {}
+    lat = snap.get("histograms", {}).get("serve.reply_latency_s")
+    total = clients * requests_per_client
+    doc = {
+        "artifact": "scenario-serve load",
+        "platform": platform,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "ok": counts["ok"], "errors": counts["err"],
+        "requests": serving.get("requests"),
+        "replies": serving.get("replies"),
+        "batches": serving.get("batches"),
+        # the serving win in one number: >1 means concurrent requests
+        # rode shared fused dispatches
+        "coalescing": round(total / serving["batches"], 2)
+        if serving.get("batches") else None,
+        "occupancy_mean": round(occ["mean"], 2)
+        if occ.get("mean") is not None else None,
+        "occupancy_max": occ.get("max"),
+        "reply_p50_ms": round(1e3 * quantile_from_snapshot(lat, 0.5), 1)
+        if lat and lat.get("count") else None,
+        "reply_p99_ms": round(1e3 * quantile_from_snapshot(lat, 0.99), 1)
+        if lat and lat.get("count") else None,
+        "replies_per_s": round(counts["ok"] / wall, 1) if wall else None,
+        "wall_s": round(wall, 2),
+        "echo": {"n_chains": n_chains, "block_s": block_s,
+                 "window_ms": cfg.window_s * 1e3,
+                 "max_batch": cfg.max_batch, "scan_unroll": unroll},
+    }
+    try:
+        from tmhpvsim_tpu.obs.report import RunReport
+
+        rep = RunReport("bench.serve", config=sim)
+        rep.attach_metrics(reg)
+        rep.headline = {"replies_per_s": doc["replies_per_s"],
+                        "coalescing": doc["coalescing"]}
+        doc["run_report"] = rep.doc()
+    except Exception as e:  # the load numbers must survive a report bug
+        print(f"# run_report build failed (bench.serve): {e}",
+              file=sys.stderr)
+    _persist_partial({"phase": "serve", **doc})
+    print(json.dumps(doc), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config",
@@ -1515,6 +1626,14 @@ def main() -> None:
                          "variant (compile-variance probe)")
     ap.add_argument("--one-variant", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--serve", type=int, metavar="N", default=None,
+                    help="scenario-serving load generator: N concurrent "
+                         "clients against one warm in-process server "
+                         "(serve/); reports coalescing ratio, reply "
+                         "latency quantiles and the v6 'serving' report "
+                         "section")
+    ap.add_argument("--serve-requests", type=int, metavar="R", default=8,
+                    help="requests per client in --serve mode (default 8)")
     ap.add_argument("--telemetry", choices=["off", "light", "full"],
                     default="off",
                     help="in-graph telemetry level for every config this "
@@ -1554,6 +1673,8 @@ def main() -> None:
         repro(args.repro)
     elif args.one_variant:
         one_variant()
+    elif args.serve is not None:
+        serve_bench(args.serve, args.serve_requests)
     else:
         headline()
 

@@ -142,6 +142,11 @@ run_json benchmarks/BENCH_config4.json config4   --config 4
 run_json benchmarks/BENCH_config2.json config2   --config 2
 run_json benchmarks/BENCH_config3a.json config3a --config 3a
 run_json benchmarks/BENCH_config5.json config5   --config 5
+# scenario-serving load point (serve/): coalescing ratio + reply-latency
+# quantiles for 8 concurrent clients against one warm in-process server;
+# the doc's run_report carries the v6 'serving' section serve_report.py
+# validates below
+run_json benchmarks/SERVE_r05b.json    serve     --serve 8 --serve-requests 8
 echo "--- scaling start $(date -u +%FT%TZ)" >> "$LOG"
 if python bench.py --scaling > benchmarks/SCALING.json.tmp 2>> "$LOG"; then
   mv benchmarks/SCALING.json.tmp benchmarks/SCALING.json
@@ -194,6 +199,16 @@ for bench_doc in benchmarks/BENCH_*.json benchmarks/SWEEP_*.jsonl; do
   echo "--- fleet_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
   python tools/fleet_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- fleet_report: MALFORMED FLEET SECTION $bench_doc rc=$?" >> "$LOG"
+done
+# scenario-serving sanity (non-fatal), same contract as fleet_report:
+# any doc carrying a RunReport 'serving' section must carry a
+# WELL-FORMED one (obs/report.serving_section shape — counters,
+# occupancy consistency, latency-quantile ordering)
+for bench_doc in benchmarks/SERVE_*.json benchmarks/BENCH_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- serve_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/serve_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- serve_report: MALFORMED SERVING SECTION $bench_doc rc=$?" >> "$LOG"
 done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

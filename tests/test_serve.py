@@ -1,0 +1,709 @@
+"""Scenario-serving runtime (tmhpvsim_tpu/serve/): schema validation,
+micro-batch coalescing, request/reply correlation over all three
+transports, batch-of-N vs batch-of-1 bit identity, the e2e acceptance
+run (concurrent clients coalesce into fewer dispatches than requests,
+every reply bit-identical to a fresh batch-of-1 answer), warm restart
+with zero fresh compiles, the schema-v6 ``serving`` report section, and
+tools/serve_report.py.
+
+Shapes are tiny (4 chains, 2 blocks of 60 s) with ``scan_unroll=1``:
+the scenario jit's compile time scales with unroll x the vmapped fold
+body, and these tests exercise serving mechanics, not throughput.
+"""
+
+import asyncio
+import contextlib
+import dataclasses
+import datetime as dt
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine import Simulation, compilecache
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.obs.report import (
+    REPORT_SCHEMA_VERSION,
+    RunReport,
+    serving_section,
+    validate_report,
+)
+from tmhpvsim_tpu.runtime import broker as broker_mod
+from tmhpvsim_tpu.runtime.broker import make_transport
+from tmhpvsim_tpu.runtime.tcpbroker import TcpFanoutBroker, _Subscriber
+from tmhpvsim_tpu.serve import schema
+from tmhpvsim_tpu.serve.batcher import OCCUPANCY_BUCKETS, MicroBatcher
+from tmhpvsim_tpu.serve.schema import Request, RequestError, Scenario
+from tmhpvsim_tpu.serve.server import (
+    ScenarioClient,
+    ScenarioEngine,
+    ScenarioServer,
+    ServeConfig,
+    default_buckets,
+)
+
+# reuse test_amqp's fake aio_pika (registers the fixture here too)
+from test_amqp import fake_aio_pika  # noqa: F401
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SERVE_REPORT = REPO / "tools" / "serve_report.py"
+BENCH_TREND = REPO / "tools" / "bench_trend.py"
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def scfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=120,
+        n_chains=4,
+        seed=7,
+        block_s=60,
+        dtype="float32",
+        output="reduce",
+        block_impl="scan",
+        scan_unroll=1,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def req(rid, scenario, mode="reduce"):
+    return Request(id=rid, reply_to="r", mode=mode, scenario=scenario)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """One warm engine for every direct-dispatch test in the module
+    (each bucket shape compiles once, on first use)."""
+    with use_registry(MetricsRegistry()):
+        return ScenarioEngine(scfg(), (1, 4, 8))
+
+
+# ---------------------------------------------------------------------------
+# schema: strict request validation
+# ---------------------------------------------------------------------------
+
+
+class TestSchema:
+    def test_defaults_are_neutral(self):
+        s = schema.parse_scenario(None, max_horizon_s=120)
+        assert s == Scenario(demand_scale=1.0, demand_shift_w=0.0,
+                             dc_capacity_scale=1.0, weather_bias=1.0,
+                             curtail_w=None, horizon_s=120)
+
+    def test_knob_bounds_enforced(self):
+        for doc in ({"demand_scale": 99.0}, {"demand_scale": -0.1},
+                    {"weather_bias": 0.1}, {"weather_bias": 5.0},
+                    {"dc_capacity_scale": 8.5},
+                    {"demand_shift_w": 1e9}, {"curtail_w": -1.0}):
+            with pytest.raises(RequestError) as ei:
+                schema.parse_scenario(doc, max_horizon_s=120)
+            assert ei.value.code == "invalid"
+
+    def test_type_strictness(self):
+        # bool is not a number, NaN is not finite, strings are not knobs
+        for doc in ({"demand_scale": True}, {"demand_scale": float("nan")},
+                    {"demand_scale": "1.0"}, {"horizon_s": 60.0},
+                    {"horizon_s": True}, "not-an-object", 7):
+            with pytest.raises(RequestError) as ei:
+                schema.parse_scenario(doc, max_horizon_s=120)
+            assert ei.value.code == "invalid"
+
+    def test_unknown_knob_rejected(self):
+        with pytest.raises(RequestError, match="unknown knob"):
+            schema.parse_scenario({"volcano": 2.0}, max_horizon_s=120)
+
+    def test_horizon_range(self):
+        assert schema.parse_scenario({"horizon_s": 1},
+                                     max_horizon_s=120).horizon_s == 1
+        for h in (0, -5, 121):
+            with pytest.raises(RequestError):
+                schema.parse_scenario({"horizon_s": h}, max_horizon_s=120)
+
+    def test_parse_request_rejects_malformed(self):
+        ok = schema.request_meta("a", "reply.x", "fleet",
+                                 {"horizon_s": 60})
+        r = schema.parse_request(ok, max_horizon_s=120)
+        assert (r.id, r.mode, r.scenario.horizon_s) == ("a", "fleet", 60)
+        bad = [
+            {**ok, "id": ""}, {**ok, "id": "x" * 65}, {**ok, "id": 7},
+            {**ok, "reply_to": ""}, {**ok, "mode": "bogus"},
+            {**ok, "surprise": 1},
+        ]
+        for meta in bad:
+            with pytest.raises(RequestError) as ei:
+                schema.parse_request(meta, max_horizon_s=120)
+            assert ei.value.code == "invalid"
+
+    def test_pick_bucket_smallest_fit(self):
+        assert schema.pick_bucket(1, (1, 4, 8)) == 1
+        assert schema.pick_bucket(3, (1, 4, 8)) == 4
+        assert schema.pick_bucket(8, (1, 4, 8)) == 8
+        with pytest.raises(ValueError):
+            schema.pick_bucket(9, (1, 4, 8))
+
+    def test_encode_batch_pads_neutral(self):
+        s = Scenario(demand_scale=2.0, dc_capacity_scale=0.5,
+                     curtail_w=1e3, horizon_s=60)
+        enc = schema.encode_batch([s], 4, np.float32)
+        assert enc["demand_scale"].shape == (4,)
+        assert enc["demand_scale"].dtype == np.float32
+        assert enc["pv_scale"][0] == np.float32(0.5)
+        assert enc["curtail_w"][0] == np.float32(1e3)
+        # padding rows: neutral knobs, horizon 0 (folds nothing),
+        # curtail at the dtype's no-cap sentinel
+        no_cap = np.float32(np.finfo(np.float32).max)
+        assert list(enc["horizon_s"]) == [60, 0, 0, 0]
+        assert all(enc["demand_scale"][1:] == np.float32(1.0))
+        assert all(enc["curtail_w"][1:] == no_cap)
+        with pytest.raises(ValueError):
+            schema.encode_batch([s, s], 1, np.float32)
+
+    def test_default_buckets_and_serve_config(self):
+        assert default_buckets(16) == (1, 2, 4, 8, 16)
+        assert default_buckets(6) == (1, 2, 4, 6)
+        assert ServeConfig(sim=scfg(),
+                           batch_sizes=(8, 1, 8)).buckets() == (1, 8)
+        with pytest.raises(ValueError):
+            ServeConfig(sim=scfg(), batch_sizes=(0, 2)).buckets()
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher (stub dispatch: no device work)
+# ---------------------------------------------------------------------------
+
+
+class TestMicroBatcher:
+    def test_coalesces_and_demuxes(self):
+        async def main():
+            reg = MetricsRegistry()
+            calls = []
+
+            def dispatch(reqs):
+                calls.append(len(reqs))
+                time.sleep(0.005)
+                return [f"r:{r}" for r in reqs]
+
+            b = MicroBatcher(dispatch, window_s=0.05, max_batch=8,
+                             registry=reg)
+            b.start()
+            futs = [b.submit(f"q{i}") for i in range(5)]
+            out = await asyncio.gather(*futs)
+            assert [r for r, _ in out] == [f"r:q{i}" for i in range(5)]
+            infos = [i for _, i in out]
+            assert {i["batch"] for i in infos} == {5}
+            assert all(i["queue_s"] >= 0.0 and i["dispatch_s"] > 0.0
+                       for i in infos)
+            assert calls == [5]
+            await b.stop(drain=True)
+            snap = reg.snapshot()
+            assert snap["counters"]["serve.batches_total"] == 1.0
+            assert snap["histograms"]["serve.batch_occupancy"]["max"] == 5.0
+        _run(main())
+
+    def test_max_batch_splits(self):
+        async def main():
+            b = MicroBatcher(lambda rs: list(rs), window_s=0.02,
+                             max_batch=2, registry=MetricsRegistry())
+            b.start()
+            out = await asyncio.gather(*[b.submit(i) for i in range(5)])
+            assert [r for r, _ in out] == list(range(5))
+            assert all(i["batch"] <= 2 for _, i in out)
+            await b.stop(drain=True)
+        _run(main())
+
+    def test_queue_limit_and_drain_rejections(self):
+        async def main():
+            b = MicroBatcher(lambda rs: list(rs), window_s=0.01,
+                             max_batch=2, queue_limit=2,
+                             registry=MetricsRegistry())
+            # worker not started: the queue fills
+            f1, f2 = b.submit("a"), b.submit("b")
+            with pytest.raises(RequestError) as ei:
+                b.submit("c")
+            assert ei.value.code == "busy"
+            await b.stop(drain=False)
+            for f in (f1, f2):
+                with pytest.raises(RequestError) as e2:
+                    await f
+                assert e2.value.code == "draining"
+            with pytest.raises(RequestError) as e3:
+                b.submit("d")
+            assert e3.value.code == "draining"
+        _run(main())
+
+    def test_dispatch_error_is_typed_internal(self):
+        async def main():
+            def boom(reqs):
+                raise RuntimeError("no device")
+
+            b = MicroBatcher(boom, window_s=0.01, max_batch=2,
+                             registry=MetricsRegistry())
+            b.start()
+            with pytest.raises(RequestError) as ei:
+                await b.submit("x")
+            assert ei.value.code == "internal"
+            await b.stop(drain=True)
+        _run(main())
+
+
+# ---------------------------------------------------------------------------
+# request/reply correlation over all three transports
+# ---------------------------------------------------------------------------
+
+
+async def _reverse_responder(url, exchange, expect):
+    """Echo server that collects ``expect`` requests, then replies in
+    REVERSE arrival order — correlation must come from ids, never from
+    delivery order."""
+    tx = make_transport(url, exchange)
+    reply_txs = {}
+    async with tx:
+        try:
+            got = []
+            async for _t, _v, meta in tx.subscribe(with_meta=True):
+                if not isinstance(meta, dict) or \
+                        meta.get("op") != schema.OP_REQUEST:
+                    continue
+                got.append(meta)
+                if len(got) < expect:
+                    continue
+                for m in reversed(got):
+                    rt = m["reply_to"]
+                    if rt not in reply_txs:
+                        reply_txs[rt] = make_transport(url, rt)
+                        await reply_txs[rt].__aenter__()
+                    await reply_txs[rt].publish(
+                        0.0, dt.datetime(2019, 1, 1),
+                        meta=schema.ok_meta(m["id"],
+                                            m.get("mode", "reduce"),
+                                            {"echo": m["id"]}))
+                got.clear()
+        finally:
+            for rtx in reply_txs.values():
+                with contextlib.suppress(Exception):
+                    await rtx.__aexit__(None, None, None)
+
+
+async def _correlate(url, n=3):
+    task = asyncio.create_task(_reverse_responder(url, "scenario", n))
+    try:
+        async with ScenarioClient(url) as c:
+            await asyncio.sleep(0.1)  # responder subscription settles
+            replies = await asyncio.gather(*[
+                c.request(None, rid=f"q{i}", timeout=10)
+                for i in range(n)])
+        assert [r["result"]["echo"] for r in replies] \
+            == [f"q{i}" for i in range(n)]
+        assert all(r["ok"] for r in replies)
+    finally:
+        task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await task
+
+
+class TestCorrelation:
+    def test_out_of_order_replies_local(self):
+        _run(_correlate("local://corr-local"))
+
+    def test_shared_reply_exchange_local(self):
+        """Two clients deliberately sharing one reply exchange: each
+        sees the other's replies and must route by id only."""
+        url = "local://corr-shared"
+
+        async def main():
+            task = asyncio.create_task(
+                _reverse_responder(url, "scenario", 2))
+            try:
+                async with ScenarioClient(url) as c1:
+                    async with ScenarioClient(
+                            url, reply_to=c1.reply_to) as c2:
+                        await asyncio.sleep(0.1)
+                        r1, r2 = await asyncio.gather(
+                            c1.request(None, rid="one", timeout=10),
+                            c2.request(None, rid="two", timeout=10))
+                assert r1["result"]["echo"] == "one"
+                assert r2["result"]["echo"] == "two"
+            finally:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+        _run(main())
+
+    def test_out_of_order_replies_tcp(self):
+        async def main():
+            async with TcpFanoutBroker(port=0) as broker:
+                await _correlate(f"tcp://127.0.0.1:{broker.port}")
+        _run(main())
+
+    def test_out_of_order_replies_amqp(self, fake_aio_pika):
+        _run(_correlate("amqp://fake-host:5672/"))
+
+
+# ---------------------------------------------------------------------------
+# engine: batch-of-N answers are bit-identical to batch-of-1
+# ---------------------------------------------------------------------------
+
+
+class TestEngineBitIdentity:
+    def test_batch_rows_match_singleton_runs(self, engine):
+        reqs = [
+            req("a", Scenario(horizon_s=120)),
+            req("b", Scenario(demand_scale=1.5, demand_shift_w=250.0,
+                              horizon_s=120), mode="fleet"),
+            req("c", Scenario(weather_bias=0.5, dc_capacity_scale=2.0,
+                              curtail_w=4000.0, horizon_s=60),
+                mode="quantiles"),
+        ]
+        batch = engine.run(reqs)          # padded to bucket 4
+        singles = [engine.run([r])[0] for r in reqs]  # bucket 1 each
+        assert batch == singles
+        assert batch[0]["stats"]["n_seconds"] == 120 * 4
+        assert batch[1]["fleet"]["count"] == 120 * 4
+        assert batch[2]["count"] == 60 * 4  # short horizon folds less
+
+    def test_company_does_not_change_answers(self, engine):
+        """The same scenario answered alone and next to very different
+        company: identical bits (the vmapped fold is elementwise per
+        row; padding rows fold nothing)."""
+        probe = req("p", Scenario(demand_scale=2.0, horizon_s=120))
+        alone = engine.run([probe])[0]
+        noisy = engine.run([
+            req("n1", Scenario(weather_bias=4.0, horizon_s=60)),
+            probe,
+            req("n2", Scenario(demand_shift_w=-5e4, horizon_s=120)),
+        ])[1]
+        assert alone == noisy
+
+    def test_neutral_scenario_matches_plain_reduce_run(self, engine):
+        """A neutral-knob scenario over the full horizon is THE batch
+        run: its stats must equal output='reduce' run_reduced bitwise."""
+        stats = engine.run(
+            [req("n", Scenario(horizon_s=120))])[0]["stats"]
+        with use_registry(MetricsRegistry()):
+            red = Simulation(scfg()).run_reduced()
+        assert stats["n_seconds"] == int(red["n_seconds"].sum())
+        for name, key in (("pv_sum", "pv_sum_w"),
+                          ("meter_sum", "meter_sum_w"),
+                          ("residual_sum", "residual_sum_w")):
+            assert stats[key] == float(
+                red[name].astype(np.float64).sum())
+        assert stats["pv_max_w"] == float(red["pv_max"].max())
+        assert stats["residual_min_w"] == float(red["residual_min"].min())
+        assert stats["residual_max_w"] == float(red["residual_max"].max())
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance: concurrent clients coalesce; replies == batch-of-1
+# ---------------------------------------------------------------------------
+
+
+class TestEndToEnd:
+    def test_concurrent_clients_coalesce_and_match(self):
+        url = "local://e2e-serve"
+        cfg = ServeConfig(sim=scfg(), url=url, window_s=0.25,
+                          batch_sizes=(1, 4, 8), timeout_s=300.0)
+        reg = MetricsRegistry()
+        scens = [{"demand_scale": 1.0 + 0.1 * i, "horizon_s": 120}
+                 for i in range(8)]
+
+        async def main():
+            server = ScenarioServer(cfg, registry=reg)
+            await server.start()
+            clients = [ScenarioClient(url) for _ in range(8)]
+            try:
+                for c in clients:
+                    await c.__aenter__()
+                replies = await asyncio.gather(*[
+                    clients[i].request(scens[i], rid=f"c{i}", timeout=300)
+                    for i in range(8)])
+                assert all(r["ok"] for r in replies), replies
+                snap1 = reg.snapshot()["counters"]
+                # the acceptance inequality: fewer dispatches than
+                # requests, occupancy above 1
+                assert snap1["serve.batches_total"] < 8
+                occ = reg.snapshot()["histograms"]["serve.batch_occupancy"]
+                assert occ["max"] > 1.0
+                assert max(r["t"]["batch"] for r in replies) > 1
+
+                # fresh batch-of-1 runs on the same warm server:
+                # sequential requests, one per window
+                singles = []
+                for i in range(8):
+                    s = await clients[0].request(scens[i], timeout=300)
+                    assert s["ok"]
+                    singles.append(s)
+                assert [r["result"] for r in replies] \
+                    == [s["result"] for s in singles]
+
+                # duplicate ids: first accepted, replay rejected typed
+                first = await clients[0].request(scens[0], rid="dup-1",
+                                                 timeout=300)
+                assert first["ok"]
+                replay = await clients[0].request(scens[0], rid="dup-1",
+                                                  timeout=30)
+                assert not replay["ok"]
+                assert replay["error"]["code"] == "duplicate"
+
+                # malformed payloads: typed invalid, server stays up
+                for bad_scen, bad_mode in (
+                        ({"volcano": 1.0}, "reduce"),
+                        ({"demand_scale": 99.0}, "reduce"),
+                        ({"horizon_s": 10**7}, "reduce"),
+                        (None, "bogus")):
+                    r = await clients[0].request(bad_scen, mode=bad_mode,
+                                                 timeout=30)
+                    assert not r["ok"]
+                    assert r["error"]["code"] == "invalid"
+
+                # graceful drain: new work typed-rejected, then stop
+                server.begin_drain()
+                r = await clients[0].request(scens[0], timeout=30)
+                assert not r["ok"]
+                assert r["error"]["code"] == "draining"
+            finally:
+                for c in clients:
+                    await c.__aexit__(None, None, None)
+                await server.stop()
+
+            snap = reg.snapshot()
+            sec = serving_section(snap)
+            assert sec is not None
+            assert sec["replies"] == 17       # 8 + 8 + dup-1's first
+            assert sec["rejected"] == 6       # dup + 4 invalid + drain
+            assert sec["in_flight"] == 0
+            assert sec["occupancy"]["max"] > 1.0
+        _run(main())
+
+
+# ---------------------------------------------------------------------------
+# warm restart: zero fresh compiles against a populated cache
+# ---------------------------------------------------------------------------
+
+
+class TestWarmRestart:
+    def test_restart_compiles_zero_times(self, tmp_path):
+        """The serving acceptance criterion: a server built against the
+        compile cache its first start populated deserialises every
+        executable — scenario buckets included — with zero cold
+        compiles (conftest's autouse fixture restores the suite cache
+        afterwards)."""
+        d = compilecache.configure(str(tmp_path))
+        assert d is not None
+        c = scfg(duration_s=60, n_chains=2,
+                 serve_batch_sizes=(1, 2))
+        reg1 = MetricsRegistry()
+        with use_registry(reg1):
+            sim = Simulation(c)
+        names = [t[0] for t in sim.aot_targets()]
+        assert "scenario_acc[1]" in names and "scenario_acc[2]" in names
+        n_targets = len(names)
+        s1 = reg1.snapshot()["counters"]
+        assert s1.get("executor.aot_warmup_total", 0) == n_targets
+        assert s1.get("executor.aot_warmup_errors_total", 0) == 0
+
+        reg2 = MetricsRegistry()
+        with use_registry(reg2):
+            Simulation(c)
+        s2 = reg2.snapshot()["counters"]
+        assert s2.get("executor.compile_warm_total", 0) == n_targets
+        assert s2.get("executor.compile_cold_total", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# report schema v6: the serving section
+# ---------------------------------------------------------------------------
+
+
+def _serving_registry():
+    reg = MetricsRegistry()
+    reg.counter("serve.requests_total").inc(9)
+    reg.counter("serve.replies_total").inc(8)
+    reg.counter("serve.rejected_total").inc(1)
+    reg.counter("serve.batches_total").inc(3)
+    reg.gauge("serve.in_flight").set(0)
+    occ = reg.histogram("serve.batch_occupancy", buckets=OCCUPANCY_BUCKETS)
+    for v in (1.0, 3.0, 4.0):
+        occ.observe(v)
+    for name in ("serve.queue_wait_s", "serve.dispatch_s",
+                 "serve.reply_latency_s"):
+        h = reg.histogram(name)
+        for x in (0.001, 0.01, 0.05):
+            h.observe(x)
+    return reg
+
+
+class TestServingReport:
+    def test_v6_round_trip(self):
+        rep = RunReport("pvsim.serve")
+        rep.attach_metrics(_serving_registry())
+        doc = rep.doc()
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 6
+        validate_report(doc)
+        doc2 = json.loads(json.dumps(doc))
+        validate_report(doc2)
+        sec = doc2["serving"]
+        assert (sec["requests"], sec["replies"], sec["rejected"],
+                sec["timeouts"], sec["batches"]) == (9, 8, 1, 0, 3)
+        assert sec["occupancy"]["batches"] == 3
+        assert sec["occupancy"]["max"] == 4.0
+        assert sec["reply_latency"]["count"] == 3
+
+    def test_no_serve_metrics_no_section(self):
+        reg = MetricsRegistry()
+        reg.counter("broker.published_total").inc()
+        rep = RunReport("pvsim")
+        rep.attach_metrics(reg)
+        assert rep.doc()["serving"] is None
+        validate_report(rep.doc())
+
+
+# ---------------------------------------------------------------------------
+# tools/serve_report.py + the bench_trend serve column
+# ---------------------------------------------------------------------------
+
+
+def _run_tool(script, *argv):
+    return subprocess.run(
+        [sys.executable, str(script), *map(str, argv)],
+        capture_output=True, text=True)
+
+
+def _serving_doc():
+    rep = RunReport("pvsim.serve")
+    rep.attach_metrics(_serving_registry())
+    return rep.doc()
+
+
+class TestServeReportTool:
+    def test_valid_report_prints_table(self, tmp_path):
+        path = tmp_path / "report.json"
+        path.write_text(json.dumps(_serving_doc()))
+        r = _run_tool(SERVE_REPORT, path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "scenario serving" in r.stdout
+        assert "coalescing 3.00x" in r.stdout
+
+    def test_malformed_serving_section_fails(self, tmp_path):
+        doc = _serving_doc()
+        doc["serving"]["replies"] = 99      # exceeds requests
+        doc["serving"]["occupancy"]["batches"] = 7   # != counter
+        path = tmp_path / "bad.json"
+        path.write_text(json.dumps(doc))
+        r = _run_tool(SERVE_REPORT, path)
+        assert r.returncode == 1
+        assert "INVALID serving section" in r.stderr
+
+    def test_report_without_serving_section_passes(self, tmp_path):
+        doc = _serving_doc()
+        doc["serving"] = None
+        path = tmp_path / "off.json"
+        path.write_text(json.dumps(doc))
+        r = _run_tool(SERVE_REPORT, path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "no serving section" in r.stdout
+
+    def test_bench_doc_and_jsonl_shapes(self, tmp_path):
+        bench = {"phase": "serve", "coalescing": 3.0,
+                 "run_report": _serving_doc()}
+        path = tmp_path / "serve.jsonl"
+        path.write_text(json.dumps(bench) + "\n" + json.dumps(bench) + "\n")
+        r = _run_tool(SERVE_REPORT, path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("[serve]") == 2
+
+    def test_bench_trend_serve_column(self, tmp_path):
+        a = tmp_path / "a.json"
+        a.write_text(json.dumps({
+            "value": 1e6, "platform": "cpu",
+            "run_report": {"timing": {"steady_block_s": 0.1},
+                           "config": {}},
+        }))
+        b = tmp_path / "b.json"
+        b.write_text(json.dumps({
+            "artifact": "scenario-serve load", "platform": "cpu",
+            "coalescing": 2.5, "run_report": _serving_doc(),
+        }))
+        r = _run_tool(BENCH_TREND, "--json", a, b)
+        assert r.returncode == 0, r.stdout + r.stderr
+        rows = {row["name"]: row
+                for row in json.loads(r.stdout)["rows"]}
+        assert rows["a.json"]["serve"] is None
+        assert rows["b.json"]["serve"] == 2.5
+        assert not rows["b.json"]["failed"]
+
+
+# ---------------------------------------------------------------------------
+# broker backlog bounding (the satellite fix)
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerBacklog:
+    def test_local_broker_drops_oldest_past_cap(self, monkeypatch):
+        monkeypatch.setattr(broker_mod, "MAX_CONSUMER_BACKLOG", 16)
+        reg = MetricsRegistry()
+
+        async def main():
+            with use_registry(reg):
+                b = broker_mod._LocalBroker()
+                q = b.bind("x")
+                for i in range(20):
+                    b.publish("x", broker_mod.encode(
+                        float(i), dt.datetime(2019, 1, 1)))
+                assert q.qsize() == 16
+                # oldest-first: messages 0..3 were dropped
+                _t, v = broker_mod.decode(q.get_nowait())
+                assert v == 4.0
+        _run(main())
+        assert reg.snapshot()["counters"]["broker.dropped_total"] == 4.0
+
+    def test_tcp_subscriber_queue_bounded(self):
+        reg = MetricsRegistry()
+
+        async def main():
+            with use_registry(reg):
+                sub = _Subscriber(writer=None, max_backlog=5)
+                for i in range(8):
+                    sub.offer(b"%d\n" % i)
+                assert sub.queue.qsize() == 5
+                assert sub.n_dropped == 3
+                # oldest-first: line 3 survives as the head (peek: only
+                # drain() pops in production, decrementing the gauge)
+                assert list(sub.queue._queue)[0] == b"3\n"
+                snap = reg.snapshot()
+                assert snap["counters"]["tcpbroker.dropped_total"] == 3.0
+                assert snap["gauges"]["tcpbroker.backlog_depth"] == 5.0
+                sub.unregistered()
+                snap = reg.snapshot()
+                assert snap["gauges"]["tcpbroker.backlog_depth"] == 0.0
+                assert sub.queue.empty()
+        _run(main())
+
+    def test_tcp_aggregate_gauge_across_subscribers(self):
+        reg = MetricsRegistry()
+
+        async def main():
+            with use_registry(reg):
+                a = _Subscriber(writer=None, max_backlog=10)
+                b = _Subscriber(writer=None, max_backlog=10)
+                for i in range(3):
+                    a.offer(b"x\n")
+                for i in range(2):
+                    b.offer(b"y\n")
+                assert reg.snapshot()["gauges"][
+                    "tcpbroker.backlog_depth"] == 5.0
+                a.unregistered()
+                assert reg.snapshot()["gauges"][
+                    "tcpbroker.backlog_depth"] == 2.0
+                b.unregistered()
+                assert reg.snapshot()["gauges"][
+                    "tcpbroker.backlog_depth"] == 0.0
+        _run(main())

@@ -590,11 +590,12 @@ def test_report_schema_v1_v2_still_validate():
     schemas keep validating against the current validator."""
     from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, RunReport
 
-    assert REPORT_SCHEMA_VERSION == 5
+    assert REPORT_SCHEMA_VERSION == 6
     doc = RunReport("test").doc()
     for old in (1, 2):
         legacy = {k: v for k, v in doc.items()
-                  if not (k == "fleet" and old < 5)
+                  if not (k == "serving" and old < 6)
+                  and not (k == "fleet" and old < 5)
                   and not (k == "executor" and old < 4)
                   and not (k == "streaming" and old < 3)
                   and not (k == "telemetry" and old < 2)}

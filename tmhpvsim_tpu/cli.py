@@ -95,19 +95,27 @@ def _parse_site_grid(spec):
               help="interface to listen on")
 @click.option("--port", type=int, default=5673, show_default=True,
               help="TCP port (0 picks a free one)")
+@click.option("--max-backlog", type=int, default=None,
+              help="per-subscriber buffered messages before oldest-first "
+                   "drop (default 10000; tcpbroker.dropped_total counts "
+                   "the drops)")
 @click.option("-v", "--verbose", count=True)
-def fanoutbroker(host, port, verbose):
+def fanoutbroker(host, port, max_backlog, verbose):
     """Standalone fanout broker for tcp:// transports — the in-tree
     replacement for the external RabbitMQ server the reference's
     deployment needs (runtime/tcpbroker.py): run this in one shell, then
     ``metersim --amqp-url tcp://HOST:PORT`` and ``pvsim out.csv
     --amqp-url tcp://HOST:PORT`` in two others."""
-    from tmhpvsim_tpu.runtime.tcpbroker import TcpFanoutBroker
+    from tmhpvsim_tpu.runtime.tcpbroker import (MAX_SUBSCRIBER_BACKLOG,
+                                                TcpFanoutBroker)
 
     _setup_logging(verbose)
 
     async def run():
-        broker = TcpFanoutBroker(host, port)
+        broker = TcpFanoutBroker(
+            host, port,
+            max_backlog=(MAX_SUBSCRIBER_BACKLOG if max_backlog is None
+                         else max_backlog))
         await broker.start()
         click.echo(f"fanout broker listening on {broker.host}:{broker.port}",
                    err=True)
@@ -331,6 +339,111 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                         run_report_path=run_report_path))
 
 
+@click.command()
+@click.option(
+    "--amqp-url", default=lambda: os.environ.get("AMQP_URL"),
+    help="broker URL the server listens on: amqp://... (RabbitMQ), "
+         "tcp://HOST:PORT (the in-tree fanoutbroker command), or "
+         "local://NAME (in-process; the default, 'local://default')")
+@click.option("--exchange",
+              default=lambda: os.environ.get("TMHPVSIM_SCENARIO_EXCHANGE",
+                                             "scenario"),
+              show_default="scenario",
+              help="request exchange; replies go to each request's own "
+                   "reply_to exchange")
+@click.option("-v", "--verbose", count=True,
+              help="Increase logging level from default WARN")
+@click.option("--seed", type=int, default=0, show_default=True,
+              help="PRNG seed of the served simulation")
+@click.option("--duration", "duration_s", type=int, default=86_400,
+              show_default=True,
+              help="maximum scenario horizon in simulated seconds (the "
+                   "base simulation the server answers from)")
+@click.option("--start", default=None,
+              help="Simulation start time 'YYYY-MM-DD HH:MM:SS'")
+@click.option("--chains", "n_chains", type=int, default=1024,
+              show_default=True,
+              help="stochastic chains per scenario evaluation")
+@click.option("--block-s", type=int, default=None,
+              help="Seconds per device block, multiple of 60 "
+                   "(default: min(8640, duration))")
+@click.option("--block-impl",
+              type=click.Choice(["auto", "wide", "scan", "scan2"]),
+              default="auto",
+              help="block formulation (config.SimConfig.block_impl)")
+@click.option("--tune", type=click.Choice(["off", "auto", "force"]),
+              default="off",
+              help="runtime autotuner for the served plan "
+                   "(config.SimConfig.tune)")
+@click.option("--window-ms", type=float, default=10.0, show_default=True,
+              help="micro-batch coalescing window: the first pending "
+                   "request waits at most this long for company before "
+                   "the fused dispatch")
+@click.option("--max-batch", type=int, default=16, show_default=True,
+              help="most requests per fused dispatch")
+@click.option("--batch-sizes", default=None, metavar="B1,B2,...",
+              help="explicit batch buckets (each is one compiled dispatch "
+                   "shape, AOT-warmed at startup); default: powers of two "
+                   "up to --max-batch")
+@click.option("--queue-limit", type=int, default=1024, show_default=True,
+              help="pending requests beyond this are rejected with a "
+                   "typed 'busy' reply")
+@click.option("--timeout-s", type=float, default=60.0, show_default=True,
+              help="per-request wall clock before a typed 'timeout' reply")
+@click.option("--trace", "trace", default=None,
+              help="Record the serving event timeline and export "
+                   "Chrome-trace JSON here on exit; crashes dump the "
+                   "last 30 s to PATH.crash.json (obs/trace.py)")
+@click.option("--metrics", "metrics_path", default=None,
+              help="Stream metric snapshots to this file (.prom = "
+                   "Prometheus text exposition, else JSONL append)")
+@click.option("--run-report", "run_report_path", default=None,
+              help="Write the RunReport JSON (with the 'serving' SLO "
+                   "section) here on shutdown")
+@click.option("--compile-cache", "compile_cache", default=None,
+              metavar="DIR",
+              help="Persistent XLA compilation-cache base directory; the "
+                   "scenario dispatch for every batch bucket is AOT-warmed "
+                   "into it at startup, so a warm restart compiles "
+                   "nothing fresh.  Unset: $TMHPVSIM_COMPILE_CACHE, else "
+                   "~/.cache/tmhpvsim_tpu/xla; 'off' disables "
+                   "(engine/compilecache.py)")
+def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
+          block_s, block_impl, tune, window_ms, max_batch, batch_sizes,
+          queue_limit, timeout_s, trace, metrics_path, run_report_path,
+          compile_cache):
+    """Long-lived scenario server: a warm simulation answering "what-if"
+    queries over the broker (serve/).  Each request perturbs bounded
+    scenario knobs (demand scale/shift, DC-capacity scale, weather
+    bias, curtailment cap, horizon); concurrent requests within the
+    window coalesce into ONE fused device dispatch.  SIGINT/SIGTERM
+    drain in-flight requests and reject new ones with a typed error."""
+    from tmhpvsim_tpu.config import SimConfig
+    from tmhpvsim_tpu.serve.server import ServeConfig, serve_main
+
+    _setup_logging(verbose)
+    sim_kw = dict(duration_s=duration_s, n_chains=n_chains, seed=seed,
+                  output="reduce", block_impl=block_impl, tune=tune)
+    if start:
+        sim_kw["start"] = start
+    sim_kw["block_s"] = block_s if block_s else min(8640, duration_s)
+    try:
+        buckets = tuple(int(b) for b in batch_sizes.split(",")) \
+            if batch_sizes else ()
+    except ValueError as e:
+        raise click.UsageError(
+            f"bad --batch-sizes {batch_sizes!r} (want B1,B2,...)") from e
+    cfg = ServeConfig(
+        sim=SimConfig(**sim_kw),
+        url=amqp_url or "local://default", exchange=exchange,
+        window_s=window_ms / 1e3, max_batch=max_batch,
+        batch_sizes=buckets, queue_limit=queue_limit,
+        timeout_s=timeout_s)
+    asyncrun(serve_main(cfg, compile_cache=compile_cache, trace=trace,
+                        metrics_path=metrics_path,
+                        run_report_path=run_report_path))
+
+
 @click.group()
 def main():
     """tmhpvsim-tpu: TPU-native PV simulation & streaming."""
@@ -339,6 +452,7 @@ def main():
 main.add_command(metersim)
 main.add_command(pvsim)
 main.add_command(fanoutbroker)
+main.add_command(serve)
 
 
 if __name__ == "__main__":

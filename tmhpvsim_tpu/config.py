@@ -440,3 +440,16 @@ class SimConfig:
     #: (engine/checkpoint.py uses an explicit key list), so toggling it
     #: across a resume is safe.
     trace: Optional[str] = None
+
+    #: scenario-serving batch buckets (serve/, engine/simulation.py):
+    #: each entry B adds a scenario-batched reduce dispatch — the block
+    #: scan with a leading (B,) vmap axis of per-request scenario knobs
+    #: over the chain axis — to ``Simulation.aot_targets()``, so a
+    #: server started under the persistent compile cache pre-compiles
+    #: every bucket it will ever dispatch (zero fresh compiles on warm
+    #: restart).  Empty (the default) leaves the batch path entirely
+    #: unbuilt: nothing else in the engine reads it.  Ascending, each
+    #: >= 1; the micro-batcher pads a partial batch up to the smallest
+    #: bucket that fits (padding rows carry horizon_s=0 and fold
+    #: nothing).
+    serve_batch_sizes: tuple = ()

@@ -150,6 +150,14 @@ REDUCE_STATS = {
     "n_seconds": ("sum", "i"),
 }
 
+#: float leaves of the scenario knob pytree (serve/: one (batch,) leaf
+#: per knob in the compute dtype, plus an int32 ``horizon_s``).  Applied
+#: per second INSIDE the scenario-batched fold as elementwise transforms
+#: of the shared physics outputs — see ``_block_step_scan_scenario``;
+#: ``serve.schema`` owns the request-side bounds and defaults.
+SCENARIO_FLOAT_KNOBS = ("demand_scale", "demand_shift_w", "pv_scale",
+                        "curtail_w", "weather_bias")
+
 
 class Simulation:
     """Blockwise JAX simulation of ``config.n_chains`` independent sites.
@@ -323,6 +331,10 @@ class Simulation:
         #: group of a run compiles a second (smaller-k) variant, so at
         #: most two compiled shapes exist per kind per run
         self._mega_jits = {}
+        #: scenario-serving dispatch (serve/): the jit and its fleet
+        #: params are built lazily on first use — batch runs pay nothing
+        self._scenario_jit = None
+        self._scn_fleet_params = None
         #: block index B such that ``self.state`` is the state AFTER
         #: block B-1 — i.e. blocks [0, B) are folded into it.  Under
         #: multi-block dispatch the state only advances at megablock
@@ -1371,6 +1383,140 @@ class Simulation:
         return state, acc
 
     # ------------------------------------------------------------------
+    # scenario-batched serving dispatch (serve/: SimConfig.serve_batch_sizes)
+    # ------------------------------------------------------------------
+
+    def scenario_fleet_params(self):
+        """FleetParams of the scenario fold's risk sketch — resolved from
+        the config independently of ``plan.analytics`` (a server always
+        folds the sketch so any request may ask for the fleet result
+        mode, even when the batch run would have analytics off)."""
+        if self._scn_fleet_params is None:
+            self._scn_fleet_params = flt.params_from_config(self.config)
+        return self._scn_fleet_params
+
+    def init_scenario_acc(self, batch: int):
+        """Zero reduce accumulator with a leading scenario axis: one
+        (batch, n_chains) leaf per statistic, same init values as
+        :meth:`init_reduce_acc` so row ``i`` of a batch-of-N run folds
+        exactly what a batch-of-1 run of scenario ``i`` folds."""
+        n = self.config.n_chains
+        dt = self.dtype
+        b = int(batch)
+
+        def build():
+            big = jnp.asarray(jnp.finfo(dt).max, dt)
+            init = {"sum": 0.0, "max": -big, "min": big}
+            return {
+                name: (jnp.zeros((b, n), jnp.int32) if dkind == "i"
+                       else jnp.full((b, n), init[kind], dt))
+                for name, (kind, dkind) in REDUCE_STATS.items()
+            }
+
+        return self._memo_jit(("scenario_acc", b), None, build)()
+
+    def scenario_abstract(self, batch: int):
+        """ShapeDtypeStructs of a (batch,)-leaf scenario knob pytree —
+        the abstract twin of ``serve.schema.encode_batch`` output."""
+        b = int(batch)
+        f = jax.ShapeDtypeStruct((b,), self.dtype)
+        scen = {k: f for k in SCENARIO_FLOAT_KNOBS}
+        scen["horizon_s"] = jax.ShapeDtypeStruct((b,), jnp.int32)
+        return scen
+
+    def _block_step_scan_scenario(self, state, inputs, acc, scen):
+        """Scenario-batched reduce block (serve/): the scan-fused block
+        step with a leading scenario ``vmap`` axis over the chain axis.
+
+        The physics pipeline (``step`` from ``_scan_block_setup``) runs
+        ONCE per second on (n_chains,) vectors — scenario knobs never
+        touch the RNG streams or the model state — and each second's
+        meter/pv outputs are then re-read through every scenario's knob
+        transform (demand scale/shift, DC-capacity x weather-regime
+        scale, curtailment cap) by a vmapped fold: the reduce statistics
+        mirror ``_make_acc_body`` exactly and a per-chain FleetAcc rides
+        alongside so any request can ask for the fleet-risk sketch.  Per
+        scenario validity is ``t < horizon_s`` on top of the duration
+        mask, so padding rows (horizon 0) fold nothing and shorter
+        horizons stop early without a separate shape.  Because every row
+        of the batch applies independent elementwise transforms of the
+        SAME per-second vectors, row ``i`` of a batch-of-N dispatch is
+        bit-identical to a batch-of-1 dispatch of scenario ``i``
+        (asserted by tests/test_serve.py).  Returns
+        ``(state', acc', fleet_delta)`` where ``fleet_delta`` is the
+        block's scalar-form FleetAcc per scenario (zero-initialised
+        inside the jit — a pure per-block delta for the host merge).
+        """
+        cfg = self.config
+        dtype = self.dtype
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        params = self.scenario_fleet_params()
+        batch = scen["horizon_s"].shape[0]
+        xs, step, cc_carry = self._scan_block_setup(state, inputs)
+        facc = jax.tree.map(
+            lambda l: jnp.broadcast_to(l, (batch,) + l.shape),
+            flt.init_acc("risk", dtype, cfg.n_chains, params=params))
+
+        def body(carry, x):
+            rc, st, fa = carry
+            rc, meter, ac = step(rc, x)
+            t = x["t"]
+            base_valid = t < cfg.duration_s
+
+            def one(sc, st_i, fa_i):
+                meter_i = meter * sc["demand_scale"] + sc["demand_shift_w"]
+                pv_i = jnp.minimum(
+                    ac * (sc["pv_scale"] * sc["weather_bias"]),
+                    sc["curtail_w"])
+                residual = meter_i - pv_i
+                valid = base_valid & (t < sc["horizon_s"])
+                vz = jnp.where(valid, 1.0, 0.0).astype(dtype)
+                st_i = {
+                    "pv_sum": st_i["pv_sum"] + pv_i * vz,
+                    "pv_max": jnp.maximum(st_i["pv_max"],
+                                          jnp.where(valid, pv_i, -big)),
+                    "meter_sum": st_i["meter_sum"] + meter_i * vz,
+                    "residual_sum": st_i["residual_sum"] + residual * vz,
+                    "residual_min": jnp.minimum(
+                        st_i["residual_min"],
+                        jnp.where(valid, residual, big)),
+                    "residual_max": jnp.maximum(
+                        st_i["residual_max"],
+                        jnp.where(valid, residual, -big)),
+                    "n_seconds": st_i["n_seconds"]
+                    + valid.astype(jnp.int32),
+                }
+                fa_i = flt.fold_second(
+                    fa_i, "risk", params, meter=meter_i, pv=pv_i,
+                    residual=residual, covered=None, t=t, valid=valid)
+                return st_i, fa_i
+
+            st, fa = jax.vmap(one)(scen, st, fa)
+            return (rc, st, fa), None
+
+        (rcarry, acc, facc), _ = jax.lax.scan(
+            body, (state["carry"], acc, facc), xs, unroll=self._unroll)
+        fdelta = jax.vmap(flt.reduce_chainwise)(facc)
+        return dict(state, carry=rcarry, cc_carry=cc_carry), acc, fdelta
+
+    def _get_scenario_jit(self):
+        """The scenario dispatch jit, built on first use: serving-only —
+        batch runs never touch it, so the default build cost is zero.
+        State and the running reduce acc are donated (the FleetAcc delta
+        is an output, not a carry); ``scen`` is not, so the batcher may
+        re-dispatch the same scenario tree across blocks."""
+        if self._scenario_jit is None:
+            self._scenario_jit = jax.jit(self._block_step_scan_scenario,
+                                         donate_argnums=(0, 2))
+        return self._scenario_jit
+
+    def scenario_step(self, state, inputs, acc, scen):
+        """One scenario-batched block: ``(state, acc, scen) ->
+        (state', acc', fleet_delta)``.  Counts as a dispatch."""
+        self._m_dispatch.inc()
+        return self._get_scenario_jit()(state, inputs, acc, scen)
+
+    # ------------------------------------------------------------------
     # multi-block fused dispatch (Plan.blocks_per_dispatch > 1)
     # ------------------------------------------------------------------
 
@@ -1657,6 +1803,20 @@ class Simulation:
         if self._k_dispatch > 1 and self.n_blocks >= self._k_dispatch:
             out.extend(self._mega_aot_targets(inputs, state_abs, mode,
                                               tel_on))
+        # scenario-serving buckets (SimConfig.serve_batch_sizes): one
+        # target per batch size so a server started under the persistent
+        # compile cache pre-compiles every shape its micro-batcher can
+        # dispatch — the warm-restart zero-fresh-compiles guarantee
+        for b in self.config.serve_batch_sizes:
+            b = int(b)
+            # bind the batch size as a closure, not an eval_shape
+            # argument — init_scenario_acc shapes arrays with int(batch)
+            # and must see the concrete python int
+            acc_abs = jax.eval_shape(
+                lambda _b=b: self.init_scenario_acc(_b))
+            out.append((f"scenario_acc[{b}]", self._get_scenario_jit(),
+                        (state_abs, inputs_abs, acc_abs,
+                         self.scenario_abstract(b))))
         return out
 
     def _mega_aot_targets(self, inputs, state_abs, mode, tel_on):

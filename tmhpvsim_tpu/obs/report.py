@@ -40,9 +40,13 @@ logger = logging.getLogger(__name__)
 #: analytics: residual quantile sketch, exceedance curve,
 #: loss-of-load probability, ramp-rate extrema, per-regime conditional
 #: means — obs/analytics.py ``summarize``).
+#: v6: adds the optional ``serving`` section (the scenario server's SLO
+#: view: request/reply/rejection/timeout counts, in-flight gauge,
+#: micro-batch occupancy, queue-wait / dispatch / reply-latency
+#: quantiles — serve/, derived from the ``serve.*`` metric names).
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 5
+REPORT_SCHEMA_VERSION = 6
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -70,6 +74,7 @@ _TOP_SCHEMA = {
     "streaming": (False, _OPT_DICT),
     "executor": (False, _OPT_DICT),
     "fleet": (False, _OPT_DICT),
+    "serving": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -307,6 +312,42 @@ def executor_section(snap: dict) -> Optional[dict]:
     return out
 
 
+def serving_section(snap: dict) -> Optional[dict]:
+    """The ``serving`` report section (schema v6) from the well-known
+    ``serve.*`` metric names the scenario server + micro-batcher record
+    (serve/server.py, serve/batcher.py).  None when the run served
+    nothing — batch and app runs keep their reports section-free."""
+    from tmhpvsim_tpu.obs.metrics import quantile_from_snapshot
+
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    if not any(k.startswith("serve.")
+               for k in list(counters) + list(gauges) + list(hists)):
+        return None
+    occ = hists.get("serve.batch_occupancy")
+    occupancy = None
+    if occ and occ.get("count"):
+        occupancy = {
+            "batches": occ["count"],
+            "mean": occ.get("mean"),
+            "max": occ.get("max"),
+            "p50": quantile_from_snapshot(occ, 0.50),
+        }
+    return {
+        "requests": int(counters.get("serve.requests_total", 0)),
+        "replies": int(counters.get("serve.replies_total", 0)),
+        "rejected": int(counters.get("serve.rejected_total", 0)),
+        "timeouts": int(counters.get("serve.timeouts_total", 0)),
+        "batches": int(counters.get("serve.batches_total", 0)),
+        "in_flight": int(gauges.get("serve.in_flight", 0)),
+        "occupancy": occupancy,
+        "queue_wait": _latency_doc(hists.get("serve.queue_wait_s")),
+        "dispatch": _latency_doc(hists.get("serve.dispatch_s")),
+        "reply_latency": _latency_doc(hists.get("serve.reply_latency_s")),
+    }
+
+
 class RunReport:
     """Incremental builder for one run's report.
 
@@ -342,6 +383,9 @@ class RunReport:
         #: fleet-analytics section (schema v5): the host summary of the
         #: run's merged FleetAcc (obs/analytics.py ``summarize``)
         self.fleet: Optional[dict] = None
+        #: scenario-serving SLO section (schema v6), derived from the
+        #: ``serve.*`` metric names by :meth:`attach_metrics`
+        self.serving: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -386,6 +430,9 @@ class RunReport:
             # preserve fields the caller set directly (e.g. cache_dir
             # from engine.compilecache.executor_doc())
             self.executor = {**executor, **(self.executor or {})}
+        serving = serving_section(snap)
+        if serving is not None:
+            self.serving = serving
 
     def doc(self, validate: bool = True) -> dict:
         out = {
@@ -410,6 +457,7 @@ class RunReport:
             "streaming": self.streaming,
             "executor": self.executor,
             "fleet": self.fleet,
+            "serving": self.serving,
         }
         return validate_report(out) if validate else out
 

@@ -30,8 +30,11 @@ import asyncio
 import dataclasses
 import datetime as _dt
 import json
+import logging
 import weakref
 from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -107,8 +110,17 @@ def _deliver_counter():
 # ---------------------------------------------------------------------------
 
 
+#: per-consumer buffered messages before oldest-first drop — the same
+#: leak-fix policy as the tcp broker (tcpbroker.MAX_SUBSCRIBER_BACKLOG):
+#: a consumer that stopped iterating its subscription must not grow its
+#: queue without bound for the life of the process
+MAX_CONSUMER_BACKLOG = 10_000
+
+
 class _LocalBroker:
-    """Named fanout exchanges; one per-consumer unbounded queue each."""
+    """Named fanout exchanges; one bounded per-consumer queue each
+    (oldest-first drop past :data:`MAX_CONSUMER_BACKLOG`, counted in
+    ``broker.dropped_total``)."""
 
     _registry: Dict[str, "_LocalBroker"] = {}
 
@@ -122,9 +134,22 @@ class _LocalBroker:
 
     def publish(self, exchange: str, msg: Message) -> None:
         depth = 0
+        dropped = 0
         for q in self._exchanges.get(exchange, []):
+            while q.qsize() >= MAX_CONSUMER_BACKLOG:
+                q.get_nowait()
+                dropped += 1
             q.put_nowait(msg)
             depth = max(depth, q.qsize())
+        if dropped:
+            from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.get_registry().counter(
+                "broker.dropped_total").inc(dropped)
+            logger.warning(
+                "local broker: consumer backlog exceeded %d on %r; "
+                "dropped %d oldest messages (consumer stalled?)",
+                MAX_CONSUMER_BACKLOG, exchange, dropped)
         if depth:
             from tmhpvsim_tpu.obs import metrics as obs_metrics
 

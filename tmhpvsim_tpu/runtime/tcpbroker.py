@@ -65,12 +65,20 @@ MAX_SUBSCRIBER_BACKLOG = 10_000
 
 class _Subscriber:
     """One consumer connection: a bounded queue + drain task, so a slow or
-    stalled consumer back-pressures onto ITS buffer, never the broker."""
+    stalled consumer back-pressures onto ITS buffer, never the broker.
 
-    def __init__(self, writer: asyncio.StreamWriter):
+    ``tcpbroker.backlog_depth`` is the AGGREGATE queued-message count
+    across all live subscribers, maintained by +/- deltas (an absolute
+    ``set(qsize)`` per subscriber would be last-write-wins: with many
+    concurrent subscribers the gauge read whichever one touched it
+    last, hiding every other backlog)."""
+
+    def __init__(self, writer: asyncio.StreamWriter,
+                 max_backlog: int = MAX_SUBSCRIBER_BACKLOG):
         from tmhpvsim_tpu.obs import metrics as obs_metrics
 
         self.writer = writer
+        self.max_backlog = int(max_backlog)
         self.queue: asyncio.Queue = asyncio.Queue()
         self.n_dropped = 0
         reg = obs_metrics.get_registry()
@@ -78,22 +86,33 @@ class _Subscriber:
         self._g_backlog = reg.gauge("tcpbroker.backlog_depth")
 
     def offer(self, line: bytes) -> None:
-        while self.queue.qsize() >= MAX_SUBSCRIBER_BACKLOG:
+        while self.queue.qsize() >= self.max_backlog:
             self.queue.get_nowait()
+            self._g_backlog.add(-1)
             self.n_dropped += 1
             self._c_dropped.inc()
             if self.n_dropped == 1 or self.n_dropped % 1000 == 0:
                 logger.warning(
                     "tcp broker: subscriber backlog exceeded %d; dropped "
                     "%d oldest messages (consumer stalled?)",
-                    MAX_SUBSCRIBER_BACKLOG, self.n_dropped,
+                    self.max_backlog, self.n_dropped,
                 )
         self.queue.put_nowait(line)
-        self._g_backlog.set(self.queue.qsize())
+        self._g_backlog.add(1)
+
+    def unregistered(self) -> None:
+        """Hand back this queue's share of the aggregate backlog gauge
+        (idempotent: the queue is emptied)."""
+        n = self.queue.qsize()
+        if n:
+            self._g_backlog.add(-n)
+        while not self.queue.empty():
+            self.queue.get_nowait()
 
     async def drain(self) -> None:
         while True:
             line = await self.queue.get()
+            self._g_backlog.add(-1)
             self.writer.write(line)
             await self.writer.drain()
 
@@ -101,9 +120,11 @@ class _Subscriber:
 class TcpFanoutBroker:
     """The broker server: named fanout exchanges over one TCP port."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 5673):
+    def __init__(self, host: str = "127.0.0.1", port: int = 5673,
+                 max_backlog: int = MAX_SUBSCRIBER_BACKLOG):
         self.host = host
         self.port = port
+        self.max_backlog = int(max_backlog)
         self._exchanges: Dict[str, Set[_Subscriber]] = {}
         self._server: Optional[asyncio.base_events.Server] = None
         #: writers of ALL live connections (not just subscribers): since
@@ -142,6 +163,17 @@ class TcpFanoutBroker:
             await self.start()
         async with self._server:
             await self._server.serve_forever()
+
+    def _unregister(self, exchange: Optional[str],
+                    sub: Optional[_Subscriber]) -> None:
+        """Detach a subscriber (idempotent): stop fanning out to it and
+        return its queued share of the backlog gauge."""
+        subs = self._exchanges.get(exchange)
+        if subs is not None and sub in subs:
+            subs.discard(sub)
+            if not subs:
+                self._exchanges.pop(exchange, None)
+            sub.unregistered()
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -191,9 +223,17 @@ class TcpFanoutBroker:
                             line[:100],
                         )
                         continue
-                    sub = _Subscriber(writer)
+                    sub = _Subscriber(writer, self.max_backlog)
                     self._exchanges.setdefault(sub_exchange, set()).add(sub)
                     drain_task = asyncio.create_task(sub.drain())
+                    # a consumer that dies mid-write kills the drain task
+                    # with ConnectionError while this reader loop may stay
+                    # parked in readline() (half-open socket): unregister
+                    # immediately so publishes stop piling into a queue
+                    # nothing will ever drain
+                    drain_task.add_done_callback(
+                        lambda _t, e=sub_exchange, s=sub:
+                        self._unregister(e, s))
                 else:
                     logger.warning("tcp broker: unexpected op %r", op)
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -201,7 +241,7 @@ class TcpFanoutBroker:
         finally:
             self._conn_writers.discard(writer)
             if sub is not None:
-                self._exchanges.get(sub_exchange, set()).discard(sub)
+                self._unregister(sub_exchange, sub)
             if drain_task is not None:
                 drain_task.cancel()
                 # the drain task may already be DONE with a ConnectionError

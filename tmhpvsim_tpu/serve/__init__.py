@@ -1,0 +1,32 @@
+"""Scenario-serving runtime: a warm, micro-batching pvsim query server.
+
+A long-lived asyncio server (``pvsim serve``) builds one
+:class:`~tmhpvsim_tpu.engine.simulation.Simulation` at startup — under
+the persistent compile cache + AOT warm-up, so a warm restart performs
+zero fresh compiles — pins the base chain state device-resident, and
+answers "what-if" scenario queries over the existing broker transports
+(``local://`` / ``tcp://`` / AMQP).  Each request perturbs a bounded
+set of scenario knobs (demand scale/shift, DC-capacity scale,
+weather-regime bias, curtailment cap, horizon) and picks a result mode;
+a micro-batcher coalesces concurrent requests within a configurable
+window into ONE fused dispatch with the knobs stacked on a leading
+``vmap`` axis over the chain axis (``SimConfig.serve_batch_sizes``).
+
+Modules: :mod:`.schema` (request/reply wire format + validation +
+scenario→pytree encoding), :mod:`.batcher` (the window/occupancy
+coalescer), :mod:`.server` (the asyncio server, the warm engine
+wrapper, graceful shutdown).
+"""
+
+from tmhpvsim_tpu.serve.schema import (  # noqa: F401
+    Request,
+    RequestError,
+    Scenario,
+)
+from tmhpvsim_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from tmhpvsim_tpu.serve.server import (  # noqa: F401
+    ScenarioClient,
+    ScenarioEngine,
+    ScenarioServer,
+    ServeConfig,
+)

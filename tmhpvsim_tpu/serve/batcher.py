@@ -1,0 +1,181 @@
+"""Micro-batcher: coalesce concurrent scenario requests into one
+fused dispatch.
+
+The window protocol: the first pending request OPENS a window; the
+batch dispatches when either ``window_s`` elapses or ``max_batch``
+requests are pending, whichever comes first.  A lone request therefore
+pays at most one window of added latency, and a burst of concurrent
+clients rides one dispatch (batch occupancy > 1 — the serving win the
+e2e acceptance test asserts).
+
+The dispatch callable runs in a single worker thread: device access is
+serialized by construction (one dispatch in flight at a time — exactly
+the semantics of one accelerator) while the event loop stays free to
+accept and reject traffic.  Results resolve per-request futures; a
+future the server already abandoned (request timeout) is skipped, not
+an error.
+
+SLO metrics (``serve.*``, obs/metrics.py): ``queue_wait_s`` /
+``dispatch_s`` histograms, a ``batch_occupancy`` histogram on dedicated
+count buckets plus a last-batch gauge, and ``batches_total``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import dataclasses
+import logging
+from typing import Callable, List, Optional, Sequence
+
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.serve.schema import Request, RequestError
+
+log = logging.getLogger(__name__)
+
+#: occupancy histogram buckets — request counts, not seconds
+OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+                     32.0, 48.0, 64.0)
+
+
+@dataclasses.dataclass
+class _Pending:
+    request: Request
+    future: asyncio.Future
+    t_enq: float  # loop.time() at submit
+
+
+class MicroBatcher:
+    """See module docstring.  ``dispatch(requests) -> results`` is a
+    SYNCHRONOUS callable (it owns the device) returning one result per
+    request, positionally."""
+
+    _STOP = object()
+
+    def __init__(self, dispatch: Callable[[List[Request]], Sequence],
+                 *, window_s: float = 0.010, max_batch: int = 16,
+                 queue_limit: int = 1024, registry=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch {max_batch} must be >= 1")
+        self._dispatch = dispatch
+        self._window_s = float(window_s)
+        self._max_batch = int(max_batch)
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=queue_limit)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-dispatch")
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+        reg = registry or obs_metrics.get_registry()
+        self._c_batches = reg.counter("serve.batches_total")
+        self._h_wait = reg.histogram("serve.queue_wait_s")
+        self._h_dispatch = reg.histogram("serve.dispatch_s")
+        self._h_occupancy = reg.histogram("serve.batch_occupancy",
+                                          buckets=OCCUPANCY_BUCKETS)
+        self._g_occupancy = reg.gauge("serve.last_batch_occupancy")
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def submit(self, request: Request) -> asyncio.Future:
+        """Enqueue one request; the returned future resolves with its
+        result.  Raises a typed ``busy`` rejection when the pending
+        queue is full and ``draining`` once the batcher is stopping."""
+        if self._closed:
+            raise RequestError("draining", "batcher is stopping")
+        loop = asyncio.get_running_loop()
+        pending = _Pending(request, loop.create_future(), loop.time())
+        try:
+            self._queue.put_nowait(pending)
+        except asyncio.QueueFull:
+            raise RequestError(
+                "busy", f"pending queue full "
+                f"({self._queue.maxsize} requests)") from None
+        return pending.future
+
+    async def stop(self, drain: bool = True) -> None:
+        """Stop the loop.  ``drain=True`` processes everything already
+        queued first; ``drain=False`` fails queued requests with a
+        typed ``draining`` error."""
+        self._closed = True
+        if not drain:
+            while True:
+                try:
+                    p = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if p is not self._STOP and not p.future.done():
+                    p.future.set_exception(
+                        RequestError("draining", "server shut down"))
+        await self._queue.put(self._STOP)
+        if self._task is not None:
+            await self._task
+            self._task = None
+        self._pool.shutdown(wait=True)
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            first = await self._queue.get()
+            if first is self._STOP:
+                return
+            batch = [first]
+            stop_after = False
+            deadline = loop.time() + self._window_s
+            while len(batch) < self._max_batch:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = await asyncio.wait_for(self._queue.get(),
+                                                 remaining)
+                except asyncio.TimeoutError:
+                    break
+                if nxt is self._STOP:
+                    stop_after = True
+                    break
+                batch.append(nxt)
+            await self._run_batch(batch, loop)
+            if stop_after:
+                return
+
+    async def _run_batch(self, batch: List[_Pending], loop) -> None:
+        now = loop.time()
+        waits = [now - p.t_enq for p in batch]
+        for w in waits:
+            self._h_wait.observe(w)
+        self._h_occupancy.observe(float(len(batch)))
+        self._g_occupancy.set(len(batch))
+        self._c_batches.inc()
+        requests = [p.request for p in batch]
+        t0 = loop.time()
+        try:
+            results = await loop.run_in_executor(
+                self._pool, self._dispatch, requests)
+        except Exception as err:
+            log.exception("scenario dispatch failed (%d requests)",
+                          len(batch))
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(
+                        RequestError("internal",
+                                     f"dispatch failed: {err}"))
+            return
+        dispatch_s = loop.time() - t0
+        self._h_dispatch.observe(dispatch_s)
+        if len(results) != len(batch):  # dispatch contract violation
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(RequestError(
+                        "internal",
+                        f"dispatch returned {len(results)} results "
+                        f"for {len(batch)} requests"))
+            return
+        # resolve as (result, info): the server folds the per-request
+        # timings into the reply's "t" section
+        for p, r, w in zip(batch, results, waits):
+            if not p.future.done():
+                p.future.set_result((r, {
+                    "batch": len(batch),
+                    "queue_s": w,
+                    "dispatch_s": dispatch_s,
+                }))

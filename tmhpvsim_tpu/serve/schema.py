@@ -1,0 +1,251 @@
+"""Request/reply wire schema of the scenario-serving runtime.
+
+Requests and replies ride the brokers' OUT-OF-BAND metadata channel
+(``Message.meta`` / AMQP headers / the tcp wire's ``"m"`` key) so the
+JSON-float body contract of the fanout exchanges is untouched —
+reference-shaped consumers sharing a broker never see a non-float body.
+
+Request meta (on the server's request exchange)::
+
+    {"op": "scenario", "id": "<1..64 chars>", "reply_to": "<exchange>",
+     "mode": "reduce" | "quantiles" | "fleet",     # default "reduce"
+     "scenario": {                                 # all knobs optional
+        "demand_scale":     float in [0, 8],       # default 1
+        "demand_shift_w":   float in [-1e7, 1e7],  # default 0
+        "dc_capacity_scale":float in [0, 8],       # default 1
+        "weather_bias":     float in [0.25, 4],    # default 1
+        "curtail_w":        float >= 0 or null,    # default null (no cap)
+        "horizon_s":        int in [1, server max] # default server max
+     }}
+
+Reply meta (on ``reply_to``)::
+
+    {"op": "scenario-reply", "id": ..., "ok": true,
+     "mode": ..., "result": {...}, "t": {queue/dispatch/batch timings}}
+    {"op": "scenario-reply", "id": ..., "ok": false,
+     "error": {"code": "<ERROR_CODES>", "message": ...}}
+
+Validation is strict — unknown scenario knobs, non-finite values and
+out-of-bounds values are typed ``invalid`` rejections, never silently
+clamped: a serving fleet must not quietly answer a different question
+than the one asked.
+
+:func:`encode_batch` turns validated :class:`Scenario` rows into the
+(batch,)-leaf knob pytree ``Simulation.scenario_step`` consumes
+(``engine.simulation.SCENARIO_FLOAT_KNOBS`` + int32 ``horizon_s``);
+the request-side ``dc_capacity_scale`` maps to the engine leaf
+``pv_scale``, and a null curtailment cap encodes as the compute dtype's
+finfo.max so ``min(pv, cap)`` is the identity.  Padding rows carry
+``horizon_s=0`` and fold nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+OP_REQUEST = "scenario"
+OP_REPLY = "scenario-reply"
+
+MODES = ("reduce", "quantiles", "fleet")
+
+#: typed rejection codes a reply's ``error.code`` may carry
+ERROR_CODES = ("invalid", "duplicate", "busy", "draining", "timeout",
+               "internal")
+
+#: request-side knob bounds: name -> (lo, hi, default).  Scales are
+#: capped at 8x (a fleet scenario, not a numerics stress test) and the
+#: weather-regime bias at [0.25, 4] so the perturbed pv stays within
+#: the analytics sketch's dynamic range.
+KNOB_BOUNDS = {
+    "demand_scale": (0.0, 8.0, 1.0),
+    "demand_shift_w": (-1e7, 1e7, 0.0),
+    "dc_capacity_scale": (0.0, 8.0, 1.0),
+    "weather_bias": (0.25, 4.0, 1.0),
+}
+
+_MAX_ID_LEN = 64
+_MAX_EXCHANGE_LEN = 128
+
+
+class RequestError(ValueError):
+    """A typed request rejection: ``code`` is one of :data:`ERROR_CODES`
+    and lands verbatim in the error reply."""
+
+    def __init__(self, code: str, message: str):
+        assert code in ERROR_CODES, code
+        super().__init__(message)
+        self.code = code
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One validated scenario: the knob values a request perturbs.
+
+    ``horizon_s=0`` marks a batch padding row — it folds nothing, so
+    its presence never changes another row's answer.
+    """
+
+    demand_scale: float = 1.0
+    demand_shift_w: float = 0.0
+    dc_capacity_scale: float = 1.0
+    weather_bias: float = 1.0
+    curtail_w: Optional[float] = None
+    horizon_s: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One validated scenario request."""
+
+    id: str
+    reply_to: str
+    mode: str
+    scenario: Scenario
+
+
+def _check_float(name: str, v, lo: float, hi: float) -> float:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise RequestError("invalid",
+                           f"scenario.{name}: expected a number, "
+                           f"got {type(v).__name__}")
+    v = float(v)
+    if not math.isfinite(v):
+        raise RequestError("invalid", f"scenario.{name}: must be finite")
+    if not (lo <= v <= hi):
+        raise RequestError(
+            "invalid", f"scenario.{name}={v:g} outside [{lo:g}, {hi:g}]")
+    return v
+
+
+def parse_scenario(doc, *, max_horizon_s: int) -> Scenario:
+    """Validate one request's ``scenario`` value (may be None/absent:
+    every knob has a neutral default and the horizon defaults to the
+    server's maximum)."""
+    if doc is None:
+        doc = {}
+    if not isinstance(doc, dict):
+        raise RequestError("invalid",
+                           f"scenario: expected an object, "
+                           f"got {type(doc).__name__}")
+    known = set(KNOB_BOUNDS) | {"curtail_w", "horizon_s"}
+    unknown = sorted(set(doc) - known)
+    if unknown:
+        raise RequestError(
+            "invalid", f"scenario: unknown knob(s) {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(known))})")
+    kw = {}
+    for name, (lo, hi, default) in KNOB_BOUNDS.items():
+        kw[name] = (_check_float(name, doc[name], lo, hi)
+                    if name in doc else default)
+    cap = doc.get("curtail_w")
+    if cap is not None:
+        cap = _check_float("curtail_w", cap, 0.0, float("inf"))
+        if math.isinf(cap):  # pragma: no cover - isfinite already rejects
+            cap = None
+    kw["curtail_w"] = cap
+    h = doc.get("horizon_s", max_horizon_s)
+    if isinstance(h, bool) or not isinstance(h, int):
+        raise RequestError("invalid",
+                           "scenario.horizon_s: expected an integer")
+    if not (1 <= h <= max_horizon_s):
+        raise RequestError(
+            "invalid",
+            f"scenario.horizon_s={h} outside [1, {max_horizon_s}]")
+    kw["horizon_s"] = h
+    return Scenario(**kw)
+
+
+def parse_request(meta, *, max_horizon_s: int) -> Request:
+    """Validate one request meta dict (``op`` already checked by the
+    caller's traffic filter).  Raises :class:`RequestError` with code
+    ``invalid`` on any malformation."""
+    if not isinstance(meta, dict):
+        raise RequestError("invalid", "request meta must be an object")
+    rid = meta.get("id")
+    if not isinstance(rid, str) or not 1 <= len(rid) <= _MAX_ID_LEN:
+        raise RequestError(
+            "invalid", f"id: expected a 1..{_MAX_ID_LEN} char string")
+    reply_to = meta.get("reply_to")
+    if not isinstance(reply_to, str) or \
+            not 1 <= len(reply_to) <= _MAX_EXCHANGE_LEN:
+        raise RequestError(
+            "invalid",
+            f"reply_to: expected a 1..{_MAX_EXCHANGE_LEN} char "
+            "exchange name")
+    mode = meta.get("mode", "reduce")
+    if mode not in MODES:
+        raise RequestError(
+            "invalid", f"mode {mode!r} not one of {', '.join(MODES)}")
+    unknown = sorted(set(meta) - {"op", "id", "reply_to", "mode",
+                                  "scenario"})
+    if unknown:
+        raise RequestError(
+            "invalid", f"unknown request field(s) {', '.join(unknown)}")
+    scenario = parse_scenario(meta.get("scenario"),
+                              max_horizon_s=max_horizon_s)
+    return Request(id=rid, reply_to=reply_to, mode=mode, scenario=scenario)
+
+
+def request_meta(rid: str, reply_to: str, mode: str = "reduce",
+                 scenario: Optional[dict] = None) -> dict:
+    """The client-side request meta (what :func:`parse_request` reads)."""
+    meta = {"op": OP_REQUEST, "id": rid, "reply_to": reply_to,
+            "mode": mode}
+    if scenario is not None:
+        meta["scenario"] = scenario
+    return meta
+
+
+def ok_meta(rid: str, mode: str, result: dict,
+            timings: Optional[dict] = None) -> dict:
+    meta = {"op": OP_REPLY, "id": rid, "ok": True, "mode": mode,
+            "result": result}
+    if timings:
+        meta["t"] = timings
+    return meta
+
+
+def error_meta(rid: Optional[str], code: str, message: str) -> dict:
+    assert code in ERROR_CODES, code
+    return {"op": OP_REPLY, "id": rid, "ok": False,
+            "error": {"code": code, "message": message}}
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest configured batch bucket that fits ``n`` requests —
+    the compiled-executable set stays finite (one shape per bucket)."""
+    fits = [b for b in buckets if b >= n]
+    if not fits:
+        raise ValueError(
+            f"batch of {n} exceeds largest bucket {max(buckets)}")
+    return min(fits)
+
+
+def encode_batch(scenarios: Sequence[Scenario], batch: int,
+                 dtype) -> dict:
+    """Validated scenarios -> the (batch,)-leaf knob pytree of
+    ``Simulation.scenario_step`` (host numpy; rows past
+    ``len(scenarios)`` are horizon-0 padding)."""
+    if len(scenarios) > batch:
+        raise ValueError(f"{len(scenarios)} scenarios > batch {batch}")
+    dt = np.dtype(dtype)
+    no_cap = float(np.finfo(dt).max)
+    pad = batch - len(scenarios)
+
+    def col(vals, fill):
+        return np.asarray(list(vals) + [fill] * pad, dt)
+
+    return {
+        "demand_scale": col((s.demand_scale for s in scenarios), 1.0),
+        "demand_shift_w": col((s.demand_shift_w for s in scenarios), 0.0),
+        "pv_scale": col((s.dc_capacity_scale for s in scenarios), 1.0),
+        "weather_bias": col((s.weather_bias for s in scenarios), 1.0),
+        "curtail_w": col((no_cap if s.curtail_w is None else s.curtail_w
+                          for s in scenarios), no_cap),
+        "horizon_s": np.asarray(
+            [s.horizon_s for s in scenarios] + [0] * pad, np.int32),
+    }

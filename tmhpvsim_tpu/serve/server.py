@@ -1,0 +1,550 @@
+"""The scenario server: a warm Simulation answering broker queries.
+
+Three pieces:
+
+* :class:`ScenarioEngine` — the warm executor.  Builds ONE
+  :class:`~tmhpvsim_tpu.engine.simulation.Simulation` (reduce mode,
+  ``serve_batch_sizes`` = the batch buckets) so the persistent compile
+  cache + AOT warm-up pre-compile every dispatch shape at startup; the
+  base chain state and per-block host inputs are computed once and
+  reused by every query (the state is protected from donation by a
+  device-side copy per batch).  ``run()`` is synchronous and runs on
+  the micro-batcher's single worker thread.
+* :class:`ScenarioServer` — the asyncio front: subscribes the request
+  exchange, validates (serve/schema.py), rejects duplicates/overload
+  with typed errors, coalesces through the
+  :class:`~tmhpvsim_tpu.serve.batcher.MicroBatcher`, publishes replies
+  to each request's ``reply_to`` exchange, and records the SLO metrics
+  the RunReport ``serving`` section reads.  SIGINT/SIGTERM start a
+  drain: in-flight requests complete, new ones get typed ``draining``
+  rejections.
+* :class:`ScenarioClient` — request/reply correlation for callers
+  (bench's load generator, the tests): one reply-exchange subscription
+  demultiplexed by request id, so out-of-order replies and other
+  clients' replies on a shared exchange are handled by construction.
+
+:func:`serve_main` is the app orchestrator behind ``pvsim serve``:
+per-run registry, compile cache, flight recorder (crash dumps at
+``trace + '.crash.json'``), run report on exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import datetime as _dt
+import logging
+import signal
+import uuid
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.obs import analytics as flt
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs.trace import Tracer
+from tmhpvsim_tpu.runtime.broker import make_transport
+from tmhpvsim_tpu.serve import schema
+from tmhpvsim_tpu.serve.batcher import MicroBatcher
+from tmhpvsim_tpu.serve.schema import Request, RequestError, Scenario
+
+logger = logging.getLogger(__name__)
+
+#: completed request ids remembered for duplicate rejection (an LRU —
+#: serving forever must not grow memory per request)
+RECENT_IDS_CAP = 4096
+
+
+def _now() -> _dt.datetime:
+    """Naive UTC wall time — the brokers' timestamp convention."""
+    return _dt.datetime.now(_dt.timezone.utc).replace(tzinfo=None)
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to ``max_batch`` (plus ``max_batch`` itself):
+    a partial batch pads to the next bucket, so the compiled-executable
+    set stays logarithmic in the batch cap."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """One server: the simulation it answers from + the serving knobs.
+
+    ``sim.duration_s`` is the maximum scenario horizon; requests ask
+    for any ``horizon_s`` in ``[1, sim.duration_s]`` and pay only the
+    blocks their batch's longest horizon needs.
+    """
+
+    sim: SimConfig
+    url: str = "local://default"
+    exchange: str = "scenario"
+    #: micro-batch window: the first pending request waits at most this
+    #: long for company before the batch dispatches
+    window_s: float = 0.010
+    max_batch: int = 16
+    #: explicit batch buckets; () -> ``default_buckets(max_batch)``
+    batch_sizes: Tuple[int, ...] = ()
+    #: pending requests beyond this are rejected ``busy``
+    queue_limit: int = 1024
+    #: per-request wall clock before a typed ``timeout`` reply
+    timeout_s: float = 60.0
+
+    def buckets(self) -> Tuple[int, ...]:
+        bs = tuple(sorted({int(b) for b in self.batch_sizes})) \
+            if self.batch_sizes else default_buckets(self.max_batch)
+        if any(b < 1 for b in bs):
+            raise ValueError(f"batch_sizes {bs} must all be >= 1")
+        return bs
+
+
+class ScenarioEngine:
+    """The warm scenario executor (device side; see module docstring).
+
+    Thread contract: construct anywhere, then ``run()`` only from ONE
+    thread at a time (the micro-batcher's single dispatch worker).
+    """
+
+    def __init__(self, sim_config: SimConfig,
+                 batch_sizes: Sequence[int]):
+        from tmhpvsim_tpu.engine.simulation import Simulation
+
+        self.buckets = tuple(sorted({int(b) for b in batch_sizes}))
+        cfg = dataclasses.replace(
+            sim_config, output="reduce",
+            serve_batch_sizes=self.buckets)
+        self.sim = Simulation(cfg)
+        self.dtype = self.sim.dtype
+        self.max_horizon_s = cfg.duration_s
+        self.params = self.sim.scenario_fleet_params()
+        #: device-resident base state, shared by every query via a
+        #: non-donating device copy (engine/simulation.py _copy_jit)
+        self._state0 = self.sim.init_state()
+        #: per-block host inputs, computed once (host float64 work)
+        self._inputs = [self.sim.host_inputs(bi)[0]
+                        for bi in range(self.sim.n_blocks)]
+
+    def run(self, requests: Sequence[Request]) -> List[dict]:
+        """Answer a batch: one fused dispatch chain over the blocks the
+        batch's longest horizon needs.  Row ``i`` of the padded batch is
+        bit-identical to a batch-of-1 run of scenario ``i`` (see
+        ``Simulation._block_step_scan_scenario``), so replies do not
+        depend on which requests happened to share the window."""
+        from tmhpvsim_tpu.engine.simulation import _copy_jit
+        import jax
+
+        scenarios = [r.scenario for r in requests]
+        bucket = schema.pick_bucket(len(scenarios), self.buckets)
+        scen = schema.encode_batch(scenarios, bucket, self.dtype)
+        cfg = self.sim.config
+        horizon = max(s.horizon_s for s in scenarios)
+        n_blocks = min(self.sim.n_blocks,
+                       -(-int(horizon) // cfg.block_s))
+        state = _copy_jit(self._state0)
+        acc = self.sim.init_scenario_acc(bucket)
+        totals: List[Optional[dict]] = [None] * len(scenarios)
+        for bi in range(n_blocks):
+            state, acc, fdelta = self.sim.scenario_step(
+                state, self._inputs[bi], acc, scen)
+            fd = jax.device_get(fdelta)
+            for i in range(len(scenarios)):
+                totals[i] = flt.merge_host(
+                    totals[i], {k: v[i] for k, v in fd.items()})
+        acc_h = jax.device_get(acc)
+        return [
+            self._format(req, {k: np.asarray(v[i])
+                               for k, v in acc_h.items()}, totals[i])
+            for i, req in enumerate(requests)
+        ]
+
+    def _format(self, req: Request, row: dict,
+                total: Optional[dict]) -> dict:
+        """One request's mode-shaped result (plain JSON-safe python).
+
+        Host reductions are fixed-order numpy ops on bit-identical
+        arrays, and JSON float round-trips are exact (repr shortest
+        round-trip), so equal scenarios give byte-equal replies through
+        any transport."""
+        h = int(req.scenario.horizon_s)
+        if req.mode == "fleet":
+            return {"mode": "fleet", "horizon_s": h,
+                    "fleet": flt.summarize(total, self.params)}
+        if req.mode == "quantiles":
+            fleet = flt.summarize(total, self.params)
+            return {"mode": "quantiles", "horizon_s": h,
+                    "count": fleet["count"],
+                    "residual": fleet["residual"]}
+        ns = int(row["n_seconds"].sum())
+
+        def tot(name):
+            return float(row[name].astype(np.float64).sum())
+
+        return {"mode": "reduce", "horizon_s": h, "stats": {
+            "n_seconds": ns,
+            "pv_sum_w": tot("pv_sum"),
+            "meter_sum_w": tot("meter_sum"),
+            "residual_sum_w": tot("residual_sum"),
+            "pv_max_w": float(row["pv_max"].max()),
+            "residual_min_w": float(row["residual_min"].min()),
+            "residual_max_w": float(row["residual_max"].max()),
+        }}
+
+
+class ScenarioServer:
+    """The asyncio serving front (see module docstring)."""
+
+    def __init__(self, cfg: ServeConfig, *, registry=None,
+                 tracer: Optional[Tracer] = None):
+        self.cfg = cfg
+        self.registry = registry or obs_metrics.get_registry()
+        self.tracer = tracer
+        self.engine: Optional[ScenarioEngine] = None
+        self.batcher: Optional[MicroBatcher] = None
+        self._req_tx = None
+        self._reply_tx: dict = {}
+        self._consume_task: Optional[asyncio.Task] = None
+        self._tasks: set = set()
+        self._inflight_ids: set = set()
+        self._recent_ids: OrderedDict = OrderedDict()
+        self._draining = False
+        self._stopped = False
+        self._drain_event: Optional[asyncio.Event] = None
+        reg = self.registry
+        self._c_requests = reg.counter("serve.requests_total")
+        self._c_replies = reg.counter("serve.replies_total")
+        self._c_rejected = reg.counter("serve.rejected_total")
+        self._c_timeouts = reg.counter("serve.timeouts_total")
+        self._g_inflight = reg.gauge("serve.in_flight")
+        self._h_reply = reg.histogram("serve.reply_latency_s")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    async def start(self) -> None:
+        """Build the warm engine (compiles — possibly from the warm
+        cache), open the request subscription, start the batcher."""
+        self._drain_event = asyncio.Event()
+        with obs_metrics.use_registry(self.registry):
+            self.engine = ScenarioEngine(self.cfg.sim,
+                                         self.cfg.buckets())
+            self.batcher = MicroBatcher(
+                self.engine.run,
+                window_s=self.cfg.window_s,
+                max_batch=max(self.engine.buckets),
+                queue_limit=self.cfg.queue_limit,
+                registry=self.registry)
+            self.batcher.start()
+            self._req_tx = make_transport(self.cfg.url, self.cfg.exchange)
+            await self._req_tx.__aenter__()
+        self._consume_task = asyncio.create_task(self._consume())
+        if self.tracer:
+            self.tracer.instant("serve.start", "serve")
+        logger.info(
+            "scenario server listening on %s exchange %r "
+            "(buckets %s, window %.0f ms, max horizon %d s)",
+            self.cfg.url, self.cfg.exchange, list(self.engine.buckets),
+            self.cfg.window_s * 1e3, self.engine.max_horizon_s)
+
+    def install_signal_handlers(self) -> None:
+        """SIGINT/SIGTERM -> begin draining (idempotent)."""
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            with contextlib.suppress(NotImplementedError):
+                loop.add_signal_handler(sig, self.begin_drain)
+
+    def begin_drain(self) -> None:
+        """Stop accepting work: new requests get typed ``draining``
+        replies; in-flight requests run to completion."""
+        if not self._draining:
+            logger.info("scenario server draining: rejecting new "
+                        "requests, completing %d in flight",
+                        len(self._inflight_ids))
+            if self.tracer:
+                self.tracer.instant("serve.drain", "serve")
+        self._draining = True
+        if self._drain_event is not None:
+            self._drain_event.set()
+
+    async def serve_forever(self) -> None:
+        """Run until a signal (or :meth:`begin_drain`) starts the
+        drain, then stop cleanly."""
+        await self._drain_event.wait()
+        await self.stop()
+
+    async def stop(self) -> None:
+        """Drain and shut down (idempotent): queued batches run,
+        in-flight replies publish, then the subscription and reply
+        transports close."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._draining = True
+        if self.batcher is not None:
+            await self.batcher.stop(drain=True)
+        if self._tasks:
+            # replies for everything the batcher just resolved
+            await asyncio.wait(self._tasks,
+                               timeout=self.cfg.timeout_s + 5.0)
+        if self._consume_task is not None:
+            self._consume_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError,
+                                     ConnectionError):
+                await self._consume_task
+        for tx in [self._req_tx, *self._reply_tx.values()]:
+            if tx is not None:
+                with contextlib.suppress(Exception):
+                    await tx.__aexit__(None, None, None)
+        self._reply_tx.clear()
+        if self.tracer:
+            self.tracer.instant("serve.stop", "serve")
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+
+    async def _consume(self) -> None:
+        async for item in self._req_tx.subscribe(with_meta=True):
+            _t, _v, meta = item
+            self._handle(meta)
+
+    def _handle(self, meta) -> None:
+        # non-request traffic on a shared exchange is not ours to judge
+        if not isinstance(meta, dict) or \
+                meta.get("op") != schema.OP_REQUEST:
+            return
+        self._c_requests.inc()
+        loop = asyncio.get_running_loop()
+        t_recv = loop.time()
+        rid = meta.get("id") if isinstance(meta.get("id"), str) else None
+        reply_to = meta.get("reply_to") \
+            if isinstance(meta.get("reply_to"), str) else None
+        if self.tracer:
+            self.tracer.instant("serve.request", "serve", id=rid)
+        try:
+            if self._draining:
+                raise RequestError("draining",
+                                   "server is draining; retry elsewhere")
+            req = schema.parse_request(
+                meta, max_horizon_s=self.engine.max_horizon_s)
+            if req.id in self._inflight_ids or \
+                    req.id in self._recent_ids:
+                raise RequestError(
+                    "duplicate", f"request id {req.id!r} already seen")
+        except RequestError as err:
+            self._reject(reply_to, rid, err)
+            return
+        self._inflight_ids.add(req.id)
+        self._g_inflight.set(len(self._inflight_ids))
+        task = asyncio.create_task(self._respond(req, t_recv))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def _reject(self, reply_to: Optional[str], rid: Optional[str],
+                err: RequestError) -> None:
+        self._c_rejected.inc()
+        logger.warning("scenario request rejected (%s): %s",
+                       err.code, err)
+        if reply_to:  # no reply address -> counted, nothing to say
+            task = asyncio.create_task(self._publish_reply(
+                reply_to, schema.error_meta(rid, err.code, str(err))))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _respond(self, req: Request, t_recv: float) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            try:
+                fut = self.batcher.submit(req)
+                result, info = await asyncio.wait_for(
+                    fut, timeout=self.cfg.timeout_s)
+            except asyncio.TimeoutError:
+                self._c_timeouts.inc()
+                await self._publish_reply(req.reply_to, schema.error_meta(
+                    req.id, "timeout",
+                    f"no result within {self.cfg.timeout_s:g} s"))
+                return
+            except RequestError as err:
+                self._c_rejected.inc()
+                await self._publish_reply(req.reply_to, schema.error_meta(
+                    req.id, err.code, str(err)))
+                return
+            except Exception as err:  # engine bug: reply, do not wedge
+                logger.exception("scenario request %s failed", req.id)
+                await self._publish_reply(req.reply_to, schema.error_meta(
+                    req.id, "internal", f"{type(err).__name__}: {err}"))
+                return
+            latency = loop.time() - t_recv
+            await self._publish_reply(req.reply_to, schema.ok_meta(
+                req.id, req.mode, result,
+                timings={**info, "reply_latency_s": latency}))
+            self._c_replies.inc()
+            self._h_reply.observe(latency)
+            if self.tracer:
+                self.tracer.instant("serve.reply", "serve", id=req.id,
+                                    latency_s=latency)
+        finally:
+            self._inflight_ids.discard(req.id)
+            self._recent_ids[req.id] = None
+            while len(self._recent_ids) > RECENT_IDS_CAP:
+                self._recent_ids.popitem(last=False)
+            self._g_inflight.set(len(self._inflight_ids))
+
+    async def _publish_reply(self, exchange: str, meta: dict) -> None:
+        """Publish on a per-``reply_to`` transport (cached: clients
+        reuse their reply exchange across requests)."""
+        tx = self._reply_tx.get(exchange)
+        if tx is None:
+            tx = make_transport(self.cfg.url, exchange)
+            await tx.__aenter__()
+            self._reply_tx[exchange] = tx
+        await tx.publish(0.0, _now(), meta=meta)
+
+
+class ScenarioClient:
+    """Request/reply correlation over the fanout transports.
+
+    One reply exchange per client, one subscription, replies resolved
+    by ``id`` — so replies arriving out of order, or other clients'
+    replies on a deliberately shared reply exchange, route correctly
+    by construction.  ``batch()`` issues many requests concurrently
+    (the server's micro-batch window sees them together).
+    """
+
+    def __init__(self, url: str, exchange: str = "scenario",
+                 reply_to: Optional[str] = None):
+        self._url = url
+        self._exchange = exchange
+        self.reply_to = reply_to or \
+            f"scenario.reply.{uuid.uuid4().hex[:12]}"
+        self._pending: dict = {}
+        self._req_tx = None
+        self._rep_tx = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def __aenter__(self):
+        self._req_tx = make_transport(self._url, self._exchange)
+        await self._req_tx.__aenter__()
+        self._rep_tx = make_transport(self._url, self.reply_to)
+        await self._rep_tx.__aenter__()
+        self._task = asyncio.create_task(self._consume())
+        # let the subscription register before the first publish (the
+        # fanout contract only delivers to already-bound subscribers)
+        await asyncio.sleep(0.05)
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError,
+                                     ConnectionError):
+                await self._task
+        for tx in (self._rep_tx, self._req_tx):
+            if tx is not None:
+                with contextlib.suppress(Exception):
+                    await tx.__aexit__(None, None, None)
+        return False
+
+    async def _consume(self) -> None:
+        async for _t, _v, meta in self._rep_tx.subscribe(with_meta=True):
+            if not isinstance(meta, dict) or \
+                    meta.get("op") != schema.OP_REPLY:
+                continue
+            fut = self._pending.pop(meta.get("id"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(meta)
+
+    async def request(self, scenario: Optional[dict] = None,
+                      mode: str = "reduce", rid: Optional[str] = None,
+                      timeout: float = 60.0) -> dict:
+        """One scenario query -> the reply meta dict (``ok`` true or
+        false — typed errors come back as values, not exceptions)."""
+        rid = rid or uuid.uuid4().hex[:16]
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending[rid] = fut
+        try:
+            await self._req_tx.publish(0.0, _now(), meta=schema.request_meta(
+                rid, self.reply_to, mode, scenario))
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    async def batch(self, scenarios: Sequence[Optional[dict]],
+                    mode: str = "reduce",
+                    timeout: float = 60.0) -> List[dict]:
+        """Concurrent requests (one window's worth of company)."""
+        return list(await asyncio.gather(*[
+            self.request(s, mode=mode, timeout=timeout)
+            for s in scenarios]))
+
+
+async def serve_main(cfg: ServeConfig, *,
+                     compile_cache: Optional[str] = None,
+                     trace: Optional[str] = None,
+                     metrics_path: Optional[str] = None,
+                     run_report_path: Optional[str] = None,
+                     install_signals: bool = True) -> None:
+    """App orchestrator behind ``pvsim serve``: per-run registry +
+    compile cache + flight recorder + run report, around one
+    :class:`ScenarioServer` lifetime."""
+    from tmhpvsim_tpu.engine import compilecache
+
+    registry = obs_metrics.MetricsRegistry()
+    sink = None
+    if metrics_path:
+        sink = obs_metrics.make_sink(metrics_path)
+        registry.add_sink(sink)
+    tracer = Tracer() if trace else None
+    server = ScenarioServer(cfg, registry=registry, tracer=tracer)
+    with obs_metrics.use_registry(registry):
+        if compile_cache is not None:
+            compilecache.configure(compile_cache)
+        try:
+            await server.start()
+            if install_signals:
+                server.install_signal_handlers()
+            await server.serve_forever()
+        except asyncio.CancelledError:
+            raise  # orderly shutdown: no crash artifact
+        except BaseException:
+            if tracer:
+                # the flight recorder's whole point: the last 30 s of
+                # serving timeline survive an unhandled exception
+                with contextlib.suppress(Exception):
+                    tracer.dump_flight(trace + ".crash.json")
+            raise
+        finally:
+            with contextlib.suppress(Exception):
+                await server.stop()
+            if tracer:
+                with contextlib.suppress(Exception):
+                    tracer.export(trace, process_name="pvsim-serve")
+            if run_report_path:
+                try:
+                    from tmhpvsim_tpu.obs.report import RunReport
+
+                    rep = RunReport(
+                        "pvsim.serve",
+                        config=(server.engine.sim.config
+                                if server.engine else cfg.sim),
+                        plan=(server.engine.sim.plan
+                              if server.engine else None))
+                    rep.attach_metrics(registry)
+                    rep.write(run_report_path)
+                except Exception as err:  # must not mask the outcome
+                    logger.warning("run report write failed: %s", err)
+            if sink is not None:
+                registry.flush(event="end")
+                registry.remove_sink(sink)
+                with contextlib.suppress(Exception):
+                    sink.close()

@@ -24,10 +24,13 @@ Accepted document shapes (the repo's bench history spans all four):
 * a bare obs RunReport document (``kind: tmhpvsim_tpu.run_report``).
 
 The table also carries each row's telemetry/analytics levels (from the
-embedded config echo; pre-instrumentation docs read as 'off') and an
+embedded config echo; pre-instrumentation docs read as 'off'), an
 ``ovh%`` column: the instrumented row's steady block wall vs the best
-same-platform uninstrumented row.  ``--json`` emits the rows + gate
-verdict as one JSON document for machine consumers.
+same-platform uninstrumented row, and a ``serve`` column: the
+scenario-serving request-coalescing ratio (requests per fused dispatch,
+from a v6 ``serving`` section or a ``bench.py --serve`` doc).
+``--json`` emits the rows + gate verdict as one JSON document for
+machine consumers.
 
 No third-party imports: runs anywhere the repo checks out.
 """
@@ -82,6 +85,21 @@ def _compile_from_headline(doc: dict) -> float | None:
     return None
 
 
+def _serve_ratio(doc) -> float | None:
+    """Request-coalescing ratio (requests per fused dispatch) from a v6
+    ``serving`` section or a ``bench.py --serve`` doc, best effort."""
+    if doc.get("kind") == REPORT_KIND:
+        sec = doc.get("serving")
+    else:
+        if isinstance(doc.get("coalescing"), (int, float)):
+            return float(doc["coalescing"])
+        rep = doc.get("run_report")
+        sec = rep.get("serving") if isinstance(rep, dict) else None
+    if isinstance(sec, dict) and sec.get("batches"):
+        return float(sec.get("requests", 0)) / float(sec["batches"])
+    return None
+
+
 def _levels(cfg) -> tuple:
     """(telemetry, analytics) levels from a config echo; pre-PR-3/PR-6
     documents predate the fields and read as 'off'."""
@@ -95,7 +113,8 @@ def normalize(path: str) -> dict:
     name = os.path.basename(path)
     row = {"name": name, "order": name, "platform": None, "value": None,
            "compile_s": None, "steady_block_s": None,
-           "telemetry": None, "analytics": None, "failed": True}
+           "telemetry": None, "analytics": None, "serve": None,
+           "failed": True}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -126,10 +145,13 @@ def normalize(path: str) -> dict:
             compile_s=timing.get("compile_s"),
             steady_block_s=timing.get("steady_block_s"),
             telemetry=tel, analytics=ana,
+            serve=_serve_ratio(doc),
         )
         return row
 
-    if "value" in doc or "variants" in doc:       # headline doc
+    # headline docs, plus serve-only artifacts (bench.py --serve writes
+    # no throughput value — the coalescing ratio IS the headline)
+    if "value" in doc or "variants" in doc or "coalescing" in doc:
         rep = doc.get("run_report")
         tel, ana = _levels(rep.get("config")
                            if isinstance(rep, dict) else None)
@@ -140,6 +162,7 @@ def normalize(path: str) -> dict:
             compile_s=_compile_from_headline(doc),
             steady_block_s=_steady_from_headline(doc),
             telemetry=tel, analytics=ana,
+            serve=_serve_ratio(doc),
         )
         return row
 
@@ -184,15 +207,18 @@ def annotate_overhead(rows: list) -> None:
 
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
-            "steady_block_s", "tel", "analytics", "ovh%", "note")
+            "steady_block_s", "tel", "analytics", "ovh%", "serve",
+            "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
+        srv = r.get("serve")
         table.append((
             r["name"], r["platform"] or "-", _fmt(r["value"]),
             _fmt(r["compile_s"]), _fmt(r["steady_block_s"]),
             r.get("telemetry") or "-", r.get("analytics") or "-",
             "-" if ovh is None else f"{ovh:+.1f}",
+            "-" if srv is None else f"{srv:.2f}x",
             r.get("note", ""),
         ))
     widths = [max(len(str(line[i])) for line in table)

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the ``serving`` section of run reports.
+
+Accepts any mix of the shapes the repo's tooling writes (same intake as
+``fleet_report.py``):
+
+* a bare RunReport JSON (``kind == "tmhpvsim_tpu.run_report"``);
+* a bench doc — one JSON object with an embedded ``run_report`` key
+  (``bench.py --serve`` stdout lines / BENCH_*.json);
+* a JSONL stream of either (bench batteries append one doc per phase).
+
+For every embedded report carrying a ``serving`` section (schema v6,
+obs/report.py ``serving_section``), the section is checked against the
+shape that function emits — required counters, occupancy consistency,
+latency-quantile ordering, conservation between requests and outcomes —
+and printed as a readable SLO table with the request-coalescing ratio
+(requests per fused dispatch) the micro-batcher exists to maximise.
+
+Exit code 0 when every *present* serving section validates — reports
+without one (non-serving runs, pre-v6 documents) are fine and just
+noted, which is how ``run_tpu_round5b.sh`` consumes this non-fatally
+after each bench doc.  Nonzero means a malformed section: the serving
+path wrote something ``serving_section`` never emits.
+
+No third-party imports: runs anywhere the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+_NUM = (int, float)
+
+#: the counters serving_section always emits (ints, >= 0)
+_COUNTER_KEYS = ("requests", "replies", "rejected", "timeouts",
+                 "batches", "in_flight")
+
+#: the latency sub-documents (_latency_doc shape, or null when the
+#: histogram never observed)
+_LATENCY_KEYS = ("queue_wait", "dispatch", "reply_latency")
+
+
+def _check(cond: bool, errors: list, msg: str) -> None:
+    if not cond:
+        errors.append(msg)
+
+
+def _validate_latency(doc, name: str, errors: list) -> None:
+    if doc is None:
+        return
+    if not isinstance(doc, dict):
+        errors.append(f"{name} neither object nor null")
+        return
+    for key in ("count", "mean_s", "min_s", "max_s",
+                "p50_s", "p90_s", "p99_s"):
+        _check(isinstance(doc.get(key), _NUM), errors,
+               f"{name}.{key} missing/non-numeric")
+    if all(isinstance(doc.get(k), _NUM) for k in
+           ("min_s", "max_s", "p50_s", "p90_s", "p99_s")):
+        _check(doc["min_s"] <= doc["max_s"], errors,
+               f"{name}: min_s > max_s")
+        q = [doc["p50_s"], doc["p90_s"], doc["p99_s"]]
+        _check(q == sorted(q), errors,
+               f"{name}: quantiles not non-decreasing: {q}")
+        _check(all(v >= 0 for v in q + [doc["min_s"]]), errors,
+               f"{name}: negative latency")
+
+
+def validate_serving(sec) -> list:
+    """Schema errors for one ``serving`` section (empty list = valid)."""
+    errors: list = []
+    if not isinstance(sec, dict):
+        return [f"serving section is {type(sec).__name__}, not an object"]
+    for key in _COUNTER_KEYS:
+        v = sec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errors.append(f"{key} missing/not an int")
+        elif v < 0:
+            errors.append(f"{key} negative: {v}")
+    if errors:
+        return errors
+    # outcomes never exceed intake (in-flight work may make it a strict
+    # inequality on a live snapshot)
+    _check(sec["replies"] + sec["rejected"] <= sec["requests"], errors,
+           f"replies+rejected ({sec['replies']}+{sec['rejected']}) "
+           f"exceed requests ({sec['requests']})")
+
+    occ = sec.get("occupancy")
+    if occ is not None:
+        if not isinstance(occ, dict):
+            errors.append("occupancy neither object nor null")
+        else:
+            for key in ("batches", "mean", "max", "p50"):
+                _check(isinstance(occ.get(key), _NUM), errors,
+                       f"occupancy.{key} missing/non-numeric")
+            if isinstance(occ.get("batches"), int):
+                _check(occ["batches"] == sec["batches"], errors,
+                       f"occupancy.batches ({occ['batches']}) != batches "
+                       f"counter ({sec['batches']})")
+            if all(isinstance(occ.get(k), _NUM) for k in ("mean", "max")):
+                _check(1.0 <= occ["mean"] <= occ["max"], errors,
+                       f"occupancy mean {occ['mean']} outside "
+                       f"[1, max={occ['max']}]")
+    for name in _LATENCY_KEYS:
+        _validate_latency(sec.get(name), name, errors)
+    return errors
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{1e3 * v:,.1f}ms"
+
+
+def _lat_line(doc) -> str:
+    if not doc or not doc.get("count"):
+        return "(no observations)"
+    return (f"p50={_fmt_ms(doc.get('p50_s'))} "
+            f"p90={_fmt_ms(doc.get('p90_s'))} "
+            f"p99={_fmt_ms(doc.get('p99_s'))} "
+            f"max={_fmt_ms(doc.get('max_s'))}  (n={doc['count']:,})")
+
+
+def print_serving(sec: dict, label: str) -> None:
+    print(f"{label}: scenario serving "
+          f"(requests={sec['requests']:,} replies={sec['replies']:,} "
+          f"rejected={sec['rejected']:,} timeouts={sec['timeouts']:,} "
+          f"in-flight={sec['in_flight']:,})")
+    occ = sec.get("occupancy")
+    if occ:
+        ratio = sec["requests"] / sec["batches"] if sec["batches"] else 0.0
+        print(f"  batches     {sec['batches']:,}  occupancy "
+              f"mean={occ['mean']:.2f} p50={occ['p50']:.2f} "
+              f"max={occ['max']:g}  (coalescing {ratio:.2f}x)")
+    else:
+        print(f"  batches     {sec['batches']:,}  (no occupancy samples)")
+    print(f"  queue wait  {_lat_line(sec.get('queue_wait'))}")
+    print(f"  dispatch    {_lat_line(sec.get('dispatch'))}")
+    print(f"  reply       {_lat_line(sec.get('reply_latency'))}")
+
+
+def _iter_docs(path: str):
+    """Parsed JSON documents in ``path``: one whole-file document, or
+    one per line (bench batteries write JSONL)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            yield json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+
+
+def _extract_reports(doc):
+    """(label_suffix, report_dict) pairs embedded in one parsed doc."""
+    if not isinstance(doc, dict):
+        return
+    if doc.get("kind") == REPORT_KIND:
+        yield "", doc
+        return
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and rep.get("kind") == REPORT_KIND:
+        label = doc.get("phase") or doc.get("variant") or rep.get("app")
+        yield f"[{label}]" if label else "", rep
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate (and print) every serving section in one file; True when
+    all present sections pass.  A file with none passes trivially."""
+    name = os.path.basename(path)
+    try:
+        docs = list(_iter_docs(path))
+    except OSError as e:
+        print(f"{name}: UNREADABLE ({e})", file=sys.stderr)
+        return False
+    found = 0
+    ok = True
+    for doc in docs:
+        for suffix, rep in _extract_reports(doc):
+            sec = rep.get("serving")
+            if sec is None:
+                continue
+            found += 1
+            errors = validate_serving(sec)
+            if errors:
+                ok = False
+                print(f"{name}{suffix}: INVALID serving section "
+                      f"({len(errors)} error(s))", file=sys.stderr)
+                for e in errors[:10]:
+                    print(f"  {e}", file=sys.stderr)
+                if len(errors) > 10:
+                    print(f"  ... and {len(errors) - 10} more",
+                          file=sys.stderr)
+            elif not quiet:
+                print_serving(sec, f"{name}{suffix}")
+    if not found and not quiet:
+        print(f"{name}: no serving section (not a serving run or "
+              f"pre-v6 report)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print RunReport scenario-serving "
+                    "sections (bare reports, bench docs, or JSONL of "
+                    "either)")
+    ap.add_argument("files", nargs="+", help="report/bench files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the tables (errors still print)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
