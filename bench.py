@@ -1513,9 +1513,14 @@ def serve_bench(clients: int, requests_per_client: int) -> None:
     platform, fallback = _probe_or_fallback()
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.metrics import quantile_from_snapshot
-    from tmhpvsim_tpu.obs.report import serving_section
+    from tmhpvsim_tpu.obs.report import resilience_section, serving_section
+    from tmhpvsim_tpu.runtime import faults
     from tmhpvsim_tpu.serve.server import (ScenarioClient, ScenarioServer,
                                            ServeConfig)
+
+    # honour $TMHPVSIM_CHAOS so the load generator doubles as a chaos
+    # soak driver; no spec = injection compiled out of the hot path
+    faults.install_from_env()
 
     if platform == "tpu":
         n_chains, block_s, n_blocks, unroll = 16384, 1080, 2, 8
@@ -1566,8 +1571,10 @@ def serve_bench(clients: int, requests_per_client: int) -> None:
 
     with obs_metrics.use_registry(reg):
         wall = asyncio.run(run())
+    faults.deactivate()
     snap = reg.snapshot()
     serving = serving_section(snap) or {}
+    resilience = resilience_section(snap)
     occ = serving.get("occupancy") or {}
     lat = snap.get("histograms", {}).get("serve.reply_latency_s")
     total = clients * requests_per_client
@@ -1593,6 +1600,10 @@ def serve_bench(clients: int, requests_per_client: int) -> None:
         if lat and lat.get("count") else None,
         "replies_per_s": round(counts["ok"] / wall, 1) if wall else None,
         "wall_s": round(wall, 2),
+        # non-null only under $TMHPVSIM_CHAOS / injected recoveries —
+        # the v7 'resilience' report section's headline numbers
+        "faults_injected": (resilience or {}).get("faults_injected"),
+        "retries": (resilience or {}).get("retries"),
         "echo": {"n_chains": n_chains, "block_s": block_s,
                  "window_ms": cfg.window_s * 1e3,
                  "max_batch": cfg.max_batch, "scan_unroll": unroll},

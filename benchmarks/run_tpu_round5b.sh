@@ -210,5 +210,15 @@ for bench_doc in benchmarks/SERVE_*.json benchmarks/BENCH_*.json; do
   python tools/serve_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- serve_report: MALFORMED SERVING SECTION $bench_doc rc=$?" >> "$LOG"
 done
+# resilience sanity (non-fatal), same contract as serve_report: any doc
+# carrying a RunReport 'resilience' section (schema v7 — recovery
+# outcomes, breaker stats, injected-fault counts) must carry a
+# WELL-FORMED one; chaos-free docs just note the absence
+for bench_doc in benchmarks/SERVE_*.json benchmarks/BENCH_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- resilience_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/resilience_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- resilience_report: MALFORMED RESILIENCE SECTION $bench_doc rc=$?" >> "$LOG"
+done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

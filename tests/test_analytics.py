@@ -596,17 +596,17 @@ class TestSlab:
 
 #: report sections by the schema version that introduced them
 _SECTION_SINCE = {"telemetry": 2, "streaming": 3, "executor": 4,
-                  "fleet": 5, "serving": 6}
+                  "fleet": 5, "serving": 6, "resilience": 7}
 
 
 class TestReportSchema:
     def test_v5_round_trips_through_validator(self):
         doc = _risk_doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 6
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 7
         assert doc["fleet"]["level"] == "risk"
         validate_report(json.loads(json.dumps(doc)))
 
-    @pytest.mark.parametrize("version", [1, 2, 3, 4, 5])
+    @pytest.mark.parametrize("version", [1, 2, 3, 4, 5, 6])
     def test_older_documents_still_validate(self, version):
         doc = _risk_doc()
         doc["schema_version"] = version

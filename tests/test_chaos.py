@@ -1,0 +1,401 @@
+"""Chaos soak tests: the fault-injection, resilience-policy, and
+warm-recovery layers working together against real transports.
+
+* the serve stack under injected publish failures, a TCP partition, and
+  a circuit-breaker trip — every accepted request answered exactly once
+  or typed-rejected, zero duplicated replies;
+* SIGKILL mid-run under ``--supervise``: the restarted child resumes
+  from the block checkpoint with zero fresh compiles and produces a
+  byte-identical CSV;
+* reconnect-and-resubscribe across all three broker transports.
+"""
+
+import asyncio
+import collections
+import contextlib
+import json
+import logging
+import pathlib
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from test_amqp import fake_aio_pika  # noqa: F401
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, validate_report
+from tmhpvsim_tpu.runtime import faults
+from tmhpvsim_tpu.runtime.broker import make_transport
+from tmhpvsim_tpu.runtime.faults import FaultPlan
+from tmhpvsim_tpu.runtime.resilience import (
+    CircuitBreaker,
+    ResiliencePolicy,
+    reconnect_policy,
+)
+from tmhpvsim_tpu.runtime.tcpbroker import TcpFanoutBroker
+from tmhpvsim_tpu.serve.batcher import MicroBatcher
+from tmhpvsim_tpu.serve.schema import RequestError
+from tmhpvsim_tpu.serve.server import (
+    ScenarioClient,
+    ScenarioServer,
+    ServeConfig,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+RESILIENCE_REPORT = REPO / "tools" / "resilience_report.py"
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def scfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=120,
+        n_chains=4,
+        seed=7,
+        block_s=60,
+        dtype="float32",
+        output="reduce",
+        block_impl="scan",
+        scan_unroll=1,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# serve soak: publish faults + a TCP partition + a breaker trip in one run
+# ---------------------------------------------------------------------------
+
+
+class TestServeSoak:
+    def test_accepted_requests_answered_exactly_once(self):
+        """End-to-end over tcp://.  The plan injects two publish
+        failures (absorbed by bounded retries), two dispatch failures
+        (typed ``internal`` + breaker trip at threshold 2), and one
+        mid-run partition (reconnect-and-resubscribe; at-least-once
+        client retries, server replay cache dedupes).  Exactly-once:
+        no id ever gets two ok replies."""
+        reg = MetricsRegistry()
+        plan = FaultPlan.parse(
+            "broker.publish=raise@n6x2"
+            ";tcp.partition=raise@n25"
+            ";serve.dispatch=raise@n2x2")
+        outcomes = {}
+        ok_seen = collections.Counter()
+
+        async def ask(client, rid, timeout=10.0):
+            for _ in range(5):
+                try:
+                    return await client.request(rid=rid, timeout=timeout)
+                except asyncio.TimeoutError:
+                    continue  # at-least-once: same rid, server dedupes
+            raise AssertionError(f"no reply for {rid}")
+
+        async def monitor(url, reply_to):
+            async def run():
+                async with make_transport(url, reply_to) as tx:
+                    async for _t, _v, meta in tx.subscribe(with_meta=True):
+                        if isinstance(meta, dict) and meta.get("ok"):
+                            ok_seen[meta.get("id")] += 1
+
+            await reconnect_policy(
+                name="soak.monitor", base_delay_s=0.01,
+                max_delay_s=0.05, registry=reg).call(run)
+
+        async def main():
+            async with TcpFanoutBroker(port=0) as broker:
+                url = f"tcp://127.0.0.1:{broker.port}"
+                cfg = ServeConfig(
+                    sim=scfg(), url=url, window_s=0.05,
+                    batch_sizes=(1, 4, 8), timeout_s=30.0,
+                    recent_ids_cap=8, breaker_threshold=2,
+                    breaker_reset_s=1.5)
+                server = ScenarioServer(cfg, registry=reg)
+                await server.start()
+                client = ScenarioClient(url, policy=ResiliencePolicy(
+                    attempts=8, base_delay_s=0.01, max_delay_s=0.05,
+                    name="soak.request", registry=reg))
+                async with client:
+                    mon = asyncio.create_task(
+                        monitor(url, client.reply_to))
+                    await asyncio.sleep(0.1)
+                    try:
+                        with faults.active(plan):
+                            for rid in ("w1-0", "w2-0", "w3-0", "w4-0"):
+                                outcomes[rid] = await ask(client, rid)
+                            await asyncio.sleep(cfg.breaker_reset_s + 0.3)
+                            w5 = await asyncio.gather(*[
+                                ask(client, f"w5-{i}") for i in range(6)])
+                            for i, meta in enumerate(w5):
+                                outcomes[f"w5-{i}"] = meta
+                        # snapshot before the replay probes below add
+                        # fresh (legitimate) completions
+                        snapshot = dict(ok_seen)
+                        # chaos off: bounded-replay satellites.  w5-5 is
+                        # still in the LRU -> typed duplicate; w1-0 was
+                        # evicted (10 completions vs cap 8) -> fresh run
+                        dup = await ask(client, "w5-5")
+                        fresh = await ask(client, "w1-0")
+                    finally:
+                        mon.cancel()
+                        with contextlib.suppress(asyncio.CancelledError,
+                                                 ConnectionError):
+                            await mon
+                await server.stop()
+                return snapshot, dup, fresh
+
+        with use_registry(reg):
+            snapshot, dup, fresh = _run(
+                asyncio.wait_for(main(), timeout=240))
+
+        # deterministic pre-partition outcomes
+        assert outcomes["w1-0"]["ok"] is True
+        assert outcomes["w2-0"]["error"]["code"] == "internal"
+        assert outcomes["w3-0"]["error"]["code"] == "internal"
+        assert outcomes["w4-0"]["error"]["code"] == "unavailable"
+        # the partition window may turn any ok into a typed duplicate
+        # (reply lost in the gap, client re-asked, server deduped) —
+        # never into a recompute
+        for i in range(6):
+            meta = outcomes[f"w5-{i}"]
+            assert meta["ok"] or \
+                meta["error"]["code"] == "duplicate", meta
+        # exactly-once: zero duplicated ok replies across the whole soak
+        assert all(n <= 1 for n in snapshot.values()), snapshot
+        assert dup["error"]["code"] == "duplicate"
+        assert fresh["ok"] is True
+
+        snap = reg.snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        assert c["faults.injected.serve.dispatch"] == 2.0
+        assert c["faults.injected.broker.publish"] == 2.0
+        assert c["faults.injected.tcp.partition"] == 1.0
+        assert c["faults.injected_total"] == 5.0
+        assert c["resilience.breaker_open_total.serve.dispatch"] == 1.0
+        assert c["resilience.breaker_rejected_total.serve.dispatch"] >= 1.0
+        assert c["serve.replay_evictions_total"] >= 2.0
+        assert c["resilience.retries_total"] >= 2.0
+        assert g["resilience.breaker_state.serve.dispatch"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-run: --supervise restarts warm, output byte-identical
+# ---------------------------------------------------------------------------
+
+
+def _env():
+    import os
+
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    for k in ("XLA_FLAGS", "TMHPVSIM_CHAOS", "TMHPVSIM_CHAOS_SEED"):
+        env.pop(k, None)
+    return env
+
+
+class TestSigkillWarmRecovery:
+    def test_supervised_restart_resumes_bit_identical(self, tmp_path):
+        """A chaos-injected SIGKILL right after block 1's checkpoint
+        commit; the supervisor restarts the child, which resumes from
+        the checkpoint with zero cold compiles and completes a CSV
+        byte-identical to an uninterrupted run."""
+        pvsim = [sys.executable, "-m", "tmhpvsim_tpu.cli", "pvsim"]
+        flags = ["--backend=jax", "--no-realtime", "--duration", "360",
+                 "--seed", "9", "--start", "2019-09-05 10:00:00",
+                 "--block-s", "120"]
+        whole = tmp_path / "whole.csv"
+        ref = subprocess.run([*pvsim, str(whole), *flags], env=_env(),
+                             cwd=REPO, capture_output=True, text=True,
+                             timeout=300)
+        assert ref.returncode == 0, ref.stderr
+
+        part = tmp_path / "part.csv"
+        ck = tmp_path / "ck.npz"
+        report = tmp_path / "report.json"
+        sup = subprocess.run(
+            [*pvsim, str(part), *flags,
+             "--checkpoint", str(ck), "--supervise", "2",
+             "--run-report", str(report),
+             "--chaos", "checkpoint.committed=kill@n2"],
+            env=_env(), cwd=REPO, capture_output=True, text=True,
+            timeout=300)
+        assert sup.returncode == 0, sup.stderr
+        assert "warm restart 1/2" in sup.stderr
+
+        assert part.read_bytes() == whole.read_bytes()
+
+        doc = validate_report(json.loads(report.read_text()))
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 7
+        res = doc["resilience"]
+        assert res["resumes"] == 1
+        assert res["restarts"] == 1
+        assert res["resumed_block"] == 2
+        # zero fresh compiles on the warm restart: everything the
+        # resumed child runs deserializes from the persistent cache
+        assert doc["executor"]["compile_cold"] == 0
+
+        tool = subprocess.run(
+            [sys.executable, str(RESILIENCE_REPORT), str(report)],
+            capture_output=True, text=True, timeout=60)
+        assert tool.returncode == 0, tool.stdout + tool.stderr
+        assert "resumes=1 from block 2" in tool.stdout
+
+
+# ---------------------------------------------------------------------------
+# reconnect-and-resubscribe across all three transports
+# ---------------------------------------------------------------------------
+
+
+async def _stream_with_reconnect(url, spec, reg, n=24):
+    """Publish ``n`` seq-stamped messages while ``spec`` kills the
+    subscription once mid-stream; the consumer reconnects under the
+    stack's standard policy.  Returns the seqs it saw."""
+    seen = []
+    done = asyncio.Event()
+
+    async def consume_once():
+        async with make_transport(url, "meter") as tx:
+            async for _t, _v, meta in tx.subscribe(with_meta=True):
+                seen.append(int(meta["seq"]))
+                if meta["seq"] >= n - 1:
+                    done.set()
+                    return
+
+    with faults.active(FaultPlan.parse(spec)):
+        consumer = asyncio.create_task(reconnect_policy(
+            name="chaos.consume", base_delay_s=0.01, max_delay_s=0.05,
+            registry=reg).call(consume_once))
+        await asyncio.sleep(0.05)
+        async with make_transport(url, "meter") as pub:
+            import datetime as dt
+
+            for i in range(n):
+                await pub.publish(float(i), dt.datetime(2019, 9, 5),
+                                  meta={"seq": i})
+                await asyncio.sleep(0.03)
+        await asyncio.wait_for(done.wait(), 30)
+        consumer.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await consumer
+    return seen
+
+
+def _assert_reconnected(seen, reg, point, n=24):
+    # strictly monotonic: no replays, no reordering across the gap
+    assert seen == sorted(set(seen))
+    assert seen[-1] == n - 1
+    assert len(seen) >= n - 6  # the gap loses at most a few messages
+    c = reg.snapshot()["counters"]
+    assert c[f"faults.injected.{point}"] == 1.0
+    assert c["retry.attempts.chaos.consume"] >= 1.0
+
+
+class TestReconnectResubscribe:
+    def test_tcp_partition_reconnects(self):
+        reg = MetricsRegistry()
+
+        async def main():
+            async with TcpFanoutBroker(port=0) as broker:
+                url = f"tcp://127.0.0.1:{broker.port}"
+                return await _stream_with_reconnect(
+                    url, "tcp.partition=raise@n3", reg)
+
+        with use_registry(reg):
+            seen = _run(main())
+        _assert_reconnected(seen, reg, "tcp.partition")
+
+    def test_local_deliver_fault_reconnects(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            seen = _run(_stream_with_reconnect(
+                "local://chaos-reconnect", "broker.deliver=raise@n3",
+                reg))
+        _assert_reconnected(seen, reg, "broker.deliver")
+
+    def test_amqp_deliver_fault_reconnects(self, fake_aio_pika):  # noqa: F811
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            seen = _run(_stream_with_reconnect(
+                "amqp://localhost", "broker.deliver=raise@n3", reg))
+        _assert_reconnected(seen, reg, "broker.deliver")
+
+
+# ---------------------------------------------------------------------------
+# batcher satellites: breaker shedding + the drain deadline
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestBatcherResilience:
+    def test_breaker_open_sheds_then_probe_recloses(self):
+        async def main():
+            reg = MetricsRegistry()
+            clk = _Clock()
+            br = CircuitBreaker("serve.dispatch", failure_threshold=1,
+                                reset_s=30.0, registry=reg, now=clk)
+            b = MicroBatcher(lambda reqs: list(reqs), window_s=0.005,
+                             max_batch=2, registry=reg, breaker=br)
+            b.start()
+            br.record_failure()  # open
+            with pytest.raises(RequestError) as ei:
+                b.submit("x")
+            assert ei.value.code == "unavailable"
+            clk.t = 30.0  # half-open: the next batch is the probe
+            result, info = await b.submit("y")
+            assert result == "y" and info["batch"] == 1
+            assert br.state == "closed"
+            await b.stop(drain=True)
+            c = reg.snapshot()["counters"]
+            assert c["resilience.breaker_rejected_total.serve.dispatch"] \
+                == 1.0
+
+        _run(main())
+
+    def test_drain_deadline_force_closes_with_typed_draining(self, caplog):
+        release = threading.Event()
+
+        async def main():
+            reg = MetricsRegistry()
+
+            def dispatch(reqs):
+                release.wait(5.0)
+                return [None] * len(reqs)
+
+            b = MicroBatcher(dispatch, window_s=0.001, max_batch=1,
+                             registry=reg)
+            b.start()
+            b.submit("a")  # occupies the worker thread
+            f2, f3 = b.submit("b"), b.submit("c")
+            await asyncio.sleep(0.05)
+            with caplog.at_level(logging.WARNING,
+                                 logger="tmhpvsim_tpu.serve.batcher"):
+                await b.stop(drain=True, timeout=0.2)
+            release.set()
+            for f in (f2, f3):
+                with pytest.raises(RequestError) as ei:
+                    await f
+                assert ei.value.code == "draining"
+                assert "drain deadline (0.2 s) exceeded" in str(ei.value)
+            assert any("force-closing" in r.getMessage()
+                       for r in caplog.records)
+
+        _run(main())

@@ -82,7 +82,8 @@ class TestWarmCache:
         assert sim._k_dispatch == 2
         s1 = reg1.snapshot()["counters"]
         n_targets = len(sim.aot_targets())
-        assert n_targets == 2  # scan_acc + the k=2 mega jit
+        # scan_acc + the k=2 mega jit + the state/acc resume copies
+        assert n_targets == 4
         assert s1.get("executor.aot_warmup_total", 0) == n_targets
         assert s1.get("executor.aot_warmup_errors_total", 0) == 0
         cache_files = [f for _, _, fns in os.walk(str(tmp_path)) for f in fns]
@@ -92,11 +93,16 @@ class TestWarmCache:
         with use_registry(reg2):
             Simulation(c)
         s2 = reg2.snapshot()["counters"]
-        assert s2.get("executor.compile_warm_total", 0) == n_targets
+        # the per-instance jits must deserialise from the persistent
+        # cache; the module-level resume copies are shared with build 1
+        # and may be served from jax's in-process executable cache
+        # without any cache event — either way nothing compiles cold
+        warm = int(s2.get("executor.compile_warm_total", 0))
+        assert warm >= 2
         assert s2.get("executor.compile_cold_total", 0) == 0
 
         doc = compilecache.executor_doc(reg2)
-        assert doc["compile_warm"] == n_targets
+        assert doc["compile_warm"] == warm
         assert doc["compile_cold"] == 0
         assert doc["aot_warmup"] == n_targets
         assert doc["cache_dir"] == d
@@ -319,7 +325,7 @@ class TestReportSchemaV4:
 
     def test_v4_round_trips_with_executor_section(self):
         doc = self._doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 6
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 7
         ex = doc["executor"]
         assert ex["blocks_per_dispatch"] == 2
         assert ex["dispatches"] == 2  # 3 blocks, k=2: mega [0,1] + block 2

@@ -1,0 +1,302 @@
+"""Deterministic fault injection (runtime/faults.py): the --chaos spec
+grammar, trigger schedules (nK windows, everyK caps, seeded pFLOAT),
+first-fire-wins arbitration, the fire/afire chokepoint contract, the
+``faults.*`` metrics every injection records, and chokepoint behaviour
+inside the local transport and the checkpoint writer.
+"""
+
+import asyncio
+import datetime as dt
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.engine import checkpoint as ckpt
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.runtime import faults
+from tmhpvsim_tpu.runtime.broker import make_transport
+from tmhpvsim_tpu.runtime.faults import FaultInjected, FaultPlan
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def plan(spec, seed=0):
+    return FaultPlan.parse(spec, seed=seed)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    """A test failing inside faults.active() must not leak its plan
+    into the rest of the suite."""
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+
+class TestSpecGrammar:
+    def test_minimal_rule_parses(self):
+        p = plan("broker.publish=raise@n3")
+        assert p.describe() == "broker.publish=raise@n3"
+        r = p.rules[0]
+        assert (r.point, r.action, r.trigger, r.k) == \
+            ("broker.publish", "raise", "n", 3)
+
+    def test_multi_rule_whitespace_and_args(self):
+        p = plan(" broker.publish=drop@n1 ;"
+                 " funnel.stall=delay:0.5@every100 ; ")
+        assert [r.point for r in p.rules] == \
+            ["broker.publish", "funnel.stall"]
+        assert p.rules[1].action == "delay"
+        assert p.rules[1].arg == 0.5
+        assert p.rules[1].count is None
+
+    def test_empty_spec_rejected(self):
+        with pytest.raises(ValueError, match="chaos spec is empty"):
+            plan("")
+        with pytest.raises(ValueError, match="chaos spec is empty"):
+            plan(" ; ")
+
+    @pytest.mark.parametrize("spec,match", [
+        ("broker.publish", "expected POINT=ACTION@TRIGGER"),
+        ("volcano.erupt=raise@n1", "unknown point"),
+        ("broker.publish=explode@n1", "unknown action"),
+        ("funnel.stall=delay@n1", "delay needs seconds"),
+        ("broker.publish=raise:7@n1", "takes no argument"),
+        ("broker.publish=raise@n1xzap", "not an"),
+        ("broker.publish=raise@n1x0", "count must be >= 1"),
+        ("broker.publish=raise@soon", "bad trigger"),
+        ("broker.publish=raise@n0", "trigger index must be >= 1"),
+        ("broker.publish=raise@p1.5", "probability outside"),
+    ])
+    def test_parse_errors_are_specific(self, spec, match):
+        with pytest.raises(ValueError, match=match):
+            plan(spec)
+
+
+# ---------------------------------------------------------------------------
+# trigger schedules (decide() without any I/O)
+# ---------------------------------------------------------------------------
+
+
+def decisions(p, point, n):
+    out = []
+    for _ in range(n):
+        hit = p.decide(point)
+        out.append(None if hit is None else hit.action)
+    return out
+
+
+class TestTriggers:
+    def test_n_trigger_fires_once(self):
+        p = plan("broker.publish=drop@n2")
+        assert decisions(p, "broker.publish", 4) == \
+            [None, "drop", None, None]
+
+    def test_n_trigger_with_window(self):
+        p = plan("broker.publish=drop@n2x2")
+        assert decisions(p, "broker.publish", 5) == \
+            [None, "drop", "drop", None, None]
+
+    def test_every_trigger_with_cap(self):
+        p = plan("broker.publish=drop@every2x2")
+        assert decisions(p, "broker.publish", 8) == \
+            [None, "drop", None, "drop", None, None, None, None]
+
+    def test_probability_edges_and_cap(self):
+        never = plan("broker.deliver=drop@p0")
+        assert decisions(never, "broker.deliver", 10) == [None] * 10
+        always = plan("broker.deliver=drop@p1x3")
+        assert decisions(always, "broker.deliver", 5) == \
+            ["drop", "drop", "drop", None, None]
+
+    def test_probability_is_seed_deterministic(self):
+        spec = "broker.deliver=drop@p0.5"
+        a = decisions(plan(spec, seed=7), "broker.deliver", 40)
+        b = decisions(plan(spec, seed=7), "broker.deliver", 40)
+        assert a == b
+
+    def test_points_count_independently(self):
+        p = plan("broker.publish=drop@n2;broker.deliver=dup@n1")
+        assert p.decide("broker.deliver").action == "dup"
+        assert p.decide("broker.publish") is None
+        assert p.decide("broker.publish").action == "drop"
+
+    def test_first_firing_rule_wins_and_all_rules_count(self):
+        p = plan("broker.publish=drop@n1;broker.publish=dup@n2")
+        # call 1: rule 1 fires and wins; rule 2 counted the call too, so
+        # its n2 lands on the NEXT publish
+        assert decisions(p, "broker.publish", 3) == ["drop", "dup", None]
+        q = plan("broker.publish=drop@n1;broker.publish=dup@n1")
+        # both scheduled on call 1: the loser's slot is consumed
+        assert decisions(q, "broker.publish", 2) == ["drop", None]
+
+
+# ---------------------------------------------------------------------------
+# fire/afire: actions, metrics, activation plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestFire:
+    def test_inactive_is_a_noop(self):
+        assert faults.ACTIVE is None
+        assert faults.fire("broker.publish") is None
+        assert _run(faults.afire("broker.publish")) is None
+
+    def test_raise_records_metrics(self):
+        reg = MetricsRegistry()
+        with use_registry(reg), \
+                faults.active(plan("checkpoint.write=raise@n1")):
+            with pytest.raises(FaultInjected, match="checkpoint.write"):
+                faults.fire("checkpoint.write")
+            assert faults.fire("checkpoint.write") is None
+        c = reg.snapshot()["counters"]
+        assert c["faults.injected_total"] == 1.0
+        assert c["faults.injected.checkpoint.write"] == 1.0
+
+    def test_drop_and_dup_are_returned_to_the_chokepoint(self):
+        with use_registry(MetricsRegistry()), faults.active(
+                plan("broker.publish=drop@n1;broker.publish=dup@n2")):
+            assert faults.fire("broker.publish") == "drop"
+            assert faults.fire("broker.publish") == "dup"
+            assert faults.fire("broker.publish") is None
+
+    def test_afire_delay_sleeps_then_returns_none(self):
+        async def main():
+            with use_registry(MetricsRegistry()), \
+                    faults.active(plan("funnel.stall=delay:0.02@n1")):
+                t0 = time.monotonic()
+                assert await faults.afire("funnel.stall") is None
+                assert time.monotonic() - t0 >= 0.015
+        _run(main())
+
+    def test_active_context_restores_none(self):
+        p = plan("broker.publish=drop@n1")
+        with faults.active(p):
+            assert faults.ACTIVE is p
+        assert faults.ACTIVE is None
+
+    def test_install_from_env(self):
+        try:
+            p = faults.install_from_env({
+                faults.ENV_SPEC: "broker.connect=raise@n1",
+                faults.ENV_SEED: "5",
+            })
+            assert faults.ACTIVE is p
+            assert p.seed == 5
+            assert p.rules[0].point == "broker.connect"
+        finally:
+            faults.deactivate()
+        assert faults.install_from_env({}) is None
+        assert faults.ACTIVE is None
+
+
+# ---------------------------------------------------------------------------
+# chokepoints in the local transport
+# ---------------------------------------------------------------------------
+
+
+class TestTransportChokepoints:
+    def _pubsub(self, url, spec_pub=None, spec_sub=None):
+        """Publish [1, 2, 3] and return what a subscriber saw, with an
+        optional plan active around the publishes or the consumption."""
+
+        async def main():
+            got = []
+            sub_tx = make_transport(url, "m")
+            async with sub_tx:
+                async def consume():
+                    async for _t, v in sub_tx.subscribe():
+                        got.append(v)
+
+                task = asyncio.create_task(consume())
+                await asyncio.sleep(0.05)
+                async with make_transport(url, "m") as pub:
+                    if spec_pub:
+                        with faults.active(plan(spec_pub)):
+                            for v in (1.0, 2.0, 3.0):
+                                await pub.publish(v, dt.datetime(2019, 9, 5))
+                    elif spec_sub:
+                        with faults.active(plan(spec_sub)):
+                            for v in (1.0, 2.0, 3.0):
+                                await pub.publish(v, dt.datetime(2019, 9, 5))
+                            await asyncio.sleep(0.1)
+                    else:
+                        for v in (1.0, 2.0, 3.0):
+                            await pub.publish(v, dt.datetime(2019, 9, 5))
+                await asyncio.sleep(0.1)
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            return got
+
+        return _run(main())
+
+    def test_publish_drop_suppresses_and_dup_doubles(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            got = self._pubsub(
+                "local://faults-pub",
+                spec_pub="broker.publish=drop@n1;broker.publish=dup@n2")
+        assert got == [2.0, 2.0, 3.0]
+        c = reg.snapshot()["counters"]
+        assert c["faults.injected.broker.publish"] == 2.0
+
+    def test_deliver_drop_suppresses_and_dup_doubles(self):
+        with use_registry(MetricsRegistry()):
+            got = self._pubsub(
+                "local://faults-sub",
+                spec_sub="broker.deliver=drop@n1;broker.deliver=dup@n2")
+        assert got == [2.0, 2.0, 3.0]
+
+    def test_connect_raise_then_recovers(self):
+        async def main():
+            with faults.active(plan("broker.connect=raise@n1")):
+                with pytest.raises(FaultInjected):
+                    async with make_transport("local://faults-conn", "m"):
+                        pass
+                async with make_transport("local://faults-conn", "m"):
+                    return True
+
+        with use_registry(MetricsRegistry()):
+            assert _run(main())
+
+
+# ---------------------------------------------------------------------------
+# checkpoint chokepoints: write before disk, committed after os.replace
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointChokepoints:
+    def test_write_fault_leaves_no_file(self, tmp_path):
+        path = str(tmp_path / "state.npz")
+        state = {"x": np.arange(3)}
+        with use_registry(MetricsRegistry()):
+            with faults.active(plan("checkpoint.write=raise@n1")):
+                with pytest.raises(FaultInjected):
+                    ckpt.save(path, state, 1)
+            assert not os.path.exists(path)
+            ckpt.save(path, state, 1)
+        assert ckpt.peek_meta(path)["next_block"] == 1
+
+    def test_committed_fault_fires_after_atomic_replace(self, tmp_path):
+        """The kill-site guarantee: a fault at ``checkpoint.committed``
+        strikes AFTER the atomic rename, so the crash the recovery tests
+        schedule there always leaves a valid checkpoint behind."""
+        path = str(tmp_path / "state.npz")
+        state = {"x": np.arange(3)}
+        with use_registry(MetricsRegistry()):
+            with faults.active(plan("checkpoint.committed=raise@n1")):
+                with pytest.raises(FaultInjected):
+                    ckpt.save(path, state, 2)
+        assert ckpt.peek_meta(path)["next_block"] == 2
+        assert not os.path.exists(path + ".tmp")

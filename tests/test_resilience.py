@@ -1,0 +1,331 @@
+"""Unified resilience policy (runtime/resilience.py): backoff shapes,
+retry/fallback/budget semantics of ResiliencePolicy.call, the half-open
+CircuitBreaker lifecycle with its ``resilience.*`` metrics, WARN
+rate-limiting, the reconnect_policy defaults every transport loop uses,
+and the deprecation shim left behind at runtime/retry.py.
+"""
+
+import asyncio
+import importlib
+import logging
+import random
+import sys
+
+import pytest
+
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.runtime.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    ResiliencePolicy,
+    WarnRateLimiter,
+    forever,
+    propagate,
+    reconnect_policy,
+)
+
+LOGGER = "tmhpvsim_tpu.runtime.resilience"
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class _Clock:
+    """Settable stand-in for time.monotonic."""
+
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Flaky:
+    """Async callable failing ``fails`` times before returning ``value``."""
+
+    def __init__(self, fails, value="ok", exc=OSError("nope")):
+        self.fails = fails
+        self.value = value
+        self.exc = exc
+        self.calls = 0
+
+    async def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fails:
+            raise self.exc
+        return self.value
+
+
+# ---------------------------------------------------------------------------
+# WarnRateLimiter
+# ---------------------------------------------------------------------------
+
+
+class TestWarnRateLimiter:
+    def test_rate_limit_and_suppressed_suffix(self, caplog):
+        lim = WarnRateLimiter(every_s=10.0)
+        log = logging.getLogger(LOGGER)
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert lim.warn(log, "boom %d", 1, now=0.0)
+            assert not lim.warn(log, "boom %d", 2, now=3.0)
+            assert not lim.warn(log, "boom %d", 3, now=6.0)
+            assert lim.suppressed == 2
+            assert lim.warn(log, "boom %d", 4, now=11.0)
+            assert lim.suppressed == 0
+        msgs = [r.getMessage() for r in caplog.records]
+        assert msgs == [
+            "boom 1",
+            "boom 4 (2 similar warnings suppressed in the last 10 s)",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# backoff shapes
+# ---------------------------------------------------------------------------
+
+
+class TestBackoff:
+    def test_exponential_without_jitter(self):
+        p = ResiliencePolicy(base_delay_s=0.5, max_delay_s=4.0,
+                             multiplier=2.0, jitter=False)
+        delays, prev = [], p.base_delay_s
+        for n in range(1, 6):
+            prev = p.backoff(n, prev)
+            delays.append(prev)
+        assert delays == [0.5, 1.0, 2.0, 4.0, 4.0]
+
+    def test_zero_base_means_no_sleep(self):
+        p = ResiliencePolicy(base_delay_s=0.0)
+        assert p.backoff(1, 0.0) == 0.0
+        assert p.backoff(9, 123.0) == 0.0
+
+    def test_decorrelated_jitter_is_bounded_and_seeded(self):
+        def delays(seed):
+            p = ResiliencePolicy(base_delay_s=0.5, max_delay_s=5.0,
+                                 rng=random.Random(seed))
+            out, prev = [], p.base_delay_s
+            for n in range(1, 20):
+                prev = p.backoff(n, prev)
+                out.append(prev)
+            return out
+
+        a, b = delays(1), delays(1)
+        assert a == b
+        assert all(0.5 <= d <= 5.0 for d in a)
+
+
+# ---------------------------------------------------------------------------
+# ResiliencePolicy.call
+# ---------------------------------------------------------------------------
+
+
+class TestPolicyCall:
+    def test_retries_then_succeeds_with_counters(self):
+        reg = MetricsRegistry()
+        fn = _Flaky(fails=2)
+        p = ResiliencePolicy(attempts=4, registry=reg, name="unit.flaky")
+        assert _run(p.call(fn)) == "ok"
+        assert fn.calls == 3
+        c = reg.snapshot()["counters"]
+        assert c["retry.attempts.unit.flaky"] == 2.0
+        assert c["resilience.retries_total"] == 2.0
+        assert "retry.exhausted.unit.flaky" not in c
+
+    def test_exhaustion_reraises_and_warns(self, caplog):
+        reg = MetricsRegistry()
+        p = ResiliencePolicy(attempts=3, registry=reg, name="unit.dead")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            with pytest.raises(OSError, match="nope"):
+                _run(p.call(_Flaky(fails=99)))
+        c = reg.snapshot()["counters"]
+        assert c["retry.exhausted.unit.dead"] == 1.0
+        assert c["resilience.giveups_total"] == 1.0
+        warn = caplog.records[-1].getMessage()
+        assert "unit.dead exhausted 3 attempt(s)" in warn
+        assert "re-raising" in warn
+
+    def test_fallback_value_callable_and_awaitable(self):
+        reg = MetricsRegistry()
+        p = ResiliencePolicy(attempts=1, registry=reg)
+        assert _run(p.call(_Flaky(fails=9), fallback=None)) is None
+        assert _run(p.call(_Flaky(fails=9), fallback=-1.0)) == -1.0
+        assert _run(p.call(_Flaky(fails=9),
+                           fallback=lambda exc: str(exc))) == "nope"
+
+        async def afb(exc):
+            return ("async", str(exc))
+
+        assert _run(p.call(_Flaky(fails=9), fallback=afb)) == \
+            ("async", "nope")
+
+    def test_cancelled_error_is_always_fatal(self):
+        reg = MetricsRegistry()
+        p = ResiliencePolicy(attempts=5, registry=reg, fallback=None)
+
+        async def cancelled():
+            raise asyncio.CancelledError
+
+        with pytest.raises(asyncio.CancelledError):
+            _run(p.call(cancelled))
+        assert reg.snapshot()["counters"] == {}
+
+    def test_zero_total_budget_gives_up_on_first_failure(self, caplog):
+        reg = MetricsRegistry()
+        p = ResiliencePolicy(attempts=10, total_timeout_s=0.0,
+                             registry=reg, name="unit.budget",
+                             fallback="shed")
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert _run(p.call(_Flaky(fails=9))) == "shed"
+        warn = caplog.records[-1].getMessage()
+        assert "unit.budget exceeded its 0.0 s retry budget" in warn
+        assert "applying fallback" in warn
+        assert reg.snapshot()["counters"]["resilience.giveups_total"] == 1.0
+
+    def test_attempt_timeout_bounds_each_try(self):
+        reg = MetricsRegistry()
+        p = ResiliencePolicy(attempts=2, attempt_timeout_s=0.02,
+                             registry=reg, name="unit.hang")
+
+        async def hang():
+            await asyncio.sleep(30)
+
+        with pytest.raises(asyncio.TimeoutError):
+            _run(p.call(hang))
+        assert reg.snapshot()["counters"]["retry.attempts.unit.hang"] == 2.0
+
+    def test_breaker_open_rejects_without_consuming_attempts(self):
+        reg = MetricsRegistry()
+        br = CircuitBreaker("unit", failure_threshold=1, registry=reg,
+                            now=_Clock())
+        p = ResiliencePolicy(attempts=5, breaker=br, registry=reg,
+                             name="unit.br")
+        with pytest.raises(BreakerOpenError, match="'unit' is open"):
+            _run(p.call(_Flaky(fails=9)))
+        c = reg.snapshot()["counters"]
+        assert c["retry.attempts.unit.br"] == 1.0
+        assert c["resilience.breaker_open_total.unit"] == 1.0
+        assert c["resilience.breaker_rejected_total.unit"] == 1.0
+
+    def test_retrying_decorator_uses_qualname(self):
+        reg = MetricsRegistry()
+        p = ResiliencePolicy(attempts=3, registry=reg)
+        flaky = _Flaky(fails=1)
+
+        @p.retrying
+        async def fetch_thing():
+            return await flaky()
+
+        assert _run(fetch_thing()) == "ok"
+        keys = reg.snapshot()["counters"]
+        assert any(k.startswith("retry.attempts.") and "fetch_thing" in k
+                   for k in keys)
+
+    def test_forever_policy_warns_rate_limited(self, caplog):
+        reg = MetricsRegistry()
+        p = ResiliencePolicy(attempts=forever, registry=reg,
+                             name="loop", warn_every_s=3600.0)
+        with caplog.at_level(logging.WARNING, logger=LOGGER):
+            assert _run(p.call(_Flaky(fails=3))) == "ok"
+        warns = [r for r in caplog.records if "loop failed" in r.getMessage()]
+        assert len(warns) == 1
+        assert "OSError: nope" in warns[0].getMessage()
+        assert reg.snapshot()["counters"]["retry.attempts.loop"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_open_half_open_probe_close(self):
+        reg = MetricsRegistry()
+        clk = _Clock()
+        br = CircuitBreaker("b", failure_threshold=2, reset_s=30.0,
+                            registry=reg, now=clk)
+        assert br.state == "closed" and br.allow()
+        br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()          # rejected while open
+        clk.t = 29.0
+        assert br.state == "open"
+        clk.t = 30.0
+        assert br.state == "half_open"
+        assert br.allow()              # the single probe
+        assert not br.allow()          # second concurrent call rejected
+        br.record_success()
+        assert br.state == "closed"
+        snap = reg.snapshot()
+        assert snap["counters"]["resilience.breaker_open_total.b"] == 1.0
+        assert snap["counters"]["resilience.breaker_rejected_total.b"] == 2.0
+        assert snap["gauges"]["resilience.breaker_state.b"] == 0.0
+
+    def test_failed_probe_reopens(self):
+        reg = MetricsRegistry()
+        clk = _Clock()
+        br = CircuitBreaker("b", failure_threshold=1, reset_s=10.0,
+                            registry=reg, now=clk)
+        br.record_failure()
+        assert br.state == "open"
+        clk.t = 10.0
+        assert br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        assert reg.snapshot()["counters"][
+            "resilience.breaker_open_total.b"] == 2.0
+
+    def test_count_rejected_preserves_probe_slot(self):
+        reg = MetricsRegistry()
+        clk = _Clock()
+        br = CircuitBreaker("b", failure_threshold=1, reset_s=5.0,
+                            registry=reg, now=clk)
+        br.record_failure()
+        clk.t = 5.0
+        assert br.state == "half_open"
+        br.count_rejected()            # shed without touching the probe
+        assert br.allow()              # probe still available
+        assert reg.snapshot()["counters"][
+            "resilience.breaker_rejected_total.b"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# reconnect_policy defaults
+# ---------------------------------------------------------------------------
+
+
+class TestReconnectPolicy:
+    def test_defaults(self):
+        p = reconnect_policy(name="loop.consume")
+        assert p.attempts is forever
+        assert p.base_delay_s == 0.5
+        assert p.max_delay_s == 5.0
+        assert p.name == "loop.consume"
+        assert p.fallback is propagate
+
+    def test_overrides_merge(self):
+        p = reconnect_policy(base_delay_s=0.01, max_delay_s=0.05,
+                             warn_every_s=1.0)
+        assert p.attempts is forever
+        assert p.base_delay_s == 0.01
+        assert p.max_delay_s == 0.05
+
+
+# ---------------------------------------------------------------------------
+# runtime/retry.py deprecation shim
+# ---------------------------------------------------------------------------
+
+
+class TestRetryShim:
+    def test_import_warns_and_reexports(self):
+        sys.modules.pop("tmhpvsim_tpu.runtime.retry", None)
+        with pytest.warns(DeprecationWarning,
+                          match="runtime.retry is deprecated"):
+            shim = importlib.import_module("tmhpvsim_tpu.runtime.retry")
+        from tmhpvsim_tpu.runtime import resilience
+
+        assert shim.asyncretry is resilience.asyncretry
+        assert shim.forever is resilience.forever
+        assert shim.propagate is resilience.propagate

@@ -166,7 +166,7 @@ class TestFanoutSemantics:
 
     def test_connection_error_raises_for_retry(self):
         """A dead broker must raise out of the transport so the apps'
-        forever-retry reconnect loop engages (runtime/retry.py)."""
+        forever-retry reconnect loop engages (runtime/resilience.py)."""
 
         async def main():
             broker = TcpFanoutBroker(port=0)

@@ -458,7 +458,7 @@ class TestFunnelObservability:
 
 class TestRetryObservability:
     def test_exhaustion_warns_and_counts_on_reraise(self, caplog):
-        from tmhpvsim_tpu.runtime.retry import asyncretry
+        from tmhpvsim_tpu.runtime.resilience import asyncretry
 
         reg = MetricsRegistry()
 
@@ -468,7 +468,7 @@ class TestRetryObservability:
 
         with use_registry(reg):
             with caplog.at_level(logging.WARNING,
-                                 logger="tmhpvsim_tpu.runtime.retry"):
+                                 logger="tmhpvsim_tpu.runtime.resilience"):
                 with pytest.raises(OSError):
                     asyncio.run(always_fails())
         qn = always_fails.__qualname__
@@ -483,7 +483,7 @@ class TestRetryObservability:
     def test_exhaustion_warns_on_silent_fallback(self, caplog):
         # the fallback path used to swallow the final failure with no log
         # at all — the WARNING is the satellite's point
-        from tmhpvsim_tpu.runtime.retry import asyncretry
+        from tmhpvsim_tpu.runtime.resilience import asyncretry
 
         @asyncretry(attempts=2, delay=0, fallback=None)
         async def fails_with_fallback():
@@ -491,7 +491,7 @@ class TestRetryObservability:
 
         with use_registry(MetricsRegistry()):
             with caplog.at_level(logging.WARNING,
-                                 logger="tmhpvsim_tpu.runtime.retry"):
+                                 logger="tmhpvsim_tpu.runtime.resilience"):
                 assert asyncio.run(fails_with_fallback()) is None
         (warn,) = [r for r in caplog.records if "exhausted" in r.message]
         assert "applying fallback" in warn.getMessage()
@@ -590,7 +590,7 @@ def test_report_schema_v1_v2_still_validate():
     schemas keep validating against the current validator."""
     from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, RunReport
 
-    assert REPORT_SCHEMA_VERSION == 6
+    assert REPORT_SCHEMA_VERSION == 7
     doc = RunReport("test").doc()
     for old in (1, 2):
         legacy = {k: v for k, v in doc.items()

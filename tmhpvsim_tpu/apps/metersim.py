@@ -21,7 +21,7 @@ import numpy as np
 
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs.trace import Tracer
-from tmhpvsim_tpu.runtime import asyncretry, fixedclock, forever
+from tmhpvsim_tpu.runtime import fixedclock, reconnect_policy
 from tmhpvsim_tpu.runtime.broker import make_transport
 
 logger = logging.getLogger(__name__)
@@ -125,7 +125,6 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange,
         "metersim.values_published_total"
     )
 
-    @asyncretry(delay=5, attempts=forever)
     async def run():
         nonlocal pending, seq
         async with make_transport(url, exchange) as transport:
@@ -145,7 +144,7 @@ async def send_queue_to_transport(queue: asyncio.Queue, url, exchange,
                 pending = None
                 queue.task_done()
 
-    await run()
+    await reconnect_policy(name="metersim.send_queue").call(run)
 
 
 async def metersim_main(amqp_url, exchange, realtime, seed=None,

@@ -27,8 +27,8 @@ import numpy as np
 from tmhpvsim_tpu.config import ModelOptions, Site
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs.trace import Tracer
-from tmhpvsim_tpu.runtime import SynchronizingFunnel, asyncretry, fixedclock, \
-    forever
+from tmhpvsim_tpu.runtime import SynchronizingFunnel, fixedclock, \
+    reconnect_policy
 from tmhpvsim_tpu.runtime.broker import make_transport
 
 logger = logging.getLogger(__name__)
@@ -137,9 +137,9 @@ async def read_transport(funnel: SynchronizingFunnel, url, exchange,
                          counter: Optional[dict] = None,
                          stream: Optional[_StreamStats] = None,
                          tracer: Optional[Tracer] = None) -> None:
-    """Meter consumer with forever-retry (pvsim.py:43-70)."""
+    """Meter consumer with forever-reconnect (pvsim.py:43-70); the
+    jittered-backoff policy replaces the reference's fixed 5 s sleep."""
 
-    @asyncretry(delay=5, attempts=forever)
     async def run():
         async with make_transport(url, exchange) as transport:
             async for time, value, meta in transport.subscribe(
@@ -156,7 +156,7 @@ async def read_transport(funnel: SynchronizingFunnel, url, exchange,
                 else:
                     await funnel.put(time, meter=value)
 
-    await run()
+    await reconnect_policy(name="pvsim.read_transport").call(run)
 
 
 async def _no_meter_watchdog(counter: dict, url, timeout_s: float = 10.0):
@@ -457,6 +457,13 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
 
     reg = obs_metrics.get_registry()
 
+    # Supervised-restart provenance (runtime/supervise.py stamps the
+    # attempt number into the child's env): the run report's resilience
+    # section can then tell a warm restart from a cold start.
+    restart = os.environ.get("TMHPVSIM_SUPERVISED_RESTART")
+    if restart and restart.isdigit() and int(restart) > 0:
+        reg.gauge("resilience.supervised_restarts").set(int(restart))
+
     # Join a pod slice when launched under a multi-host runtime; no-op
     # single-process.  Must run before any jax.devices() query.  Guarded:
     # stale coordinator env vars in a shell must degrade to a single-host
@@ -546,6 +553,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             state, acc = tree["state"], tree["acc"]
             logger.info("resuming reduce run from %s at block %d",
                         checkpoint, start_block)
+            reg.counter("resilience.resumed_total").inc()
+            reg.gauge("resilience.resumed_block").set(start_block)
         dtrace = device_trace(profile_dir) if profile_dir else \
             contextlib.nullcontext()
         # under a slabbing plan each on_block tick covers one slab-sized
@@ -620,6 +629,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
     if checkpoint and os.path.exists(checkpoint):
         state, start_block = ckpt.load(checkpoint, cfg)
         logger.info("resuming from %s at block %d", checkpoint, start_block)
+        reg.counter("resilience.resumed_total").inc()
+        reg.gauge("resilience.resumed_block").set(start_block)
         # Exactly-once CSV rows: a crash can land between "rows of block b
         # written" and "checkpoint for b saved", leaving extra rows from
         # block start_block in the file.  Truncate back to the checkpoint —

@@ -59,6 +59,48 @@ def _common_options(f):
     return f
 
 
+def _chaos_options(f):
+    f = click.option(
+        "--chaos", "chaos", default=None, metavar="SPEC",
+        help="Deterministic fault-injection plan, e.g. "
+             "'broker.publish=raise@n3;serve.dispatch=delay:0.2@every5' "
+             "(grammar in runtime/faults.py).  Unset: $TMHPVSIM_CHAOS; "
+             "no spec anywhere = injection compiled out")(f)
+    f = click.option(
+        "--chaos-seed", "chaos_seed", type=int, default=0,
+        show_default=True,
+        help="seed of the probability-triggered chaos rules")(f)
+    return f
+
+
+def _activate_chaos(chaos, chaos_seed) -> None:
+    """Arm fault injection from --chaos, else from $TMHPVSIM_CHAOS."""
+    from tmhpvsim_tpu.runtime import faults
+
+    if chaos:
+        try:
+            faults.activate(faults.FaultPlan.parse(chaos,
+                                                   seed=chaos_seed))
+        except ValueError as e:
+            raise click.UsageError(f"bad --chaos spec: {e}") from e
+    else:
+        faults.install_from_env()
+
+
+def _maybe_supervise(subcommand: str, supervise: int) -> None:
+    """``--supervise N``: rerun this command as a restarting child
+    (runtime/supervise.py) and exit with its final code.  A supervised
+    child (env marker set) falls through and just runs."""
+    if supervise <= 0:
+        return
+    from tmhpvsim_tpu.runtime import supervise as sup
+
+    if os.environ.get(sup.ENV_RESTART) is not None:
+        return
+    raise SystemExit(sup.run_supervised(sup.child_argv(subcommand),
+                                        max_restarts=supervise))
+
+
 def _setup_logging(verbose: int) -> None:
     # -v -> INFO, -vv -> DEBUG (metersim.py:92-93)
     logging.basicConfig(level=logging.WARN - 10 * verbose)
@@ -137,12 +179,14 @@ def fanoutbroker(host, port, max_backlog, verbose):
                    "it).  Unset: $TMHPVSIM_COMPILE_CACHE, else "
                    "~/.cache/tmhpvsim_tpu/xla; 'off' disables "
                    "(engine/compilecache.py)")
+@_chaos_options
 def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
-             trace, backend, compile_cache):
+             trace, backend, compile_cache, chaos, chaos_seed):
     """1 Hz electricity-demand producer (reference metersim.py:79-95)."""
     from tmhpvsim_tpu.apps.metersim import metersim_main
 
     _setup_logging(verbose)
+    _activate_chaos(chaos, chaos_seed)
     if compile_cache is not None and backend != "jax":
         raise click.UsageError("--compile-cache requires --backend=jax")
     asyncrun(metersim_main(amqp_url, exchange, realtime, seed, duration_s,
@@ -254,14 +298,23 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "under --tune); K > 1 runs K blocks as one jitted scan "
                    "— bit-identical results, fewer host round-trips "
                    "(config.SimConfig.blocks_per_dispatch)")
+@click.option("--supervise", "supervise", type=int, default=0,
+              metavar="N",
+              help="Run as a supervised child and warm-restart it on a "
+                   "crash up to N times: the restarted run resumes from "
+                   "--checkpoint and recompiles nothing under the "
+                   "persistent compile cache (runtime/supervise.py)")
+@_chaos_options
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, trace, backend, n_chains, chain, sharded, checkpoint,
           block_s, site_grid_spec, sites_csv, profile_dir, output,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
           analytics, metrics_path, run_report_path, compile_cache,
-          blocks_per_dispatch):
+          blocks_per_dispatch, supervise, chaos, chaos_seed):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
+    _maybe_supervise("pvsim", supervise)
+    _activate_chaos(chaos, chaos_seed)
     if (site_grid_spec or sites_csv) and backend != "jax":
         raise click.UsageError("--site-grid/--sites-csv require "
                                "--backend=jax")
@@ -390,6 +443,17 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                    "typed 'busy' reply")
 @click.option("--timeout-s", type=float, default=60.0, show_default=True,
               help="per-request wall clock before a typed 'timeout' reply")
+@click.option("--drain-timeout", "drain_timeout_s", type=float,
+              default=30.0, show_default=True,
+              help="shutdown drain budget: past this deadline queued "
+                   "requests get typed 'draining' rejections instead of "
+                   "holding shutdown on a stuck dispatch")
+@click.option("--supervise", "supervise", type=int, default=0,
+              metavar="N",
+              help="Run as a supervised child and warm-restart it on a "
+                   "crash up to N times; the AOT-warmed compile cache "
+                   "makes the restarted server compile nothing fresh "
+                   "(runtime/supervise.py)")
 @click.option("--trace", "trace", default=None,
               help="Record the serving event timeline and export "
                    "Chrome-trace JSON here on exit; crashes dump the "
@@ -408,10 +472,12 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                    "nothing fresh.  Unset: $TMHPVSIM_COMPILE_CACHE, else "
                    "~/.cache/tmhpvsim_tpu/xla; 'off' disables "
                    "(engine/compilecache.py)")
+@_chaos_options
 def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
           block_s, block_impl, tune, window_ms, max_batch, batch_sizes,
-          queue_limit, timeout_s, trace, metrics_path, run_report_path,
-          compile_cache):
+          queue_limit, timeout_s, drain_timeout_s, supervise, trace,
+          metrics_path, run_report_path, compile_cache, chaos,
+          chaos_seed):
     """Long-lived scenario server: a warm simulation answering "what-if"
     queries over the broker (serve/).  Each request perturbs bounded
     scenario knobs (demand scale/shift, DC-capacity scale, weather
@@ -422,6 +488,8 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
     from tmhpvsim_tpu.serve.server import ServeConfig, serve_main
 
     _setup_logging(verbose)
+    _maybe_supervise("serve", supervise)
+    _activate_chaos(chaos, chaos_seed)
     sim_kw = dict(duration_s=duration_s, n_chains=n_chains, seed=seed,
                   output="reduce", block_impl=block_impl, tune=tune)
     if start:
@@ -438,7 +506,7 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
         url=amqp_url or "local://default", exchange=exchange,
         window_s=window_ms / 1e3, max_batch=max_batch,
         batch_sizes=buckets, queue_limit=queue_limit,
-        timeout_s=timeout_s)
+        timeout_s=timeout_s, drain_timeout_s=drain_timeout_s)
     asyncrun(serve_main(cfg, compile_cache=compile_cache, trace=trace,
                         metrics_path=metrics_path,
                         run_report_path=run_report_path))

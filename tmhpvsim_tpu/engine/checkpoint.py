@@ -103,9 +103,14 @@ def save(path: str, state, next_block: int, config=None) -> None:
 
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import annotate
+    from tmhpvsim_tpu.runtime import faults
 
     with obs_metrics.get_registry().timed("checkpoint.save_s"), \
             annotate("tmhpvsim/checkpoint.save"):
+        if faults.ACTIVE is not None:
+            # "write" fires before anything touches disk (a failed save
+            # must leave the previous good checkpoint intact)
+            faults.fire("checkpoint.write")
         flat = _flatten(state)
         meta = {"next_block": int(next_block)}
         if config is not None:
@@ -123,6 +128,11 @@ def save(path: str, state, next_block: int, config=None) -> None:
         with open(tmp, "wb") as f:
             np.savez(f, **flat, **{_META: json.dumps(meta)})
         os.replace(tmp, path)
+        if faults.ACTIVE is not None:
+            # "committed" fires after the atomic rename: a kill scheduled
+            # here is the deterministic crash-with-valid-checkpoint the
+            # recovery tests resume from
+            faults.fire("checkpoint.committed")
 
 
 def peek_meta(path: str) -> dict:

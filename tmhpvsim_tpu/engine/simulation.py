@@ -1817,6 +1817,15 @@ class Simulation:
             out.append((f"scenario_acc[{b}]", self._get_scenario_jit(),
                         (state_abs, inputs_abs, acc_abs,
                          self.scenario_abstract(b))))
+        # resumed carries (and the scenario engine's shared base state)
+        # pass through the non-donating identity copy before the first
+        # donating dispatch; it only ever compiles on those paths, so
+        # without warming it here a resumed run's single cold compile
+        # would be this trivial copy
+        out.append(("resume_copy", _copy_jit, (state_abs,)))
+        if mode == "reduce":
+            out.append(("resume_copy_acc", _copy_jit,
+                        (jax.eval_shape(self.init_reduce_acc),)))
         return out
 
     def _mega_aot_targets(self, inputs, state_abs, mode, tel_on):

@@ -44,9 +44,13 @@ logger = logging.getLogger(__name__)
 #: view: request/reply/rejection/timeout counts, in-flight gauge,
 #: micro-batch occupancy, queue-wait / dispatch / reply-latency
 #: quantiles — serve/, derived from the ``serve.*`` metric names).
+#: v7: adds the optional ``resilience`` section (recovery outcomes:
+#: checkpoint resumes + supervised restart count, retry/giveup
+#: aggregates, circuit-breaker opens/rejections/state, injected-fault
+#: counts by chokepoint — runtime/resilience.py, runtime/faults.py).
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 6
+REPORT_SCHEMA_VERSION = 7
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -75,6 +79,7 @@ _TOP_SCHEMA = {
     "executor": (False, _OPT_DICT),
     "fleet": (False, _OPT_DICT),
     "serving": (False, _OPT_DICT),
+    "resilience": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -348,6 +353,50 @@ def serving_section(snap: dict) -> Optional[dict]:
     }
 
 
+def resilience_section(snap: dict) -> Optional[dict]:
+    """The ``resilience`` report section (schema v7) from the
+    well-known ``resilience.*`` / ``faults.*`` metric names
+    (runtime/resilience.py policies + breakers, runtime/faults.py
+    chokepoints, the checkpoint-resume markers in apps/pvsim.py).
+    None when the run recorded none of them — healthy chaos-free runs
+    keep their reports section-free."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    if not any(k.startswith(("resilience.", "faults."))
+               for k in list(counters) + list(gauges)):
+        return None
+    state_names = {0: "closed", 1: "half_open", 2: "open"}
+    breaker_states = {
+        k[len("resilience.breaker_state."):]:
+            state_names.get(int(v), str(v))
+        for k, v in gauges.items()
+        if k.startswith("resilience.breaker_state.")
+    }
+    out = {
+        "resumes": int(counters.get("resilience.resumed_total", 0)),
+        "restarts":
+            int(gauges.get("resilience.supervised_restarts", 0)),
+        "retries": int(counters.get("resilience.retries_total", 0)),
+        "giveups": int(counters.get("resilience.giveups_total", 0)),
+        "breaker": {
+            "opens": int(_sum_prefixed(
+                counters, "resilience.breaker_open_total.")),
+            "rejected": int(_sum_prefixed(
+                counters, "resilience.breaker_rejected_total.")),
+            "states": breaker_states,
+        },
+        "faults_injected": int(counters.get("faults.injected_total", 0)),
+        "faults_by_point": {
+            k[len("faults.injected."):]: int(v)
+            for k, v in counters.items()
+            if k.startswith("faults.injected.")
+        },
+    }
+    if "resilience.resumed_block" in gauges:
+        out["resumed_block"] = int(gauges["resilience.resumed_block"])
+    return out
+
+
 class RunReport:
     """Incremental builder for one run's report.
 
@@ -386,6 +435,10 @@ class RunReport:
         #: scenario-serving SLO section (schema v6), derived from the
         #: ``serve.*`` metric names by :meth:`attach_metrics`
         self.serving: Optional[dict] = None
+        #: recovery/chaos section (schema v7), derived from the
+        #: ``resilience.*`` / ``faults.*`` metric names by
+        #: :meth:`attach_metrics`
+        self.resilience: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -433,6 +486,9 @@ class RunReport:
         serving = serving_section(snap)
         if serving is not None:
             self.serving = serving
+        resilience = resilience_section(snap)
+        if resilience is not None:
+            self.resilience = resilience
 
     def doc(self, validate: bool = True) -> dict:
         out = {
@@ -458,6 +514,7 @@ class RunReport:
             "executor": self.executor,
             "fleet": self.fleet,
             "serving": self.serving,
+            "resilience": self.resilience,
         }
         return validate_report(out) if validate else out
 

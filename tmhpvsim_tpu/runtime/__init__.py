@@ -2,5 +2,11 @@
 
 from tmhpvsim_tpu.runtime.clock import fixedclock  # noqa: F401
 from tmhpvsim_tpu.runtime.funnel import SynchronizingFunnel  # noqa: F401
-from tmhpvsim_tpu.runtime.retry import asyncretry, forever  # noqa: F401
+from tmhpvsim_tpu.runtime.resilience import (  # noqa: F401
+    CircuitBreaker,
+    ResiliencePolicy,
+    asyncretry,
+    forever,
+    reconnect_policy,
+)
 from tmhpvsim_tpu.runtime.run import asyncrun  # noqa: F401

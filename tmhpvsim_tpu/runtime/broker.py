@@ -34,6 +34,8 @@ import logging
 import weakref
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
+from tmhpvsim_tpu.runtime import faults
+
 logger = logging.getLogger(__name__)
 
 
@@ -177,6 +179,8 @@ class LocalTransport:
         self._exchange = exchange
 
     async def __aenter__(self):
+        if faults.ACTIVE is not None:
+            await faults.afire("broker.connect")
         _count_connect(self._url, self._exchange)
         return self
 
@@ -185,8 +189,16 @@ class LocalTransport:
 
     async def publish(self, value: float, time: _dt.datetime,
                       meta: Optional[dict] = None) -> None:
+        act = None
+        if faults.ACTIVE is not None:
+            act = await faults.afire("broker.publish")
+            if act == "drop":
+                return
         self._broker.publish(self._exchange, encode(value, time, meta))
         _pub_counter().inc()
+        if act == "dup":
+            self._broker.publish(self._exchange, encode(value, time, meta))
+            _pub_counter().inc()
 
     async def subscribe(self, with_meta: bool = False) -> AsyncIterator:
         """Yields ``(time, value)``; ``with_meta=True`` yields
@@ -197,6 +209,14 @@ class LocalTransport:
         try:
             while True:
                 msg = await q.get()
+                if faults.ACTIVE is not None:
+                    act = await faults.afire("broker.deliver")
+                    if act == "drop":
+                        continue
+                    if act == "dup":
+                        deliver.inc()
+                        yield (decode_with_meta(msg) if with_meta
+                               else decode(msg))
                 deliver.inc()
                 yield decode_with_meta(msg) if with_meta else decode(msg)
         finally:
@@ -232,6 +252,8 @@ class AmqpTransport:
 
     async def __aenter__(self):
         ap = self._aio_pika
+        if faults.ACTIVE is not None:
+            await faults.afire("broker.connect")
         self._conn = await ap.connect_robust(self._url)
         self._channel = await self._conn.channel()
         self._exchange = await self._channel.declare_exchange(
@@ -251,6 +273,11 @@ class AmqpTransport:
         # meta rides in AMQP headers, NOT the body: the reference
         # consumer json.loads()es the body as a bare float and must keep
         # working against a stamping producer
+        act = None
+        if faults.ACTIVE is not None:
+            act = await faults.afire("broker.publish")
+            if act == "drop":
+                return
         msg = ap.Message(
             body=json.dumps(value).encode(),
             timestamp=time,
@@ -258,6 +285,10 @@ class AmqpTransport:
         )
         await asyncio.shield(self._exchange.publish(msg, routing_key=""))
         _pub_counter().inc()
+        if act == "dup":
+            await asyncio.shield(
+                self._exchange.publish(msg, routing_key=""))
+            _pub_counter().inc()
 
     async def subscribe(self, with_meta: bool = False) -> AsyncIterator:
         await self._channel.set_qos(prefetch_count=1)
@@ -267,6 +298,11 @@ class AmqpTransport:
         async with queue.iterator() as it:
             async for message in it:
                 async with message.process():
+                    act = None
+                    if faults.ACTIVE is not None:
+                        act = await faults.afire("broker.deliver")
+                        if act == "drop":
+                            continue
                     ts = message.timestamp
                     if isinstance(ts, (int, float)):
                         ts = _dt.datetime.fromtimestamp(ts)
@@ -275,9 +311,13 @@ class AmqpTransport:
                     if with_meta:
                         meta = dict(message.headers) \
                             if message.headers else None
-                        yield ts, value, meta
+                        item = ts, value, meta
                     else:
-                        yield ts, value
+                        item = ts, value
+                    yield item
+                    if act == "dup":
+                        deliver.inc()
+                        yield item
 
 
 def make_transport(url: Optional[str], exchange: str):

@@ -1,0 +1,305 @@
+"""Deterministic fault injection for chaos testing.
+
+A :class:`FaultPlan` is a seeded schedule of faults that instrumented
+chokepoints consult at runtime.  The default path is a module-global
+``None`` check (``if faults.ACTIVE is not None:``) so production code
+pays one attribute load per chokepoint and nothing else — with chaos
+disabled the broker hot paths and the compiled HLO are untouched by
+construction (every chokepoint is host-side Python).
+
+Spec grammar (``--chaos SPEC`` / ``TMHPVSIM_CHAOS``)::
+
+    SPEC    := RULE (';' RULE)*
+    RULE    := POINT '=' ACTION [':' ARG] '@' TRIGGER ['x' COUNT]
+    POINT   := broker.connect | broker.publish | broker.deliver
+             | tcp.partition | funnel.stall | serve.dispatch
+             | checkpoint.write | checkpoint.committed
+    ACTION  := raise | delay:SECONDS | drop | dup | kill
+    TRIGGER := 'n'K        fire on the K-th call (1-based); 'x'C extends
+                           the window to calls K .. K+C-1
+             | 'every'K    fire on every K-th call; 'x'C caps total fires
+             | 'p'P        fire with probability P per call (seeded,
+                           per-rule RNG); 'x'C caps total fires
+
+Examples::
+
+    broker.publish=raise@n3          third publish raises
+    broker.deliver=dup@p0.05x2       ~5% of deliveries duplicated, max 2
+    funnel.stall=delay:0.5@every100  every 100th put stalls 0.5 s
+    checkpoint.committed=kill@n2     SIGKILL right after the 2nd commit
+
+Actions: ``raise`` raises :class:`FaultInjected` (a ``ConnectionError``,
+so transport retry paths treat it as transient), ``delay:S`` sleeps,
+``drop``/``dup`` are returned to the chokepoint which suppresses or
+repeats the unit of work, and ``kill`` delivers SIGKILL to this process
+— the deterministic mid-run crash used by the recovery tests.
+
+Determinism: probability triggers draw from ``random.Random`` seeded
+from ``(plan seed, rule index)``, so firing is independent of rule
+ordering and of any other RNG in the process.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import random
+import signal
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+ENV_SPEC = "TMHPVSIM_CHAOS"
+ENV_SEED = "TMHPVSIM_CHAOS_SEED"
+
+#: the instrumented chokepoints (``broker.*`` fires in all three
+#: transports; ``tcp.partition`` only in the tcp subscriber loop)
+POINTS = (
+    "broker.connect",
+    "broker.publish",
+    "broker.deliver",
+    "tcp.partition",
+    "funnel.stall",
+    "serve.dispatch",
+    "checkpoint.write",
+    "checkpoint.committed",
+)
+
+ACTIONS = ("raise", "delay", "drop", "dup", "kill")
+
+
+class FaultInjected(ConnectionError):
+    """Raised at a chokepoint when the active plan schedules ``raise``."""
+
+
+class _Rule:
+    __slots__ = ("point", "action", "arg", "trigger", "k", "prob",
+                 "count", "calls", "fired", "rng", "spec")
+
+    def __init__(self, point, action, arg, trigger, k, prob, count,
+                 rng, spec):
+        self.point = point
+        self.action = action
+        self.arg = arg
+        self.trigger = trigger  # "n" | "every" | "p"
+        self.k = k
+        self.prob = prob
+        self.count = count      # None = unlimited (every/p only)
+        self.calls = 0
+        self.fired = 0
+        self.rng = rng
+        self.spec = spec
+
+    def should_fire(self) -> bool:
+        """Decide for the current call (``calls`` already incremented)."""
+        if self.trigger == "n":
+            width = 1 if self.count is None else self.count
+            return self.k <= self.calls < self.k + width
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.trigger == "every":
+            return self.calls % self.k == 0
+        return self.rng.random() < self.prob
+
+
+def _parse_rule(raw: str, idx: int, seed: int) -> _Rule:
+    text = raw.strip()
+    try:
+        point, rhs = text.split("=", 1)
+        action_part, trigger_part = rhs.split("@", 1)
+    except ValueError:
+        raise ValueError(
+            f"chaos rule {text!r}: expected POINT=ACTION@TRIGGER") from None
+    point = point.strip()
+    if point not in POINTS:
+        raise ValueError(
+            f"chaos rule {text!r}: unknown point {point!r} "
+            f"(known: {', '.join(POINTS)})")
+
+    action, _, argtext = action_part.strip().partition(":")
+    if action not in ACTIONS:
+        raise ValueError(
+            f"chaos rule {text!r}: unknown action {action!r} "
+            f"(known: {', '.join(ACTIONS)})")
+    arg = 0.0
+    if action == "delay":
+        try:
+            arg = float(argtext)
+        except ValueError:
+            raise ValueError(
+                f"chaos rule {text!r}: delay needs seconds "
+                f"(delay:0.5)") from None
+    elif argtext:
+        raise ValueError(
+            f"chaos rule {text!r}: action {action!r} takes no argument")
+
+    trig = trigger_part.strip()
+    count = None
+    if "x" in trig:
+        trig, _, counttext = trig.rpartition("x")
+        try:
+            count = int(counttext)
+        except ValueError:
+            raise ValueError(
+                f"chaos rule {text!r}: count {counttext!r} not an "
+                f"integer") from None
+        if count < 1:
+            raise ValueError(f"chaos rule {text!r}: count must be >= 1")
+    k, prob, kind = 0, 0.0, None
+    try:
+        if trig.startswith("every"):
+            kind, k = "every", int(trig[len("every"):])
+        elif trig.startswith("n"):
+            kind, k = "n", int(trig[1:])
+        elif trig.startswith("p"):
+            kind, prob = "p", float(trig[1:])
+        else:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"chaos rule {text!r}: bad trigger {trig!r} (nK, everyK, "
+            f"or pFLOAT)") from None
+    if kind in ("n", "every") and k < 1:
+        raise ValueError(f"chaos rule {text!r}: trigger index must be >= 1")
+    if kind == "p" and not 0.0 <= prob <= 1.0:
+        raise ValueError(f"chaos rule {text!r}: probability outside [0, 1]")
+
+    rng = random.Random(1_000_003 * int(seed) + idx)
+    return _Rule(point, action, arg, kind, k, prob, count, rng, text)
+
+
+class FaultPlan:
+    """A parsed, seeded fault schedule.  Thread-safe (one lock guards the
+    per-rule call counters: chokepoints fire from the event loop, worker
+    threads, and the checkpoint writer alike)."""
+
+    def __init__(self, rules, *, seed: int = 0, spec: str = ""):
+        self.rules = list(rules)
+        self.seed = seed
+        self.spec = spec
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        rules = [
+            _parse_rule(raw, idx, seed)
+            for idx, raw in enumerate(
+                s for s in (spec or "").split(";") if s.strip())
+        ]
+        if not rules:
+            raise ValueError("chaos spec is empty")
+        return cls(rules, seed=seed, spec=spec)
+
+    def decide(self, point: str):
+        """The rule firing at ``point`` for this call, or None.  Every
+        rule on the point counts the call; the first firing rule wins."""
+        hit = None
+        with self._lock:
+            for rule in self.rules:
+                if rule.point != point:
+                    continue
+                rule.calls += 1
+                if hit is None and rule.should_fire():
+                    rule.fired += 1
+                    hit = rule
+        return hit
+
+    def describe(self) -> str:
+        return "; ".join(r.spec for r in self.rules)
+
+
+#: the process-wide active plan — chokepoints do nothing unless set
+ACTIVE: FaultPlan | None = None
+
+
+def activate(plan: FaultPlan) -> None:
+    global ACTIVE
+    ACTIVE = plan
+    logger.info("chaos plan active (seed %d): %s", plan.seed,
+                plan.describe())
+
+
+def deactivate() -> None:
+    global ACTIVE
+    ACTIVE = None
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """Scope a plan to a ``with`` block (tests)."""
+    activate(plan)
+    try:
+        yield plan
+    finally:
+        deactivate()
+
+
+def install_from_env(environ=os.environ) -> FaultPlan | None:
+    """Activate a plan from ``TMHPVSIM_CHAOS`` if set (subprocesses of a
+    supervised run inherit chaos through the environment)."""
+    spec = environ.get(ENV_SPEC)
+    if not spec:
+        return None
+    plan = FaultPlan.parse(spec, seed=int(environ.get(ENV_SEED, "0") or 0))
+    activate(plan)
+    return plan
+
+
+def _record(point: str, action: str) -> None:
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.get_registry()
+    reg.counter("faults.injected_total").inc()
+    reg.counter(f"faults.injected.{point}").inc()
+    logger.warning("chaos: injecting %s at %s", action, point)
+
+
+def _apply(rule: _Rule, point: str):
+    """Common tail of fire/afire once a rule fired: record, then either
+    kill/raise here or hand drop/dup/delay back to the caller."""
+    _record(point, rule.action)
+    if rule.action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)  # pragma: no cover - signal delivery race
+    if rule.action == "raise":
+        raise FaultInjected(f"injected fault at {point} ({rule.spec})")
+    return rule.action
+
+
+def fire(point: str):
+    """Synchronous chokepoint: returns ``"drop"``/``"dup"``/``None``;
+    ``delay`` sleeps inline; ``raise`` raises :class:`FaultInjected`;
+    ``kill`` does not return.  Callers guard with
+    ``if faults.ACTIVE is not None:`` so the default path stays a single
+    attribute test."""
+    plan = ACTIVE
+    if plan is None:
+        return None
+    rule = plan.decide(point)
+    if rule is None:
+        return None
+    action = _apply(rule, point)
+    if action == "delay":
+        time.sleep(rule.arg)
+        return None
+    return action
+
+
+async def afire(point: str):
+    """Async chokepoint twin of :func:`fire` (``delay`` awaits instead
+    of blocking the loop)."""
+    plan = ACTIVE
+    if plan is None:
+        return None
+    rule = plan.decide(point)
+    if rule is None:
+        return None
+    action = _apply(rule, point)
+    if action == "delay":
+        import asyncio
+
+        await asyncio.sleep(rule.arg)
+        return None
+    return action

@@ -132,6 +132,10 @@ class SynchronizingFunnel:
         return len(self._cache)
 
     async def put(self, time, **fields) -> None:
+        from tmhpvsim_tpu.runtime import faults
+
+        if faults.ACTIVE is not None:
+            await faults.afire("funnel.stall")
         rec = self._cache.get(time, self._blank)._replace(**fields)
         if any(isinstance(v, float) and math.isnan(v) for v in rec):
             if time not in self._cache:

@@ -1,0 +1,383 @@
+"""Unified resilience policy: retries, backoff, deadlines, breakers.
+
+This module is the successor of ``runtime/retry.py`` (now a deprecated
+re-export shim).  It keeps the reference's ``asyncretry`` decorator
+semantics bit-for-bit (``forever`` sentinel, ``propagate`` fallback,
+``CancelledError`` always fatal, per-qualname ``retry.*`` counters, the
+exhaustion WARN) and layers the pieces the streaming/serving stack
+shares on top:
+
+* :class:`ResiliencePolicy` — one retry loop with exponential backoff
+  and decorrelated jitter, optional per-attempt and total deadline
+  budgets, and an optional circuit breaker.  Threaded through broker
+  reconnect-and-resubscribe (apps + serve), ``ScenarioClient`` request
+  publishing, and the serve reply path.
+* :class:`CircuitBreaker` — a half-open breaker with ``resilience.*``
+  metrics; serve dispatch trips it and sheds load with typed
+  ``unavailable`` rejections instead of queueing doomed work.
+* :class:`WarnRateLimiter` — the funnel-eviction WARN pattern (at most
+  one per 10 s, suppressed-count suffix) applied to reconnect WARNs so
+  a flapping broker cannot flood stderr.
+
+Metrics (looked up per event on the current default registry, like the
+old retry counters): ``retry.attempts.{name}`` / ``retry.exhausted.{name}``
+(unchanged well-known names the streaming report section reads),
+``resilience.retries_total`` / ``resilience.giveups_total`` aggregates,
+and per-breaker ``resilience.breaker_open_total.{name}`` /
+``resilience.breaker_rejected_total.{name}`` counters plus a
+``resilience.breaker_state.{name}`` gauge (0 closed, 1 half-open,
+2 open).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+import logging
+import random
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+#: Sentinel for unbounded retries (the reference's ``forever = ...``,
+#: utils.py:71).
+forever = ...
+
+
+class _Propagate:
+    pass
+
+
+propagate = _Propagate()
+
+_UNSET = object()
+
+#: default window for rate-limited reconnect WARNs (mirrors
+#: ``funnel.EVICT_WARN_EVERY_S``)
+WARN_EVERY_S = 10.0
+
+
+class BreakerOpenError(ConnectionError):
+    """Raised when a call is refused because its circuit breaker is
+    open (subclasses ``ConnectionError`` so reconnect loops treat it as
+    transient)."""
+
+
+class WarnRateLimiter:
+    """At most one WARN per ``every_s``, with a suppressed-count suffix
+    (the funnel-eviction pattern).  ``now`` is injectable for tests."""
+
+    def __init__(self, every_s: float = WARN_EVERY_S):
+        self.every_s = every_s
+        self._last: Optional[float] = None
+        self._suppressed = 0
+
+    @property
+    def suppressed(self) -> int:
+        return self._suppressed
+
+    def warn(self, log: logging.Logger, fmt: str, *args,
+             now: Optional[float] = None) -> bool:
+        if now is None:
+            now = time.monotonic()
+        if self._last is not None and now - self._last < self.every_s:
+            self._suppressed += 1
+            return False
+        suffix = ""
+        if self._suppressed:
+            suffix = (f" ({self._suppressed} similar warnings "
+                      f"suppressed in the last {self.every_s:.0f} s)")
+        self._last = now
+        self._suppressed = 0
+        log.warning(fmt + "%s", *args, suffix)
+        return True
+
+
+_STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class CircuitBreaker:
+    """Half-open circuit breaker.
+
+    ``failure_threshold`` consecutive failures open it; after
+    ``reset_s`` it lets exactly one probe through (half-open); the probe
+    closing or re-opening it.  ``now`` is injectable for tests.  Metrics
+    go to ``registry`` when given, else the current default registry at
+    event time (apps swap registries per run).
+    """
+
+    def __init__(self, name: str = "default", *,
+                 failure_threshold: int = 5, reset_s: float = 30.0,
+                 registry=None, now=time.monotonic):
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_s = reset_s
+        self._registry = registry
+        self._now = now
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+        return obs_metrics.get_registry()
+
+    def _set_state(self, state: str) -> None:
+        self._state = state
+        self._reg().gauge(
+            f"resilience.breaker_state.{self.name}").set(
+                _STATE_CODES[state])
+
+    def _maybe_half_open(self) -> None:
+        if (self._state == "open"
+                and self._now() - self._opened_at >= self.reset_s):
+            self._set_state("half_open")
+            self._probe_in_flight = False
+
+    @property
+    def state(self) -> str:
+        self._maybe_half_open()
+        return self._state
+
+    def allow(self) -> bool:
+        """True when a call may proceed (half-open admits one probe)."""
+        self._maybe_half_open()
+        if self._state == "closed":
+            return True
+        if self._state == "half_open" and not self._probe_in_flight:
+            self._probe_in_flight = True
+            return True
+        self._reg().counter(
+            f"resilience.breaker_rejected_total.{self.name}").inc()
+        return False
+
+    def count_rejected(self) -> None:
+        """Count a load-shedding rejection taken on this breaker's
+        behalf without consuming the half-open probe slot (the serve
+        submit path sheds while open instead of calling allow())."""
+        self._reg().counter(
+            f"resilience.breaker_rejected_total.{self.name}").inc()
+
+    def record_success(self) -> None:
+        self._probe_in_flight = False
+        self._failures = 0
+        if self._state != "closed":
+            logger.info("breaker %r closed after successful probe",
+                        self.name)
+            self._set_state("closed")
+
+    def record_failure(self) -> None:
+        self._maybe_half_open()
+        probe_failed = self._state == "half_open" and self._probe_in_flight
+        self._probe_in_flight = False
+        self._failures += 1
+        tripped = (self._state == "closed"
+                   and self._failures >= self.failure_threshold)
+        if tripped or probe_failed:
+            self._reg().counter(
+                f"resilience.breaker_open_total.{self.name}").inc()
+            logger.warning(
+                "breaker %r open after %d consecutive failure(s); "
+                "next probe in %.1f s", self.name, self._failures,
+                self.reset_s)
+            self._set_state("open")
+            self._opened_at = self._now()
+
+
+class ResiliencePolicy:
+    """One retry loop for every reconnect/redeliver path in the stack.
+
+    ``attempts`` may be an int or the ``forever`` sentinel.  Backoff is
+    exponential (``base_delay_s * multiplier**(n-1)``, capped at
+    ``max_delay_s``); with ``jitter=True`` (default) the delay is drawn
+    with decorrelated jitter (``uniform(base, 3*prev)``, capped) from
+    ``rng`` — injectable for determinism.  ``attempt_timeout_s`` bounds
+    each attempt via ``wait_for``; ``total_timeout_s`` is a total retry
+    budget after which the fallback policy applies even with attempts
+    remaining.  Bounded policies log per-attempt INFO lines like the old
+    decorator; ``forever`` policies are reconnect loops and WARN instead
+    — rate-limited to one per ``warn_every_s`` with a suppressed-count
+    suffix.  ``asyncio.CancelledError`` is always fatal.
+    """
+
+    def __init__(self, *, attempts=3, base_delay_s: float = 0.0,
+                 max_delay_s: Optional[float] = None,
+                 multiplier: float = 2.0, jitter: bool = True,
+                 attempt_timeout_s: Optional[float] = None,
+                 total_timeout_s: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 name: Optional[str] = None, fallback=propagate,
+                 rng: Optional[random.Random] = None,
+                 registry=None, warn_every_s: float = WARN_EVERY_S):
+        self.attempts = attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = (base_delay_s if max_delay_s is None
+                            else max_delay_s)
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.attempt_timeout_s = attempt_timeout_s
+        self.total_timeout_s = total_timeout_s
+        self.breaker = breaker
+        self.name = name
+        self.fallback = fallback
+        self._rng = rng if rng is not None else random.Random()
+        self._registry = registry
+        self._warn = WarnRateLimiter(warn_every_s)
+
+    def backoff(self, n: int, prev: float) -> float:
+        """Sleep before retry ``n`` (1-based), given the previous sleep."""
+        if self.base_delay_s <= 0.0:
+            return 0.0
+        if not self.jitter:
+            return min(self.max_delay_s,
+                       self.base_delay_s * self.multiplier ** (n - 1))
+        return min(self.max_delay_s,
+                   self._rng.uniform(self.base_delay_s,
+                                     max(prev, self.base_delay_s) * 3.0))
+
+    async def call(self, fn, *args, name: Optional[str] = None,
+                   fallback=_UNSET, **kwargs):
+        """Invoke ``await fn(*args, **kwargs)`` under this policy."""
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+        qualname = name or self.name or getattr(
+            fn, "__qualname__", repr(fn))
+        fb = self.fallback if fallback is _UNSET else fallback
+        unbounded = self.attempts is forever
+        deadline = (None if self.total_timeout_s is None
+                    else time.monotonic() + self.total_timeout_s)
+        n = 0
+        prev = self.base_delay_s
+        while True:
+            if self.breaker is not None and not self.breaker.allow():
+                raise BreakerOpenError(
+                    f"{qualname}: circuit breaker "
+                    f"{self.breaker.name!r} is open")
+            try:
+                if self.attempt_timeout_s is not None:
+                    result = await asyncio.wait_for(
+                        fn(*args, **kwargs), self.attempt_timeout_s)
+                else:
+                    result = await fn(*args, **kwargs)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                n += 1
+                # per-qualname counters against the CURRENT process
+                # default registry (looked up per event, not cached at
+                # construction: apps swap registries per run), unless a
+                # registry was bound explicitly (the serve stack)
+                reg = self._registry or obs_metrics.get_registry()
+                reg.counter(f"retry.attempts.{qualname}").inc()
+                reg.counter("resilience.retries_total").inc()
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                out_of_attempts = not unbounded and n >= self.attempts
+                out_of_time = (deadline is not None
+                               and time.monotonic() >= deadline)
+                if out_of_attempts or out_of_time:
+                    reg.counter(f"retry.exhausted.{qualname}").inc()
+                    reg.counter("resilience.giveups_total").inc()
+                    # WARN on exhaustion whichever way it resolves: the
+                    # fallback path would otherwise swallow the failure
+                    # silently (only per-attempt INFO lines exist)
+                    why = ("re-raising" if fb is propagate
+                           else "applying fallback")
+                    if out_of_attempts:
+                        logger.warning(
+                            "%s exhausted %d attempt(s); final failure "
+                            "%s: %s (%s)", qualname, n,
+                            type(exc).__name__, exc, why)
+                    else:
+                        logger.warning(
+                            "%s exceeded its %.1f s retry budget after "
+                            "%d attempt(s); final failure %s: %s (%s)",
+                            qualname, self.total_timeout_s, n,
+                            type(exc).__name__, exc, why)
+                    if fb is propagate:
+                        raise
+                    if callable(fb):
+                        res = fb(exc)
+                        if inspect.isawaitable(res):
+                            res = await res
+                        return res
+                    return fb
+                prev = delay = self.backoff(n, prev)
+                if deadline is not None:
+                    delay = min(delay,
+                                max(0.0, deadline - time.monotonic()))
+                if unbounded:
+                    # a forever policy is a reconnect loop: its failures
+                    # deserve WARN visibility, but rate-limited so a
+                    # flapping broker cannot flood stderr
+                    self._warn.warn(
+                        logger,
+                        "%s failed (%s: %s); retrying in %.1f s "
+                        "(attempt %s)", qualname, type(exc).__name__,
+                        exc, delay, n)
+                else:
+                    logger.info(
+                        "%s failed (%s: %s); retrying in %.1f s "
+                        "(attempt %s)", qualname, type(exc).__name__,
+                        exc, delay, f"{n}/{self.attempts}")
+                await asyncio.sleep(delay)
+            else:
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                return result
+
+    def retrying(self, func):
+        """Decorator form: wrap an async callable under this policy."""
+
+        @functools.wraps(func)
+        async def wrapper(*args, **kwargs):
+            return await self.call(func, *args,
+                                   name=func.__qualname__, **kwargs)
+
+        return wrapper
+
+
+def asyncretry(func=None, *, attempts=3, delay: float = 0.0,
+               fallback=propagate):
+    """Decorator: retry an async callable on exception.
+
+    Reference semantics (utils.py:69-161) preserved exactly — constant
+    ``delay`` between attempts, ``forever`` sentinel, fallback policy,
+    ``CancelledError`` fatal — now expressed as a
+    :class:`ResiliencePolicy` with jitter off and multiplier 1.  Usable
+    bare (``@asyncretry``) or parameterised
+    (``@asyncretry(delay=5, attempts=forever)``).
+    """
+    if func is None:
+        return functools.partial(
+            asyncretry, attempts=attempts, delay=delay, fallback=fallback
+        )
+
+    policy = ResiliencePolicy(attempts=attempts, base_delay_s=delay,
+                              max_delay_s=delay, multiplier=1.0,
+                              jitter=False, fallback=fallback)
+
+    @functools.wraps(func)
+    async def wrapper(*args, **kwargs):
+        return await policy.call(func, *args, name=func.__qualname__,
+                                 **kwargs)
+
+    return wrapper
+
+
+def reconnect_policy(name: Optional[str] = None,
+                     **overrides) -> ResiliencePolicy:
+    """The stack's standard reconnect-and-resubscribe policy: retry
+    forever with decorrelated jitter between 0.5 s and 5 s (the old
+    fixed 5 s reconnect sleep is now the cap, so brief broker blips
+    recover in well under a second)."""
+    kwargs = dict(attempts=forever, base_delay_s=0.5, max_delay_s=5.0,
+                  name=name)
+    kwargs.update(overrides)
+    return ResiliencePolicy(**kwargs)

@@ -1,95 +1,24 @@
-"""Retry decorator for long-lived connection coroutines.
+"""Deprecated shim — the retry loop moved to ``runtime/resilience.py``.
 
-Reference semantics (utils.py:69-161): wrap an async function so failures
-re-invoke it after ``delay`` seconds, up to ``attempts`` times (the
-``forever`` sentinel means unbounded — how both AMQP coroutines ride out
-broker outages, metersim.py:13, pvsim.py:43).  ``asyncio.CancelledError``
-is always fatal (shutdown must win over resilience).  On exhaustion the
-``fallback`` policy applies: re-raise (default), a constant, or a callable
-receiving the exception.
-
-The reference's latent bugs in the callable-fallback path
-(``isinstance(Exception)`` with one argument, undefined ``loop``,
-utils.py:134,138) are simply not reproduced.
+``asyncretry``/``forever``/``propagate`` live on unchanged (the
+decorator is now expressed over :class:`ResiliencePolicy`); import them
+from :mod:`tmhpvsim_tpu.runtime.resilience` (or the ``runtime`` package
+root).  This module re-exports them for one release and will then be
+removed, like the old ``engine/profiling.py`` shim before it.
 """
 
 from __future__ import annotations
 
-import asyncio
-import functools
-import inspect
-import logging
+import warnings
 
-logger = logging.getLogger(__name__)
+from tmhpvsim_tpu.runtime.resilience import (  # noqa: F401
+    asyncretry,
+    forever,
+    propagate,
+)
 
-#: Sentinel for unbounded retries (the reference's ``forever = ...``,
-#: utils.py:71).
-forever = ...
-
-
-class _Propagate:
-    pass
-
-
-propagate = _Propagate()
-
-
-def asyncretry(func=None, *, attempts=3, delay: float = 0.0,
-               fallback=propagate):
-    """Decorator: retry an async callable on exception.
-
-    Usable bare (``@asyncretry``) or parameterised
-    (``@asyncretry(delay=5, attempts=forever)``).
-    """
-    if func is None:
-        return functools.partial(
-            asyncretry, attempts=attempts, delay=delay, fallback=fallback
-        )
-
-    qualname = func.__qualname__
-
-    @functools.wraps(func)
-    async def wrapper(*args, **kwargs):
-        from tmhpvsim_tpu.obs import metrics as obs_metrics
-
-        n = 0
-        while True:
-            try:
-                return await func(*args, **kwargs)
-            except asyncio.CancelledError:
-                raise
-            except Exception as exc:
-                n += 1
-                # per-qualname counters against the CURRENT process
-                # default registry (looked up per event, not cached at
-                # decoration: apps swap registries per run)
-                obs_metrics.get_registry().counter(
-                    f"retry.attempts.{qualname}").inc()
-                if attempts is not forever and n >= attempts:
-                    obs_metrics.get_registry().counter(
-                        f"retry.exhausted.{qualname}").inc()
-                    # WARN on exhaustion whichever way it resolves: the
-                    # fallback path would otherwise swallow the failure
-                    # silently (only per-attempt INFO lines exist)
-                    logger.warning(
-                        "%s exhausted %d attempt(s); final failure %s: "
-                        "%s (%s)", qualname, n, type(exc).__name__, exc,
-                        "re-raising" if fallback is propagate
-                        else "applying fallback",
-                    )
-                    if fallback is propagate:
-                        raise
-                    if callable(fallback):
-                        res = fallback(exc)
-                        if inspect.isawaitable(res):
-                            res = await res
-                        return res
-                    return fallback
-                logger.info(
-                    "%s failed (%s: %s); retrying in %.1f s (attempt %s)",
-                    func.__qualname__, type(exc).__name__, exc, delay,
-                    f"{n}/{attempts}" if attempts is not forever else n,
-                )
-                await asyncio.sleep(delay)
-
-    return wrapper
+warnings.warn(
+    "tmhpvsim_tpu.runtime.retry is deprecated; import asyncretry/forever"
+    " from tmhpvsim_tpu.runtime.resilience (or tmhpvsim_tpu.runtime)",
+    DeprecationWarning, stacklevel=2,
+)

@@ -1,0 +1,130 @@
+"""Supervised warm restart: rerun a crashed CLI child until it exits
+cleanly.
+
+``pvsim --supervise N`` (and ``pvsim-serve --supervise N``) run the
+actual command in a child process; when the child dies — a crash, an
+OOM kill, a chaos-injected SIGKILL (runtime/faults.py) — the supervisor
+relaunches it with exponential backoff, up to N restarts.  Warmth is
+what makes the relaunch cheap: the child resumes from its last block
+checkpoint (engine/checkpoint.py) and recompiles nothing under the
+persistent compile cache (engine/compilecache.py), so a restart costs
+one backoff sleep plus one checkpoint load, not a cold start.
+
+The restart attempt number rides into each child as
+``TMHPVSIM_SUPERVISED_RESTART`` (0 on the first launch); apps/pvsim.py
+surfaces it as the ``resilience.supervised_restarts`` gauge so the run
+report's ``resilience`` section records how many lives the run used.
+The marker doubles as the re-entrancy guard: a child never starts its
+own supervisor even if a ``--supervise`` flag leaks through.
+
+A SIGINT/SIGTERM at the supervisor is forwarded to the child and ends
+supervision — an operator's ^C must stop the run, not fight a restart
+loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+#: restart attempt number in the child's env ("0" = first launch)
+ENV_RESTART = "TMHPVSIM_SUPERVISED_RESTART"
+
+
+def strip_supervise(argv: Sequence[str]) -> List[str]:
+    """``argv`` without ``--supervise N`` / ``--supervise=N`` — the
+    child runs the command itself, never another supervisor."""
+    out: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--supervise":
+            skip = True
+            continue
+        if a.startswith("--supervise="):
+            continue
+        out.append(a)
+    return out
+
+
+def child_argv(subcommand: str,
+               argv: Optional[Sequence[str]] = None) -> List[str]:
+    """Rebuild this process's invocation as a module-run child argv.
+
+    Handles both launch styles: the console script (``pvsim out.csv
+    ...`` — ``sys.argv[1:]`` lacks the subcommand) and the module group
+    (``python -m tmhpvsim_tpu.cli pvsim out.csv ...`` — it leads).  The
+    child always goes through ``python -m tmhpvsim_tpu.cli`` so the
+    same interpreter and environment are reused.
+    """
+    tail = list(sys.argv[1:] if argv is None else argv)
+    if not tail or tail[0] != subcommand:
+        tail = [subcommand, *tail]
+    return [sys.executable, "-m", "tmhpvsim_tpu.cli",
+            *strip_supervise(tail)]
+
+
+def _describe_exit(rc: int) -> str:
+    if rc < 0:
+        try:
+            return f"on signal {signal.Signals(-rc).name}"
+        except ValueError:
+            return f"on signal {-rc}"
+    return f"with code {rc}"
+
+
+def run_supervised(argv: Sequence[str], *, max_restarts: int,
+                   backoff_base_s: float = 1.0,
+                   backoff_max_s: float = 30.0,
+                   env: Optional[dict] = None) -> int:
+    """Run ``argv`` as a child, restarting on crash; returns the final
+    child's exit code (0 on any clean exit)."""
+    base_env = dict(os.environ if env is None else env)
+    attempt = 0
+    proc: Optional[subprocess.Popen] = None
+    stop_sig: List[int] = []
+
+    def _forward(signum, frame):
+        stop_sig.append(signum)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signum)
+
+    old_handlers = {}
+    for s in (signal.SIGINT, signal.SIGTERM):
+        try:
+            old_handlers[s] = signal.signal(s, _forward)
+        except ValueError:  # pragma: no cover - non-main-thread caller
+            pass
+    try:
+        while True:
+            base_env[ENV_RESTART] = str(attempt)
+            proc = subprocess.Popen(list(argv), env=base_env)
+            rc = proc.wait()
+            if rc == 0 or stop_sig:
+                return rc
+            if attempt >= max_restarts:
+                log.error(
+                    "supervised child exited %s; %d restart(s) "
+                    "exhausted — giving up", _describe_exit(rc),
+                    max_restarts)
+                return rc
+            attempt += 1
+            delay = min(backoff_max_s,
+                        backoff_base_s * 2.0 ** (attempt - 1))
+            log.warning(
+                "supervised child exited %s; warm restart %d/%d in "
+                "%.1f s", _describe_exit(rc), attempt, max_restarts,
+                delay)
+            time.sleep(delay)
+    finally:
+        for s, h in old_handlers.items():
+            signal.signal(s, h)

@@ -274,8 +274,11 @@ class TcpTransport:
         self._writer: Optional[asyncio.StreamWriter] = None
 
     async def __aenter__(self):
+        from tmhpvsim_tpu.runtime import faults
         from tmhpvsim_tpu.runtime.broker import _count_connect
 
+        if faults.ACTIVE is not None:
+            await faults.afire("broker.connect")
         self._reader, self._writer = await asyncio.open_connection(
             self._host, self._port
         )
@@ -295,8 +298,14 @@ class TcpTransport:
 
     async def publish(self, value: float, time: _dt.datetime,
                       meta: Optional[dict] = None) -> None:
+        from tmhpvsim_tpu.runtime import faults
         from tmhpvsim_tpu.runtime.broker import _pub_counter
 
+        act = None
+        if faults.ACTIVE is not None:
+            act = await faults.afire("broker.publish")
+            if act == "drop":
+                return
         # naive wall time -> as-if-UTC epoch (see module docstring: makes
         # the join timezone-independent across hosts); aware datetimes
         # keep their real instant.  Wire encoding is INTEGER microseconds
@@ -314,23 +323,43 @@ class TcpTransport:
         # mid-publish must not truncate the frame on the wire
         await asyncio.shield(self._send(frame))
         _pub_counter().inc()
+        if act == "dup":
+            await asyncio.shield(self._send(frame))
+            _pub_counter().inc()
 
     async def subscribe(self, with_meta: bool = False) -> AsyncIterator:
+        from tmhpvsim_tpu.runtime import faults
         from tmhpvsim_tpu.runtime.broker import _deliver_counter
 
         await self._send({"op": "sub", "exchange": self._exchange})
         deliver = _deliver_counter()
         while True:
+            act = None
+            if faults.ACTIVE is not None:
+                # an injected partition drops the socket for real: the
+                # reconnect loop upstream must re-attach and re-subscribe
+                try:
+                    await faults.afire("tcp.partition")
+                except faults.FaultInjected:
+                    self._writer.close()
+                    raise
+                act = await faults.afire("broker.deliver")
             line = await self._reader.readline()
             if not line:
                 raise ConnectionError("tcp broker closed the connection")
+            if act == "drop":
+                continue
             frame = json.loads(line)
             deliver.inc()
             # inverse of publish: integer-us as-if-UTC epoch -> naive wall
             t = _EPOCH + _dt.timedelta(microseconds=frame["ts_us"])
             if with_meta:
                 m = frame.get("m")
-                yield (t.replace(tzinfo=None), frame["v"],
-                       m if isinstance(m, dict) else None)
+                item = (t.replace(tzinfo=None), frame["v"],
+                        m if isinstance(m, dict) else None)
             else:
-                yield (t.replace(tzinfo=None), frame["v"])
+                item = (t.replace(tzinfo=None), frame["v"])
+            yield item
+            if act == "dup":
+                deliver.inc()
+                yield item

@@ -24,11 +24,14 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import dataclasses
 import logging
 from typing import Callable, List, Optional, Sequence
 
 from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.runtime import faults
+from tmhpvsim_tpu.runtime.resilience import CircuitBreaker
 from tmhpvsim_tpu.serve.schema import Request, RequestError
 
 log = logging.getLogger(__name__)
@@ -54,12 +57,17 @@ class MicroBatcher:
 
     def __init__(self, dispatch: Callable[[List[Request]], Sequence],
                  *, window_s: float = 0.010, max_batch: int = 16,
-                 queue_limit: int = 1024, registry=None):
+                 queue_limit: int = 1024, registry=None,
+                 breaker: Optional[CircuitBreaker] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch {max_batch} must be >= 1")
         self._dispatch = dispatch
         self._window_s = float(window_s)
         self._max_batch = int(max_batch)
+        #: dispatch circuit breaker: consecutive dispatch failures open
+        #: it and submit sheds with typed ``unavailable`` until a probe
+        #: batch succeeds (None = never shed)
+        self.breaker = breaker
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=queue_limit)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="serve-dispatch")
@@ -82,6 +90,13 @@ class MicroBatcher:
         queue is full and ``draining`` once the batcher is stopping."""
         if self._closed:
             raise RequestError("draining", "batcher is stopping")
+        if self.breaker is not None and self.breaker.state == "open":
+            # shed while open; once half-open, requests flow again and
+            # the next batch is the probe that closes or re-opens it
+            self.breaker.count_rejected()
+            raise RequestError(
+                "unavailable",
+                "dispatch circuit breaker is open; retry with backoff")
         loop = asyncio.get_running_loop()
         pending = _Pending(request, loop.create_future(), loop.time())
         try:
@@ -92,25 +107,51 @@ class MicroBatcher:
                 f"({self._queue.maxsize} requests)") from None
         return pending.future
 
-    async def stop(self, drain: bool = True) -> None:
+    async def stop(self, drain: bool = True,
+                   timeout: Optional[float] = None) -> None:
         """Stop the loop.  ``drain=True`` processes everything already
         queued first; ``drain=False`` fails queued requests with a
-        typed ``draining`` error."""
+        typed ``draining`` error.  ``timeout`` bounds the drain: past
+        the deadline the loop is force-closed and every request still
+        queued fails with a typed ``draining`` rejection instead of
+        hanging shutdown on a stuck dispatch."""
         self._closed = True
         if not drain:
-            while True:
-                try:
-                    p = self._queue.get_nowait()
-                except asyncio.QueueEmpty:
-                    break
-                if p is not self._STOP and not p.future.done():
-                    p.future.set_exception(
-                        RequestError("draining", "server shut down"))
+            self._fail_queued("server shut down")
         await self._queue.put(self._STOP)
+        timed_out = False
         if self._task is not None:
-            await self._task
+            try:
+                if timeout is None:
+                    await self._task
+                else:
+                    await asyncio.wait_for(
+                        asyncio.shield(self._task), timeout)
+            except asyncio.TimeoutError:
+                timed_out = True
+                log.warning(
+                    "drain deadline (%.1f s) exceeded; force-closing "
+                    "with typed 'draining' rejections for %d queued "
+                    "request(s)", timeout, self._queue.qsize())
+                self._task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await self._task
+                self._fail_queued(
+                    f"drain deadline ({timeout:g} s) exceeded")
             self._task = None
-        self._pool.shutdown(wait=True)
+        # past the deadline a dispatch may still hold the worker thread;
+        # waiting would defeat the deadline (the thread parks until the
+        # device call returns)
+        self._pool.shutdown(wait=not timed_out)
+
+    def _fail_queued(self, why: str) -> None:
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if p is not self._STOP and not p.future.done():
+                p.future.set_exception(RequestError("draining", why))
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
@@ -149,9 +190,13 @@ class MicroBatcher:
         requests = [p.request for p in batch]
         t0 = loop.time()
         try:
+            if faults.ACTIVE is not None:
+                await faults.afire("serve.dispatch")
             results = await loop.run_in_executor(
                 self._pool, self._dispatch, requests)
         except Exception as err:
+            if self.breaker is not None:
+                self.breaker.record_failure()
             log.exception("scenario dispatch failed (%d requests)",
                           len(batch))
             for p in batch:
@@ -160,6 +205,8 @@ class MicroBatcher:
                         RequestError("internal",
                                      f"dispatch failed: {err}"))
             return
+        if self.breaker is not None:
+            self.breaker.record_success()
         dispatch_s = loop.time() - t0
         self._h_dispatch.observe(dispatch_s)
         if len(results) != len(batch):  # dispatch contract violation

@@ -53,8 +53,10 @@ OP_REPLY = "scenario-reply"
 MODES = ("reduce", "quantiles", "fleet")
 
 #: typed rejection codes a reply's ``error.code`` may carry
+#: (``unavailable`` = the dispatch circuit breaker is open: the server
+#: is shedding load until its probe succeeds — retry with backoff)
 ERROR_CODES = ("invalid", "duplicate", "busy", "draining", "timeout",
-               "internal")
+               "internal", "unavailable")
 
 #: request-side knob bounds: name -> (lo, hi, default).  Scales are
 #: capped at 8x (a fleet scenario, not a numerics stress test) and the

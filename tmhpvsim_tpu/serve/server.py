@@ -47,6 +47,8 @@ from tmhpvsim_tpu.obs import analytics as flt
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs.trace import Tracer
 from tmhpvsim_tpu.runtime.broker import make_transport
+from tmhpvsim_tpu.runtime.resilience import (CircuitBreaker,
+                                             ResiliencePolicy, forever)
 from tmhpvsim_tpu.serve import schema
 from tmhpvsim_tpu.serve.batcher import MicroBatcher
 from tmhpvsim_tpu.serve.schema import Request, RequestError, Scenario
@@ -98,6 +100,16 @@ class ServeConfig:
     queue_limit: int = 1024
     #: per-request wall clock before a typed ``timeout`` reply
     timeout_s: float = 60.0
+    #: graceful-drain hard deadline: past it, queued requests get typed
+    #: ``draining`` rejections and shutdown proceeds (``--drain-timeout``)
+    drain_timeout_s: float = 30.0
+    #: completed request ids remembered for duplicate rejection (LRU)
+    recent_ids_cap: int = RECENT_IDS_CAP
+    #: consecutive dispatch failures that open the circuit breaker
+    #: (requests shed with typed ``unavailable`` while open)
+    breaker_threshold: int = 5
+    #: seconds an open breaker waits before letting a probe batch through
+    breaker_reset_s: float = 30.0
 
     def buckets(self) -> Tuple[int, ...]:
         bs = tuple(sorted({int(b) for b in self.batch_sizes})) \
@@ -223,8 +235,19 @@ class ScenarioServer:
         self._c_replies = reg.counter("serve.replies_total")
         self._c_rejected = reg.counter("serve.rejected_total")
         self._c_timeouts = reg.counter("serve.timeouts_total")
+        self._c_replay_evict = reg.counter("serve.replay_evictions_total")
         self._g_inflight = reg.gauge("serve.in_flight")
         self._h_reply = reg.histogram("serve.reply_latency_s")
+        #: reconnect-and-resubscribe for the request subscription — a
+        #: dropped broker connection must not kill the server
+        self._consume_policy = ResiliencePolicy(
+            attempts=forever, base_delay_s=0.1, max_delay_s=2.0,
+            name="serve.consume", registry=reg)
+        #: bounded retries for reply publishes — a transient publish
+        #: failure must not lose an accepted request's answer
+        self._reply_policy = ResiliencePolicy(
+            attempts=5, base_delay_s=0.05, max_delay_s=0.5,
+            name="serve.publish_reply", registry=reg)
 
     @property
     def draining(self) -> bool:
@@ -242,7 +265,12 @@ class ScenarioServer:
                 window_s=self.cfg.window_s,
                 max_batch=max(self.engine.buckets),
                 queue_limit=self.cfg.queue_limit,
-                registry=self.registry)
+                registry=self.registry,
+                breaker=CircuitBreaker(
+                    "serve.dispatch",
+                    failure_threshold=self.cfg.breaker_threshold,
+                    reset_s=self.cfg.breaker_reset_s,
+                    registry=self.registry))
             self.batcher.start()
             self._req_tx = make_transport(self.cfg.url, self.cfg.exchange)
             await self._req_tx.__aenter__()
@@ -289,12 +317,22 @@ class ScenarioServer:
             return
         self._stopped = True
         self._draining = True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.cfg.drain_timeout_s
         if self.batcher is not None:
-            await self.batcher.stop(drain=True)
+            await self.batcher.stop(drain=True,
+                                    timeout=self.cfg.drain_timeout_s)
         if self._tasks:
-            # replies for everything the batcher just resolved
-            await asyncio.wait(self._tasks,
-                               timeout=self.cfg.timeout_s + 5.0)
+            # replies for everything the batcher just resolved (or
+            # force-failed with typed 'draining' at the deadline); past
+            # the deadline, stragglers are cancelled unreplied
+            done, pending = await asyncio.wait(
+                self._tasks,
+                timeout=max(1.0, deadline - loop.time()))
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.wait(pending, timeout=1.0)
         if self._consume_task is not None:
             self._consume_task.cancel()
             with contextlib.suppress(asyncio.CancelledError,
@@ -313,9 +351,25 @@ class ScenarioServer:
     # ------------------------------------------------------------------
 
     async def _consume(self) -> None:
-        async for item in self._req_tx.subscribe(with_meta=True):
-            _t, _v, meta = item
-            self._handle(meta)
+        async def run():
+            # (re)build the request transport when the last subscription
+            # died — reconnect AND re-subscribe, the fanout contract
+            if self._req_tx is None:
+                tx = make_transport(self.cfg.url, self.cfg.exchange)
+                await tx.__aenter__()
+                self._req_tx = tx
+            try:
+                async for item in self._req_tx.subscribe(with_meta=True):
+                    _t, _v, meta = item
+                    self._handle(meta)
+            except BaseException:
+                tx, self._req_tx = self._req_tx, None
+                if tx is not None:
+                    with contextlib.suppress(Exception):
+                        await tx.__aexit__(None, None, None)
+                raise
+
+        await self._consume_policy.call(run)
 
     def _handle(self, meta) -> None:
         # non-request traffic on a shared exchange is not ours to judge
@@ -338,6 +392,8 @@ class ScenarioServer:
                 meta, max_horizon_s=self.engine.max_horizon_s)
             if req.id in self._inflight_ids or \
                     req.id in self._recent_ids:
+                if req.id in self._recent_ids:  # true LRU: a replayed
+                    self._recent_ids.move_to_end(req.id)  # id stays hot
                 raise RequestError(
                     "duplicate", f"request id {req.id!r} already seen")
         except RequestError as err:
@@ -395,19 +451,32 @@ class ScenarioServer:
         finally:
             self._inflight_ids.discard(req.id)
             self._recent_ids[req.id] = None
-            while len(self._recent_ids) > RECENT_IDS_CAP:
+            while len(self._recent_ids) > self.cfg.recent_ids_cap:
                 self._recent_ids.popitem(last=False)
+                self._c_replay_evict.inc()
             self._g_inflight.set(len(self._inflight_ids))
 
     async def _publish_reply(self, exchange: str, meta: dict) -> None:
         """Publish on a per-``reply_to`` transport (cached: clients
-        reuse their reply exchange across requests)."""
-        tx = self._reply_tx.get(exchange)
-        if tx is None:
-            tx = make_transport(self.cfg.url, exchange)
-            await tx.__aenter__()
-            self._reply_tx[exchange] = tx
-        await tx.publish(0.0, _now(), meta=meta)
+        reuse their reply exchange across requests).  Retried under the
+        reply policy, rebuilding the transport on failure — a transient
+        broker error must not lose an accepted request's answer."""
+
+        async def attempt():
+            tx = self._reply_tx.get(exchange)
+            if tx is None:
+                tx = make_transport(self.cfg.url, exchange)
+                await tx.__aenter__()
+                self._reply_tx[exchange] = tx
+            try:
+                await tx.publish(0.0, _now(), meta=meta)
+            except BaseException:
+                self._reply_tx.pop(exchange, None)
+                with contextlib.suppress(Exception):
+                    await tx.__aexit__(None, None, None)
+                raise
+
+        await self._reply_policy.call(attempt)
 
 
 class ScenarioClient:
@@ -421,7 +490,8 @@ class ScenarioClient:
     """
 
     def __init__(self, url: str, exchange: str = "scenario",
-                 reply_to: Optional[str] = None):
+                 reply_to: Optional[str] = None,
+                 policy: Optional[ResiliencePolicy] = None):
         self._url = url
         self._exchange = exchange
         self.reply_to = reply_to or \
@@ -430,6 +500,14 @@ class ScenarioClient:
         self._req_tx = None
         self._rep_tx = None
         self._task: Optional[asyncio.Task] = None
+        #: bounded retry policy for request publishes (None = one shot);
+        #: reply timeouts stay the caller's ``timeout`` budget
+        self._policy = policy
+        #: the reply subscription reconnects-and-resubscribes forever —
+        #: a broker blip must not strand every pending future
+        self._consume_policy = ResiliencePolicy(
+            attempts=forever, base_delay_s=0.1, max_delay_s=2.0,
+            name="ScenarioClient.consume")
 
     async def __aenter__(self):
         self._req_tx = make_transport(self._url, self._exchange)
@@ -455,13 +533,28 @@ class ScenarioClient:
         return False
 
     async def _consume(self) -> None:
-        async for _t, _v, meta in self._rep_tx.subscribe(with_meta=True):
-            if not isinstance(meta, dict) or \
-                    meta.get("op") != schema.OP_REPLY:
-                continue
-            fut = self._pending.pop(meta.get("id"), None)
-            if fut is not None and not fut.done():
-                fut.set_result(meta)
+        async def run():
+            if self._rep_tx is None:
+                tx = make_transport(self._url, self.reply_to)
+                await tx.__aenter__()
+                self._rep_tx = tx
+            try:
+                async for _t, _v, meta in \
+                        self._rep_tx.subscribe(with_meta=True):
+                    if not isinstance(meta, dict) or \
+                            meta.get("op") != schema.OP_REPLY:
+                        continue
+                    fut = self._pending.pop(meta.get("id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(meta)
+            except BaseException:
+                tx, self._rep_tx = self._rep_tx, None
+                if tx is not None:
+                    with contextlib.suppress(Exception):
+                        await tx.__aexit__(None, None, None)
+                raise
+
+        await self._consume_policy.call(run)
 
     async def request(self, scenario: Optional[dict] = None,
                       mode: str = "reduce", rid: Optional[str] = None,
@@ -472,9 +565,14 @@ class ScenarioClient:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._pending[rid] = fut
+        meta = schema.request_meta(rid, self.reply_to, mode, scenario)
         try:
-            await self._req_tx.publish(0.0, _now(), meta=schema.request_meta(
-                rid, self.reply_to, mode, scenario))
+            if self._policy is not None:
+                await self._policy.call(
+                    self._req_tx.publish, 0.0, _now(), meta=meta,
+                    name="ScenarioClient.request")
+            else:
+                await self._req_tx.publish(0.0, _now(), meta=meta)
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(rid, None)

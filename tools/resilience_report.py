@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the ``resilience`` section of run reports.
+
+Accepts any mix of the shapes the repo's tooling writes (same intake as
+``serve_report.py`` / ``fleet_report.py``):
+
+* a bare RunReport JSON (``kind == "tmhpvsim_tpu.run_report"``);
+* a bench doc — one JSON object with an embedded ``run_report`` key
+  (``bench.py`` stdout lines / BENCH_*.json);
+* a JSONL stream of either (bench batteries append one doc per phase).
+
+For every embedded report carrying a ``resilience`` section (schema v7,
+obs/report.py ``resilience_section``), the section is checked against
+the shape that function emits — required counters, breaker sub-document
+and state names, fault totals consistent with the per-point breakdown —
+and printed as a readable recovery summary: resumes and supervised
+restarts, retry/giveup aggregates, breaker opens/rejections and final
+states, and what the chaos plan actually injected.
+
+Exit code 0 when every *present* resilience section validates — reports
+without one (healthy chaos-free runs, pre-v7 documents) are fine and
+just noted, which is how ``run_tpu_round5b.sh`` consumes this
+non-fatally after each bench doc.  Nonzero means a malformed section:
+the resilience path wrote something ``resilience_section`` never emits.
+
+No third-party imports: runs anywhere the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+#: the counters resilience_section always emits (ints, >= 0)
+_COUNTER_KEYS = ("resumes", "restarts", "retries", "giveups",
+                 "faults_injected")
+
+_BREAKER_STATES = ("closed", "half_open", "open")
+
+
+def _check(cond: bool, errors: list, msg: str) -> None:
+    if not cond:
+        errors.append(msg)
+
+
+def _is_count(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+
+def validate_resilience(sec) -> list:
+    """Schema errors for one ``resilience`` section (empty = valid)."""
+    errors: list = []
+    if not isinstance(sec, dict):
+        return [f"resilience section is {type(sec).__name__}, "
+                f"not an object"]
+    for key in _COUNTER_KEYS:
+        _check(_is_count(sec.get(key)), errors,
+               f"{key} missing/not a non-negative int")
+
+    br = sec.get("breaker")
+    if not isinstance(br, dict):
+        errors.append("breaker missing/not an object")
+    else:
+        for key in ("opens", "rejected"):
+            _check(_is_count(br.get(key)), errors,
+                   f"breaker.{key} missing/not a non-negative int")
+        states = br.get("states")
+        if not isinstance(states, dict):
+            errors.append("breaker.states missing/not an object")
+        else:
+            for name, st in states.items():
+                _check(st in _BREAKER_STATES, errors,
+                       f"breaker.states[{name!r}] = {st!r} not one of "
+                       f"{', '.join(_BREAKER_STATES)}")
+
+    by_point = sec.get("faults_by_point")
+    if not isinstance(by_point, dict):
+        errors.append("faults_by_point missing/not an object")
+    else:
+        for point, n in by_point.items():
+            _check(_is_count(n), errors,
+                   f"faults_by_point[{point!r}] not a non-negative int")
+        if _is_count(sec.get("faults_injected")) and \
+                all(_is_count(n) for n in by_point.values()):
+            total = sum(by_point.values())
+            _check(total == sec["faults_injected"], errors,
+                   f"faults_by_point sums to {total} != "
+                   f"faults_injected ({sec['faults_injected']})")
+
+    rb = sec.get("resumed_block")
+    if rb is not None:
+        _check(_is_count(rb), errors,
+               "resumed_block present but not a non-negative int")
+        _check(_is_count(sec.get("resumes")) and sec["resumes"] > 0,
+               errors, "resumed_block present with resumes == 0")
+    return errors
+
+
+def print_resilience(sec: dict, label: str) -> None:
+    resumed = (f" from block {sec['resumed_block']}"
+               if sec.get("resumed_block") is not None else "")
+    print(f"{label}: resilience "
+          f"(resumes={sec['resumes']:,}{resumed} "
+          f"restarts={sec['restarts']:,} retries={sec['retries']:,} "
+          f"giveups={sec['giveups']:,})")
+    br = sec["breaker"]
+    states = ", ".join(f"{n}={s}" for n, s in sorted(br["states"].items()))
+    print(f"  breaker     opens={br['opens']:,} "
+          f"rejected={br['rejected']:,}"
+          + (f"  ({states})" if states else ""))
+    if sec["faults_injected"]:
+        points = ", ".join(f"{p}={n:,}" for p, n in
+                           sorted(sec["faults_by_point"].items()))
+        print(f"  chaos       injected={sec['faults_injected']:,}  "
+              f"({points})")
+    else:
+        print("  chaos       (no faults injected)")
+
+
+def _iter_docs(path: str):
+    """Parsed JSON documents in ``path``: one whole-file document, or
+    one per line (bench batteries write JSONL)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            yield json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+
+
+def _extract_reports(doc):
+    """(label_suffix, report_dict) pairs embedded in one parsed doc."""
+    if not isinstance(doc, dict):
+        return
+    if doc.get("kind") == REPORT_KIND:
+        yield "", doc
+        return
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and rep.get("kind") == REPORT_KIND:
+        label = doc.get("phase") or doc.get("variant") or rep.get("app")
+        yield f"[{label}]" if label else "", rep
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate (and print) every resilience section in one file; True
+    when all present sections pass.  A file with none passes
+    trivially."""
+    name = os.path.basename(path)
+    try:
+        docs = list(_iter_docs(path))
+    except OSError as e:
+        print(f"{name}: UNREADABLE ({e})", file=sys.stderr)
+        return False
+    found = 0
+    ok = True
+    for doc in docs:
+        for suffix, rep in _extract_reports(doc):
+            sec = rep.get("resilience")
+            if sec is None:
+                continue
+            found += 1
+            errors = validate_resilience(sec)
+            if errors:
+                ok = False
+                print(f"{name}{suffix}: INVALID resilience section "
+                      f"({len(errors)} error(s))", file=sys.stderr)
+                for e in errors[:10]:
+                    print(f"  {e}", file=sys.stderr)
+                if len(errors) > 10:
+                    print(f"  ... and {len(errors) - 10} more",
+                          file=sys.stderr)
+            elif not quiet:
+                print_resilience(sec, f"{name}{suffix}")
+    if not found and not quiet:
+        print(f"{name}: no resilience section (healthy chaos-free run "
+              f"or pre-v7 report)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print RunReport resilience "
+                    "sections (bare reports, bench docs, or JSONL of "
+                    "either)")
+    ap.add_argument("files", nargs="+", help="report/bench files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the summaries (errors still print)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
