@@ -129,14 +129,57 @@ def _force_cpu(n_devices: int = 8):
         pass  # already created; the XLA_FLAGS path may still hold
 
 
+#: backend-probe accounting, surfaced in the schema-v8 ``probe`` report
+#: section of every bench artifact (obs/report.py): how many probe
+#: attempts this process made and how many returned nothing (timeout or
+#: child failure) before the platform was settled
+_PROBE_STATS = {"probe_attempts": 0, "probe_timeouts": 0}
+
+#: per-attempt bound handed to the probe child's subprocess timeout; the
+#: policy's total budget caps the whole retry loop including backoff
+_PROBE_ATTEMPT_TIMEOUT_S = 150.0
+_PROBE_TOTAL_TIMEOUT_S = 240.0
+
+
+def _probe_doc() -> dict | None:
+    """The ``probe`` report section, or None when no probe ran (so
+    artifacts from probe-free paths stay byte-stable)."""
+    if not _PROBE_STATS["probe_attempts"]:
+        return None
+    return {**_PROBE_STATS,
+            "attempt_timeout_s": _PROBE_ATTEMPT_TIMEOUT_S,
+            "total_timeout_s": _PROBE_TOTAL_TIMEOUT_S}
+
+
 def _probe_or_fallback() -> tuple[str, bool]:
-    """(platform, fallback?) — probe the pinned backend, else force CPU."""
-    platform = None
-    for attempt, deadline in enumerate((180.0, 90.0), 1):
-        platform = _probe_backend(deadline)
-        if platform:
-            break
-        print(f"# probe attempt {attempt} failed", file=sys.stderr)
+    """(platform, fallback?) — probe the pinned backend, else force CPU.
+
+    The probe runs under ``runtime.resilience.ResiliencePolicy``
+    (replacing the old ad-hoc two-timeout loop): two bounded attempts
+    with jittered backoff inside a total budget, each attempt bounded by
+    the probe child's own subprocess timeout (the policy's asyncio
+    wait_for cannot pre-empt a blocking subprocess, so the bound lives
+    where it works).  A no-platform attempt raises TimeoutError so the
+    policy's retry/giveup machinery — and its ``retry.*`` counters —
+    drive the loop; attempts/timeouts are also journalled into
+    ``_PROBE_STATS`` for the v8 ``probe`` report section."""
+    import asyncio
+
+    from tmhpvsim_tpu.runtime.resilience import ResiliencePolicy
+
+    async def attempt():
+        _PROBE_STATS["probe_attempts"] += 1
+        platform = _probe_backend(_PROBE_ATTEMPT_TIMEOUT_S)
+        if platform is None:
+            _PROBE_STATS["probe_timeouts"] += 1
+            raise TimeoutError("backend probe returned no platform")
+        return platform
+
+    policy = ResiliencePolicy(
+        attempts=2, base_delay_s=2.0, max_delay_s=10.0,
+        total_timeout_s=_PROBE_TOTAL_TIMEOUT_S,
+        name="bench.backend_probe", fallback=None)
+    platform = asyncio.run(policy.call(attempt))
     if platform is None:
         _force_cpu()
         return "cpu-fallback", True
@@ -213,7 +256,8 @@ def _bench_timing(compile_s, steady_wall_s, n_timed_blocks, rate) -> dict:
 
 def _bench_report(app: str, *, config=None, plan=None, timing=None,
                   headline=None, profile=None, slabs=None,
-                  device=None, executor=None) -> dict | None:
+                  device=None, executor=None,
+                  precision=None) -> dict | None:
     """A validated obs RunReport document, embedded ADDITIVELY in a bench
     artifact as ``doc["run_report"]`` (the legacy ad-hoc fields stay —
     battery scripts key richness decisions off them).  Never raises: a
@@ -237,6 +281,10 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
         rep.slabs = slabs
         rep.device = device
         rep.executor = executor
+        rep.precision = precision
+        # every bench artifact records how the backend probe went — the
+        # v8 ``probe`` section; None when this path never probed
+        rep.probe = _probe_doc()
         return rep.doc()
     except Exception as e:
         print(f"# run_report build failed ({app}): {e}", file=sys.stderr)
@@ -337,6 +385,17 @@ VARIANT_CFGS = {
     "scan2-threefry": dict(prng_impl="threefry2x32", block_impl="scan2"),
     "wide-threefry": dict(prng_impl="threefry2x32", block_impl="wide",
                           stats_fusion="fused"),
+    # precision levers priced on the scan2 path (threefry ONLY — the rbg
+    # pathology above must never contaminate a precision comparison):
+    # bf16 compute, tabulated solar/pv kernels, and both together.  bf16
+    # auto-escalates telemetry to 'light' (engine/autotune.py), so these
+    # rates already pay the sentinel's cost — the honest number.
+    "scan2-bf16": dict(prng_impl="threefry2x32", block_impl="scan2",
+                       compute_dtype="bf16"),
+    "scan2-table": dict(prng_impl="threefry2x32", block_impl="scan2",
+                        kernel_impl="table"),
+    "scan2-bf16-table": dict(prng_impl="threefry2x32", block_impl="scan2",
+                             compute_dtype="bf16", kernel_impl="table"),
     "scan-rbg": dict(prng_impl="rbg", block_impl="auto", _probe=True),
 }
 
@@ -410,7 +469,35 @@ def _plan_doc(plan) -> dict:
     return {"block_impl": plan.block_impl, "scan_unroll": plan.scan_unroll,
             "stats_fusion": plan.stats_fusion,
             "slab_chains": plan.slab_chains, "source": plan.source,
-            "blocks_per_dispatch": plan.blocks_per_dispatch}
+            "blocks_per_dispatch": plan.blocks_per_dispatch,
+            "compute_dtype": getattr(plan, "compute_dtype", "f32"),
+            "kernel_impl": getattr(plan, "kernel_impl", "exact")}
+
+
+def _precision_doc(variants: dict) -> dict | None:
+    """The v8 ``precision`` report section for one variant sweep: each
+    fully-timed variant's rate keyed by its (compute_dtype, kernel_impl)
+    axes, priced as a speedup against the best exact/f32 variant in the
+    SAME sweep (same platform, same process, same chain count — the only
+    comparison that isolates the precision lever)."""
+    rows = {}
+    base = None
+    for name, v in variants.items():
+        if "rate" not in v or v.get("probe"):
+            continue
+        plan = v.get("plan") or {}
+        cdt = plan.get("compute_dtype", "f32")
+        kimpl = plan.get("kernel_impl", "exact")
+        rows[name] = {"compute_dtype": cdt, "kernel_impl": kimpl,
+                      "rate": v["rate"]}
+        if cdt == "f32" and kimpl == "exact":
+            base = max(base or 0.0, v["rate"])
+    if not rows:
+        return None
+    if base:
+        for r in rows.values():
+            r["speedup_vs_exact_f32"] = round(r["rate"] / base, 2)
+    return {"baseline_rate_exact_f32": base, "variants": rows}
 
 
 def _headline_doc(variants: dict, platform: str, **extra) -> dict:
@@ -456,6 +543,7 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
         headline={"site_seconds_per_s": rate, "variant": best_name},
         device={"platform": platform,
                 "device_kind": extra.get("device_kind")},
+        precision=_precision_doc(variants),
     )
     return doc
 
@@ -1404,18 +1492,27 @@ def repro(k: int) -> None:
     runs (105 ms/block in the headline process vs 3.5 ms/block in the
     sweep process): if the spread reproduces across fresh compiles, the
     tunnel's compiler is nondeterministic and the honest headline is the
-    distribution, not one draw."""
+    distribution, not one draw.
+
+    Distribution mode: each trial runs under its OWN simulation seed
+    (1000+i, echoed per-trial and listed in the summary), so the spread
+    also covers seed-dependent compilation/layout effects, and the
+    summary reports min/median/max plus the coefficient of variation —
+    the single number a trend tool can threshold on."""
     rates = []
+    seeds = []
     consec_non_tpu = 0
     ran = 0
     for i in range(k):
         ran = i + 1
+        seed = 1000 + i
         # the compile-variance probe needs a FRESH compile per trial;
         # bench now enables the persistent compile cache by default
         # (main()), so each child must explicitly disable it — a cache
         # hit would measure deserialisation, not compile variance
         env = dict(os.environ, TMHPVSIM_BENCH_ONE_VARIANT="scan-threefry",
-                   TMHPVSIM_COMPILE_CACHE="off")
+                   TMHPVSIM_COMPILE_CACHE="off",
+                   TMHPVSIM_BENCH_SEED=str(seed))
         try:
             # Bounded: a wedged-tunnel trial must not hang the probe
             # forever.  The kill does leave a stale tunnel grant that can
@@ -1436,10 +1533,12 @@ def repro(k: int) -> None:
         except json.JSONDecodeError:
             doc = {"error": f"malformed child output: {line[:120]!r}"}
         doc["trial"] = i
+        doc.setdefault("seed", seed)
         # TPU rates only: a trial that fell back to CPU would otherwise
         # fabricate a giant "compile variance" spread in the summary
         if doc.get("platform") == "tpu":
             rates.append(doc.get("rate"))
+            seeds.append(seed)
             consec_non_tpu = 0
         else:
             consec_non_tpu += 1
@@ -1463,13 +1562,22 @@ def repro(k: int) -> None:
         summary = {
             "phase": "repro-summary", "platform": "tpu",
             "trials": ran, "requested": k,
-            "landed": len(ok),
+            "landed": len(ok), "seeds": seeds,
             "min": ok[0], "median": ok[len(ok) // 2], "max": ok[-1],
         }
+        # coefficient of variation (sample stdev / mean): the spread in
+        # one dimensionless number — >~0.1 means the compiler (or the
+        # tunnel) is the variable, not the code under test
+        if len(ok) >= 2:
+            mean = sum(ok) / len(ok)
+            var = sum((r - mean) ** 2 for r in ok) / (len(ok) - 1)
+            summary["cov"] = (round((var ** 0.5) / mean, 4) if mean
+                              else None)
         summary["run_report"] = _bench_report(
             "bench.repro",
             headline={"site_seconds_per_s": summary["median"],
-                      "min": ok[0], "max": ok[-1], "landed": len(ok)},
+                      "min": ok[0], "max": ok[-1], "landed": len(ok),
+                      "cov": summary.get("cov")},
             device={"platform": "tpu"},  # summary of TPU-only trials
         )
         print(json.dumps(summary), flush=True)
@@ -1482,16 +1590,19 @@ def one_variant() -> None:
     from tmhpvsim_tpu.engine import Simulation
 
     name = os.environ.get("TMHPVSIM_BENCH_ONE_VARIANT", "scan-threefry")
+    # repro()'s distribution mode hands each trial its own seed; default
+    # matches _make_cfg's so a bare --one-variant stays byte-stable
+    seed = int(os.environ.get("TMHPVSIM_BENCH_SEED", "0"))
     n = N_CHAINS if platform == "tpu" else CPU_N_CHAINS
     nb, nr = (N_BLOCKS, N_ROUNDS) if platform == "tpu" else (CPU_N_BLOCKS, 1)
     kw = {k: v for k, v in VARIANT_CFGS[name].items() if k != "_probe"}
-    sim = Simulation(_make_cfg(n, nb * nr + 1, **kw))
+    sim = Simulation(_make_cfg(n, nb * nr + 1, seed=seed, **kw))
     c_s, dt, rate = _timed_reduce_run(sim, nb, nr)
     doc = {
         "variant": name, "platform": platform, "rate": round(rate, 1),
         "compile_s": round(c_s, 1), "best_round_wall_s": round(dt, 3),
         "block_ms": round(dt / nb * 1e3, 2), "n_chains": n,
-        "impl": _impl_label(sim),
+        "impl": _impl_label(sim), "seed": seed,
     }
     doc["run_report"] = _bench_report(
         "bench.one_variant", config=sim.config, plan=_plan_doc(sim.plan),
@@ -1633,8 +1744,10 @@ def main() -> None:
     ap.add_argument("--sweep", action="store_true")
     ap.add_argument("--profile", metavar="DIR")
     ap.add_argument("--repro", type=int, metavar="K",
-                    help="K fresh-process timed runs of the headline "
-                         "variant (compile-variance probe)")
+                    help="distribution mode: K fresh-process timed runs "
+                         "of the headline variant, one seed per run; "
+                         "summary reports min/median/max + CoV "
+                         "(compile-variance probe)")
     ap.add_argument("--one-variant", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--serve", type=int, metavar="N", default=None,

@@ -137,6 +137,9 @@ run_json () {  # run_json <dest.json> <label> <args...>
 }
 
 run_json benchmarks/HEADLINE_r05.json  headline2
+# --repro is now a distribution mode: six fresh-process compiles, one
+# seed per trial, summary with min/median/max + CoV (the compile-lottery
+# answer in one number)
 run_json benchmarks/REPRO_r05.jsonl    repro     --repro 6
 run_json benchmarks/BENCH_config4.json config4   --config 4
 run_json benchmarks/BENCH_config2.json config2   --config 2
@@ -219,6 +222,19 @@ for bench_doc in benchmarks/SERVE_*.json benchmarks/BENCH_*.json; do
   echo "--- resilience_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
   python tools/resilience_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- resilience_report: MALFORMED RESILIENCE SECTION $bench_doc rc=$?" >> "$LOG"
+done
+# precision sanity (non-fatal), same contract as the loops above: any
+# doc carrying a RunReport 'precision' or 'probe' section (schema v8 —
+# the compute_dtype/kernel_impl axes, their sweep pricing, the
+# resilience-wrapped backend-probe accounting) must carry a WELL-FORMED
+# one; default-precision docs just note the absence.  The headline doc
+# is included explicitly: it is where bench.py prices the levers.
+for bench_doc in benchmarks/HEADLINE_*.json benchmarks/REPRO_*.jsonl \
+                 benchmarks/SERVE_*.json benchmarks/BENCH_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- precision_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/precision_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- precision_report: MALFORMED PRECISION SECTION $bench_doc rc=$?" >> "$LOG"
 done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

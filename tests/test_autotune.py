@@ -45,7 +45,12 @@ def tmp_cache(tmp_path, monkeypatch):
 def fake_prober(monkeypatch):
     """Replace the real-block probe with a deterministic rater: the
     wide/unroll=4/unslabbed candidate wins (any fixed winner works —
-    the tests assert the CACHED plan equals the PROBED winner)."""
+    the tests assert the CACHED plan equals the PROBED winner).
+
+    Stage-2 precision probing is neutralised by collapsing the
+    candidate axes to their defaults — the sentinel gate runs real
+    mini-simulations, and these tests count structural-grid probes
+    only (precision probing has its own coverage in test_precision)."""
     def fake(config, plan, n_timed=autotune.PROBE_TIMED_BLOCKS):
         if (plan.block_impl == "wide" and plan.scan_unroll == 4
                 and plan.slab_chains == config.n_chains):
@@ -53,6 +58,8 @@ def fake_prober(monkeypatch):
         return 10.0 + plan.scan_unroll
 
     monkeypatch.setattr(autotune, "probe_plan", fake)
+    monkeypatch.setattr(autotune, "CANDIDATE_COMPUTE_DTYPES", ("f32",))
+    monkeypatch.setattr(autotune, "CANDIDATE_KERNEL_IMPLS", ("exact",))
     return fake
 
 

@@ -1,0 +1,300 @@
+"""Mixed-precision compute path + tabulated kernels (PR 9).
+
+Covers the two opt-in speed axes and their correctness gates:
+
+* every table/minimax kernel stays inside its PUBLISHED max-ULP bound
+  (``models/tables.MAX_ULP``) against the NumPy float64 reference over
+  the argument ranges the solar/pv chain actually produces
+  (``ARG_RANGES``);
+* a ``kernel_impl='table'`` run matches the exact run's end-of-run
+  reduce statistics to 1e-5 at FIELD SCALE — the published contract is
+  ``max|a-b| / max(max|a|, 1.0) <= 1e-5`` per stat field (per-element
+  denominators fail spuriously on extremal stats when a 64-ULP powc
+  perturbation switches which element wins an argmin);
+* a ``compute_dtype='bf16'`` run auto-escalates telemetry and a
+  doctored ensemble bias trips the drift sentinel under strict — the
+  safety chain bf16 rides on;
+* defaults lower BYTE-IDENTICALLY to explicit f32/exact pins (the new
+  axes cost nothing until asked for);
+* pre-axis autotuner cache entries load with the f32/exact defaults
+  (plan-cache back-compat) and malformed axis values are rejected;
+* double-buffered host output yields byte-equal blocks to the
+  non-overlapped path.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine import Simulation, autotune
+from tmhpvsim_tpu.models import clearsky_index as ci
+from tmhpvsim_tpu.models import tables
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.obs.sentinel import DriftError
+
+
+def small_cfg(**kw):
+    # 10:00 local start: the solar chain must see daylight, or the table
+    # kernels go unexercised and every comparison passes vacuously
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=7200,
+        n_chains=8,
+        seed=7,
+        block_s=3600,
+        dtype="float32",
+        block_impl="scan",
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level ULP bounds vs the float64 reference
+# ---------------------------------------------------------------------------
+
+def _ulp_err(got, ref64: np.ndarray) -> np.ndarray:
+    """Error in float32 ULPs at the f64 reference, ULP floored at 1.0's
+    (matches how the MAX_ULP bounds are published — tables.py)."""
+    ulp = np.maximum(np.spacing(np.abs(ref64).astype(np.float32)),
+                     np.spacing(np.float32(1.0)))
+    return np.abs(np.asarray(got, np.float64) - ref64) / ulp
+
+
+N_SAMPLES = 20_000
+
+
+class TestTableKernelULP:
+    @pytest.mark.parametrize("name", sorted(tables.MAX_ULP))
+    def test_within_published_bound(self, name):
+        k = tables.table_kernels(jnp)
+        rng = np.random.default_rng(0)
+        if name == "arctan2":
+            y = rng.uniform(-1e3, 1e3, N_SAMPLES).astype(np.float32)
+            x = rng.uniform(-1e3, 1e3, N_SAMPLES).astype(np.float32)
+            err = _ulp_err(k.arctan2(jnp.asarray(y), jnp.asarray(x)),
+                           np.arctan2(y.astype(np.float64),
+                                      x.astype(np.float64)))
+        elif name == "powc":
+            lo, hi = tables.ARG_RANGES[name]
+            x = rng.uniform(lo, hi, N_SAMPLES).astype(np.float32)
+            errs = [_ulp_err(k.powc(jnp.asarray(x), p),
+                             x.astype(np.float64) ** p)
+                    for p in (-1.7, -1.0, -0.5, -0.1)]
+            err = np.concatenate(errs)
+        elif name == "spencer_factor":
+            doy = np.arange(1, 367, dtype=np.float32)
+            err = _ulp_err(k.spencer_factor(jnp.asarray(doy)),
+                           tables._spencer_factor64(doy))
+        else:
+            lo, hi = tables.ARG_RANGES[name]
+            x = rng.uniform(lo, hi, N_SAMPLES).astype(np.float32)
+            err = _ulp_err(getattr(k, name)(jnp.asarray(x)),
+                           getattr(np, name)(x.astype(np.float64)))
+        worst = float(np.max(err))
+        assert worst <= tables.MAX_ULP[name], (
+            f"{name}: worst error {worst:.1f} ULP exceeds published "
+            f"bound {tables.MAX_ULP[name]}")
+
+    def test_exact_kernels_are_the_raw_ops(self):
+        # the byte-identity discipline rests on this: k.sin IS jnp.sin
+        k = tables.exact_kernels(jnp)
+        assert k.sin is jnp.sin and k.exp is jnp.exp
+        assert k.spencer_factor is None
+        # and the set is memoized, so the closure identity is stable
+        assert tables.exact_kernels(jnp) is k
+
+
+# ---------------------------------------------------------------------------
+# end-of-run reduce statistics: the 1e-5 field-scale contract
+# ---------------------------------------------------------------------------
+
+class TestReduceStatsContract:
+    def _acc(self, **kw):
+        sim = Simulation(small_cfg(**kw))
+        reduced = sim.run_reduced()
+        return sim, {k: np.asarray(v, np.float64)
+                     for k, v in reduced.items()}
+
+    def test_table_matches_exact_to_1e5_field_scale(self):
+        _, a = self._acc()
+        sim_t, b = self._acc(kernel_impl="table")
+        assert sim_t.plan.kernel_impl == "table"
+        # daylight guard: zero pv would make the comparison vacuous
+        assert float(np.sum(a["pv_sum"])) > 0.0
+        for name in a:
+            diff = float(np.max(np.abs(a[name] - b[name])))
+            scale = max(float(np.max(np.abs(a[name]))), 1.0)
+            assert diff / scale <= 1e-5, (
+                f"{name}: field-scale relerr {diff / scale:.3g} > 1e-5")
+
+    def test_bf16_scan_and_scan2_bit_identical(self):
+        # the draw plumbing hands compute_dtype straight to jax.random
+        # with identical fold_in structure on both scan topologies — the
+        # merge bit-exactness contract must survive bf16
+        _, a = self._acc(compute_dtype="bf16")
+        _, b = self._acc(compute_dtype="bf16", block_impl="scan2")
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name], err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# bf16 rides the sentinel: auto-escalation + strict trip
+# ---------------------------------------------------------------------------
+
+class TestBf16Sentinel:
+    def test_bf16_auto_escalates_telemetry(self):
+        sim = Simulation(small_cfg(compute_dtype="bf16"))
+        assert sim.plan.compute_dtype == "bf16"
+        assert sim.plan.telemetry == "light"  # was 'off' by default
+        # explicit levels are respected, never downgraded
+        sim2 = Simulation(small_cfg(compute_dtype="bf16",
+                                    telemetry="full"))
+        assert sim2.plan.telemetry == "full"
+
+    def test_doctored_bias_trips_strict_sentinel(self, monkeypatch):
+        orig = ci.csi_compose_step
+
+        def biased(tables_, x, carry, options, dtype=jnp.float32):
+            rc, csi, covered = orig(tables_, x, carry, options, dtype)
+            return rc, csi + jnp.asarray(0.5, csi.dtype), covered
+
+        monkeypatch.setattr(ci, "csi_compose_step", biased)
+        with use_registry(MetricsRegistry()):
+            sim = Simulation(small_cfg(compute_dtype="bf16",
+                                       telemetry_strict=True))
+            with pytest.raises(DriftError):
+                sim.run_reduced()
+
+    def test_run_report_precision_section(self):
+        with use_registry(MetricsRegistry()):
+            sim = Simulation(small_cfg(kernel_impl="table"))
+            sim.run_reduced()
+            doc = sim.run_report()
+        assert doc["schema_version"] >= 8
+        sec = doc["precision"]
+        assert sec["kernel_impl"] == "table"
+        assert sec["compute_dtype"] == "f32"
+        assert doc["plan"]["kernel_impl"] == "table"
+        # a defaults run writes NO precision section
+        with use_registry(MetricsRegistry()):
+            sim = Simulation(small_cfg())
+            sim.run_reduced()
+            assert sim.run_report()["precision"] is None
+
+
+# ---------------------------------------------------------------------------
+# defaults stay byte-identical
+# ---------------------------------------------------------------------------
+
+class TestDefaultHLOIdentity:
+    def _scan_text(self, cfg) -> str:
+        sim = Simulation(cfg)
+        sim.state = sim.init_state()
+        acc = sim.init_reduce_acc()
+        inputs, _ = sim.host_inputs(0)
+        return sim._scan_acc_jit.lower(sim.state, inputs, acc).as_text()
+
+    def test_defaults_lower_identical_to_explicit_pins(self):
+        a = self._scan_text(small_cfg())
+        b = self._scan_text(small_cfg(compute_dtype="f32",
+                                      kernel_impl="exact"))
+        assert a == b
+
+    def test_table_pin_changes_the_program(self):
+        # the inverse guard: if 'table' lowered identically to 'exact',
+        # the axis would be wired to nothing
+        a = self._scan_text(small_cfg())
+        b = self._scan_text(small_cfg(kernel_impl="table"))
+        assert a != b
+
+
+# ---------------------------------------------------------------------------
+# autotuner plan-cache back-compat
+# ---------------------------------------------------------------------------
+
+class TestPlanCacheBackCompat:
+    def cache_cfg(self, **kw):
+        base = dict(start="2019-09-05 10:00:00", duration_s=7200,
+                    n_chains=3, seed=7, block_s=3600, dtype="float32",
+                    tune="auto")
+        base.update(kw)
+        return SimConfig(**base)
+
+    def test_pre_axis_entry_loads_with_defaults(self, tmp_path,
+                                                monkeypatch):
+        path = str(tmp_path / "autotune.json")
+        monkeypatch.setenv("TMHPVSIM_AUTOTUNE_CACHE", path)
+        cfg = self.cache_cfg()
+        # a cache entry persisted before the precision axes existed
+        entry = {"plan": {"block_impl": "scan", "scan_unroll": 1,
+                          "stats_fusion": "split",
+                          "slab_chains": cfg.n_chains}}
+        with open(path, "w") as f:
+            json.dump({autotune.plan_key(cfg): entry}, f)
+        before = autotune.PROBE_COUNT
+        plan = autotune.resolve_plan(cfg)
+        assert autotune.PROBE_COUNT == before  # pure cache hit
+        assert plan.source == "cache"
+        assert plan.compute_dtype == "f32"
+        assert plan.kernel_impl == "exact"
+
+    def test_malformed_axis_values_rejected(self):
+        entry = {"plan": {"block_impl": "scan", "scan_unroll": 1,
+                          "stats_fusion": "split", "slab_chains": 3,
+                          "compute_dtype": "f16"}}
+        with pytest.raises(ValueError, match="malformed"):
+            autotune._plan_from_entry(entry)
+
+    def test_config_pin_overrides_cached_axis(self, tmp_path,
+                                              monkeypatch):
+        path = str(tmp_path / "autotune.json")
+        monkeypatch.setenv("TMHPVSIM_AUTOTUNE_CACHE", path)
+        cfg = self.cache_cfg(kernel_impl="table")
+        entry = {"plan": {"block_impl": "scan", "scan_unroll": 1,
+                          "stats_fusion": "split",
+                          "slab_chains": cfg.n_chains}}
+        with open(path, "w") as f:
+            json.dump({autotune.plan_key(cfg): entry}, f)
+        plan = autotune.resolve_plan(cfg)
+        assert plan.kernel_impl == "table"  # the pin wins over the cache
+
+    def test_broadcast_plan_round_trips_axes(self):
+        plan = autotune.static_plan(
+            self.cache_cfg(tune="off", compute_dtype="bf16",
+                           kernel_impl="table"))
+        out = autotune.broadcast_plan(plan)
+        assert out.compute_dtype == "bf16"
+        assert out.kernel_impl == "table"
+        assert out.telemetry != "off"  # escalation survives the decode
+
+
+# ---------------------------------------------------------------------------
+# double-buffered host output
+# ---------------------------------------------------------------------------
+
+class TestOutputOverlap:
+    @pytest.mark.parametrize("impl", ["wide", "scan"])
+    def test_overlap_matches_off_byte_for_byte(self, impl):
+        def blocks(**kw):
+            sim = Simulation(small_cfg(duration_s=4 * 3600,
+                                       block_impl=impl, **kw))
+            return list(sim.run_blocks())
+
+        on = blocks()                       # 'auto': overlapped
+        off = blocks(output_overlap="off")  # strictly serial
+        assert len(on) == len(off) == 4
+        for r_on, r_off in zip(on, off):
+            assert r_on.offset == r_off.offset
+            np.testing.assert_array_equal(r_on.epoch, r_off.epoch)
+            for field in ("meter", "pv", "residual"):
+                np.testing.assert_array_equal(getattr(r_on, field),
+                                              getattr(r_off, field),
+                                              err_msg=field)
+
+    def test_bad_overlap_value_rejected(self):
+        with pytest.raises(ValueError, match="output_overlap"):
+            Simulation(small_cfg(output_overlap="on"))

@@ -308,7 +308,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               run_report_path: Optional[str] = None,
               trace: Optional[str] = None,
               compile_cache: Optional[str] = None,
-              blocks_per_dispatch: int = 0) -> None:
+              blocks_per_dispatch: int = 0,
+              compute_dtype: str = "auto",
+              kernel_impl: str = "auto",
+              output_overlap: str = "auto") -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -350,6 +353,15 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     means).  The merged fleet summary lands in the report's ``fleet``
     section (schema v5).
 
+    ``compute_dtype`` ('auto'|'f32'|'bf16') and ``kernel_impl``
+    ('auto'|'exact'|'table') select the mixed-precision compute path and
+    the tabulated transcendental kernels (models/tables.py); bf16
+    auto-escalates ``telemetry='off'`` to 'light' so the drift sentinel
+    watches the run.  ``output_overlap`` ('auto'|'off') double-buffers
+    the trace/ensemble host gather against the next block's dispatch;
+    checkpointed runs force it off (the checkpoint writer gates on
+    ``state_block``, which pipelining breaks by design).
+
     ``trace`` records host-side per-block instants into the streaming
     tracer's ring (obs/trace.py) and exports Chrome-trace JSON there on
     exit; the pid is the real os.getpid(), so a jax.profiler device
@@ -379,6 +391,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 analytics=analytics,
                 trace=trace, tracer=tracer, compile_cache=compile_cache,
                 blocks_per_dispatch=blocks_per_dispatch,
+                compute_dtype=compute_dtype, kernel_impl=kernel_impl,
+                output_overlap=output_overlap,
             )
         except (Exception, KeyboardInterrupt):
             if tracer:
@@ -411,6 +425,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         fleet_sec = sim.fleet_summary()
         if fleet_sec is not None:
             rep.fleet = fleet_sec
+    if hasattr(sim, "precision_doc"):
+        prec = sim.precision_doc()
+        if prec is not None:
+            rep.precision = prec
     if profile_dir:
         rep.profile = read_manifest(profile_dir)
     if jax.process_count() > 1:
@@ -441,7 +459,10 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    trace: Optional[str] = None,
                    tracer: Optional[Tracer] = None,
                    compile_cache: Optional[str] = None,
-                   blocks_per_dispatch: int = 0):
+                   blocks_per_dispatch: int = 0,
+                   compute_dtype: str = "auto",
+                   kernel_impl: str = "auto",
+                   output_overlap: str = "auto"):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
     the wrapper can assemble the run report from its config/plan/timer."""
     import contextlib
@@ -500,6 +521,13 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         start = _dt.datetime.now().replace(microsecond=0).isoformat(" ")
     if block_s is None:
         block_s = min(8640, max(60, (duration_s // 60) * 60))
+    if checkpoint and output_overlap != "off":
+        # the checkpoint writer gates saves on ``sim.state_block ==
+        # block_index + 1``; the double buffer dispatches block N+1
+        # before block N is consumed, so every gate would miss — force
+        # the serial loop rather than silently skipping every save
+        output_overlap = "off"
+        logger.info("checkpointing disables output_overlap")
     cfg = SimConfig(
         start=start,
         duration_s=duration_s,
@@ -516,6 +544,9 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         analytics=analytics,
         trace=trace,
         blocks_per_dispatch=blocks_per_dispatch,
+        compute_dtype=compute_dtype,
+        kernel_impl=kernel_impl,
+        output_overlap=output_overlap,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation
@@ -527,9 +558,12 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
     plan = sim.plan
     logger.info(
         "plan [%s]: block_impl=%s scan_unroll=%d stats_fusion=%s "
-        "slab_chains=%d blocks_per_dispatch=%d", plan.source,
+        "slab_chains=%d blocks_per_dispatch=%d compute_dtype=%s "
+        "kernel_impl=%s", plan.source,
         plan.block_impl, plan.scan_unroll, plan.stats_fusion,
         plan.slab_chains, plan.blocks_per_dispatch,
+        getattr(plan, "compute_dtype", "f32"),
+        getattr(plan, "kernel_impl", "exact"),
     )
     if checkpoint and plan.slab_chains < cfg.n_chains:
         # a slabbed run has no single resumable state pytree; checkpointed

@@ -298,6 +298,29 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "under --tune); K > 1 runs K blocks as one jitted scan "
                    "— bit-identical results, fewer host round-trips "
                    "(config.SimConfig.blocks_per_dispatch)")
+@click.option("--compute-dtype", "compute_dtype",
+              type=click.Choice(["auto", "f32", "bf16"]),
+              default="auto",
+              help="Mixed-precision compute path (jax backend): bf16 "
+                   "narrows the per-second RNG streams + physics chain; "
+                   "accumulators/carry stay f32 and the drift sentinel "
+                   "gates it — telemetry auto-escalates to 'light' "
+                   "(config.SimConfig.compute_dtype)")
+@click.option("--kernel-impl", "kernel_impl",
+              type=click.Choice(["auto", "exact", "table"]),
+              default="auto",
+              help="Transcendental kernels for the solar/pv models (jax "
+                   "backend): exact = jnp ops (byte-identical HLO), "
+                   "table = minimax polynomials + day-of-year LUT, "
+                   "validated to published ULP bounds "
+                   "(config.SimConfig.kernel_impl, models/tables.py)")
+@click.option("--output-overlap", "output_overlap",
+              type=click.Choice(["auto", "off"]),
+              default="auto",
+              help="Double-buffered trace/ensemble host output (jax "
+                   "backend): overlap block N's gather/CSV with block "
+                   "N+1's device dispatch; forced off by --checkpoint "
+                   "(config.SimConfig.output_overlap)")
 @click.option("--supervise", "supervise", type=int, default=0,
               metavar="N",
               help="Run as a supervised child and warm-restart it on a "
@@ -310,7 +333,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           block_s, site_grid_spec, sites_csv, profile_dir, output,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
           analytics, metrics_path, run_report_path, compile_cache,
-          blocks_per_dispatch, supervise, chaos, chaos_seed):
+          blocks_per_dispatch, compute_dtype, kernel_impl, output_overlap,
+          supervise, chaos, chaos_seed):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     _maybe_supervise("pvsim", supervise)
@@ -340,6 +364,12 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
     if blocks_per_dispatch != 0 and backend != "jax":
         raise click.UsageError("--blocks-per-dispatch requires "
                                "--backend=jax")
+    if compute_dtype != "auto" and backend != "jax":
+        raise click.UsageError("--compute-dtype requires --backend=jax")
+    if kernel_impl != "auto" and backend != "jax":
+        raise click.UsageError("--kernel-impl requires --backend=jax")
+    if output_overlap != "auto" and backend != "jax":
+        raise click.UsageError("--output-overlap requires --backend=jax")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
@@ -381,7 +411,9 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   metrics_path=metrics_path,
                   run_report_path=run_report_path,
                   trace=trace, compile_cache=compile_cache,
-                  blocks_per_dispatch=blocks_per_dispatch)
+                  blocks_per_dispatch=blocks_per_dispatch,
+                  compute_dtype=compute_dtype, kernel_impl=kernel_impl,
+                  output_overlap=output_overlap)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

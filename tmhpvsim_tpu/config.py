@@ -263,6 +263,23 @@ class Plan:
     #: entries never persist it (engine/autotune.py re-applies the
     #: config's request on every cache hit).
     analytics: str = "off"
+    #: resolved compute dtype for the per-second stream/physics path:
+    #: 'f32' (the historical behaviour — byte-identical HLO) | 'bf16'
+    #: (pre-drawn RNG streams, shared-site geometry and the PV physics
+    #: chain run in bfloat16; all accumulators — reduce stats,
+    #: TelemetryAcc, FleetAcc — and the csi/renewal scan carry stay
+    #: f32/int32, so merges remain bit-exact and the drift sentinel vs
+    #: the f64 golden mirror stays the correctness gate).  The autotuner
+    #: may only select 'bf16' when the sentinel passes on the probe
+    #: (engine/autotune.py).
+    compute_dtype: str = "f32"
+    #: resolved transcendental-kernel implementation for the solar/pv
+    #: models: 'exact' (jnp's libm-equivalent ops — byte-identical HLO)
+    #: | 'table' (minimax polynomials + the day-of-year lookup table,
+    #: models/tables.py; validated against the f64 golden to published
+    #: max-ULP bounds and to 1e-5 on end-of-run reduce stats).  Same
+    #: sentinel gate as ``compute_dtype`` under the autotuner.
+    kernel_impl: str = "exact"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -393,6 +410,39 @@ class SimConfig:
     #: quality is equivalent for Monte-Carlo use; all parity/KS tests pass
     #: under either (the golden model is seeded numpy, not stream-matched).
     prng_impl: str = "threefry2x32"
+
+    #: compute dtype for the per-second stream/physics path.  'auto'
+    #: resolves to 'f32' (the historical path, byte-identical HLO) unless
+    #: the autotuner's sentinel-gated probe selects 'bf16'; 'f32'/'bf16'
+    #: pin it.  bf16 halves the HBM bytes of the pre-drawn RNG streams
+    #: and the shared-site geometry and runs the PV physics chain in
+    #: bfloat16 — accumulators (reduce stats, TelemetryAcc, FleetAcc)
+    #: and the csi/renewal scan carry ALWAYS stay f32/int32, so slab /
+    #: shard / fused-dispatch merges remain bit-exact and the PR-3 drift
+    #: sentinel vs the f64 golden mirror remains the correctness gate.
+    #: Requesting bf16 with ``telemetry='off'`` auto-escalates telemetry
+    #: to 'light' so the sentinel actually watches the run.
+    compute_dtype: str = "auto"
+
+    #: transcendental-kernel implementation for the solar/pv models.
+    #: 'auto' resolves to 'exact' (jnp sin/cos/exp/log/arccos —
+    #: byte-identical HLO) unless the autotuner's sentinel-gated probe
+    #: selects 'table'; 'exact'/'table' pin it.  'table' swaps the
+    #: irradiance chain's transcendentals for minimax polynomials plus a
+    #: 366-entry day-of-year lookup table (models/tables.py), validated
+    #: against the f64 golden to published max-ULP bounds and to 1e-5 on
+    #: end-of-run reduce stats (tests/test_precision.py).
+    kernel_impl: str = "auto"
+
+    #: double-buffered host output for the trace/blocks loop
+    #: (engine/simulation.py ``_iter_blocks``): 'auto' overlaps device
+    #: dispatch of block N+1 with the host gather/CSV/telemetry flush of
+    #: block N (donation-safe: only the carried state is donated, never
+    #: the gathered outputs); 'off' keeps the strictly serial historical
+    #: loop.  Checkpointed runs force 'off' (apps/pvsim.py): a
+    #: checkpoint writer gates on ``state_block == block_index + 1``,
+    #: which pipelining breaks by design.  Reduce mode is unaffected.
+    output_overlap: str = "auto"
 
     #: in-graph numerics telemetry (obs/telemetry.py): 'off' (telemetry
     #: structurally absent from the traced graph — byte-identical HLO to

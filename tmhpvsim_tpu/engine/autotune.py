@@ -58,6 +58,18 @@ CANDIDATE_SLAB_CHAINS = (None, 65536, 16384)
 #: ``blocks_per_dispatch``), probed as a fourth grid axis when
 #: ``SimConfig.blocks_per_dispatch`` is left 0 (auto)
 CANDIDATE_BLOCKS_PER_DISPATCH = (1, 4)
+#: precision axes (config.Plan ``compute_dtype`` / ``kernel_impl``).
+#: NOT part of the base candidate product: the staged search in
+#: :func:`probe_grid` first picks the structural winner at the resolved
+#: precision, then probes precision variants of that winner only — and a
+#: non-default variant may win only when the drift sentinel passes on a
+#: strict-telemetry gate run (exact/f32 is never silently replaced).
+CANDIDATE_COMPUTE_DTYPES = ("f32", "bf16")
+CANDIDATE_KERNEL_IMPLS = ("exact", "table")
+
+#: chains/blocks of the sentinel gate run (small: it pays a compile)
+SENTINEL_GATE_CHAINS = 4096
+SENTINEL_GATE_BLOCKS = 4
 
 #: steady blocks timed per probe (after the one compile/warm-up block)
 PROBE_TIMED_BLOCKS = 2
@@ -122,21 +134,56 @@ def _resolve_impl(config: SimConfig) -> str:
     )
 
 
+def _resolve_compute_dtype(config: SimConfig) -> str:
+    cdt = getattr(config, "compute_dtype", "auto")
+    if cdt == "auto":
+        return "f32"  # the tuner's staged probe may still pick bf16
+    if cdt in ("f32", "bf16"):
+        return cdt
+    raise ValueError(
+        f"compute_dtype must be 'auto', 'f32' or 'bf16', got {cdt!r}"
+    )
+
+
+def _resolve_kernel_impl(config: SimConfig) -> str:
+    ki = getattr(config, "kernel_impl", "auto")
+    if ki == "auto":
+        return "exact"  # the tuner's staged probe may still pick 'table'
+    if ki in ("exact", "table"):
+        return ki
+    raise ValueError(
+        f"kernel_impl must be 'auto', 'exact' or 'table', got {ki!r}"
+    )
+
+
+def _escalate_telemetry(level: str, compute_dtype: str) -> str:
+    """bf16 must never run unwatched: an 'off' telemetry request
+    escalates to 'light' whenever the mixed-precision path is active, so
+    the drift sentinel vs the f64 golden mirror stays the correctness
+    gate (SimConfig.compute_dtype docstring)."""
+    if compute_dtype == "bf16" and level == "off":
+        return "light"
+    return level
+
+
 def static_plan(config: SimConfig) -> Plan:
     """The un-measured plan: 'auto' knobs resolved by backend heuristic
     (scan+fused on accelerators, wide+split on CPU — the historical
     behaviour), no slabbing."""
+    cdt = _resolve_compute_dtype(config)
     return Plan(
         block_impl=_resolve_impl(config),
         scan_unroll=config.scan_unroll,
         stats_fusion=_resolve_fusion(config),
         slab_chains=config.n_chains,
         source="static",
-        telemetry=_resolve_telemetry(config),
+        telemetry=_escalate_telemetry(_resolve_telemetry(config), cdt),
         analytics=_resolve_analytics(config),
         # 0 (auto) resolves to per-block dispatch without measurement;
         # the fused dispatch only enters statically when pinned
         blocks_per_dispatch=max(1, config.blocks_per_dispatch),
+        compute_dtype=cdt,
+        kernel_impl=_resolve_kernel_impl(config),
     )
 
 
@@ -267,12 +314,18 @@ def candidate_plans(config: SimConfig, slabs: bool = True) -> list:
     # pinned block_impl
     kds = (CANDIDATE_BLOCKS_PER_DISPATCH if config.blocks_per_dispatch == 0
            else (max(1, config.blocks_per_dispatch),))
-    telemetry = _resolve_telemetry(config)
     analytics = _resolve_analytics(config)
+    # the base grid runs at the RESOLVED precision ('auto' -> f32/exact):
+    # precision variants are probed as a second stage on the structural
+    # winner only (probe_grid), not as a 4x product blow-up here
+    cdt = _resolve_compute_dtype(config)
+    ki = _resolve_kernel_impl(config)
+    telemetry = _escalate_telemetry(_resolve_telemetry(config), cdt)
     return [
         Plan(block_impl=impl, scan_unroll=u, stats_fusion=fusion,
              slab_chains=slab, source="probe", telemetry=telemetry,
-             analytics=analytics, blocks_per_dispatch=kd)
+             analytics=analytics, blocks_per_dispatch=kd,
+             compute_dtype=cdt, kernel_impl=ki)
         for impl in impls
         for u in CANDIDATE_UNROLLS
         for slab in slab_sizes
@@ -280,8 +333,97 @@ def candidate_plans(config: SimConfig, slabs: bool = True) -> list:
     ]
 
 
+def _candidate_record(plan: Plan) -> dict:
+    return {
+        "block_impl": plan.block_impl,
+        "scan_unroll": plan.scan_unroll,
+        "stats_fusion": plan.stats_fusion,
+        "slab_chains": plan.slab_chains,
+        "blocks_per_dispatch": plan.blocks_per_dispatch,
+        "compute_dtype": plan.compute_dtype,
+        "kernel_impl": plan.kernel_impl,
+    }
+
+
+def _sentinel_gate(config: SimConfig, plan: Plan) -> bool:
+    """True when a short strict-telemetry run of ``plan`` passes the
+    drift sentinel (obs/sentinel.py) against the f64 golden reference.
+
+    The probe path (``time_reduce_blocks``) drives ``step_acc`` directly
+    and never reaches ``_observe_telemetry``, so a performance probe
+    alone would never trip the sentinel — this explicit gate runs a
+    small ``run_reduced`` with ``telemetry_strict`` so a numerically
+    unsound bf16/table candidate raises :class:`DriftError` instead of
+    silently winning on speed.  Any non-DriftError failure also fails
+    the gate (a candidate that cannot complete the gate run must not be
+    selected)."""
+    from tmhpvsim_tpu.engine.simulation import Simulation
+    from tmhpvsim_tpu.obs.sentinel import DriftError
+
+    n = min(config.n_chains, plan.slab_chains, SENTINEL_GATE_CHAINS)
+    gcfg = dataclasses.replace(
+        config,
+        tune="off",
+        n_chains=n,
+        n_chains_total=None,
+        chain_offset=0,
+        site_grid=slice_grid(config.site_grid, 0, n),
+        duration_s=config.block_s * SENTINEL_GATE_BLOCKS,
+        output="reduce",
+        telemetry="light",
+        telemetry_strict=True,
+        blocks_per_dispatch=1,
+    )
+    gplan = dataclasses.replace(plan, slab_chains=n, telemetry="light",
+                                analytics="off", blocks_per_dispatch=1)
+    try:
+        sim = Simulation(gcfg, plan=gplan)
+        sim.run_reduced()
+    except DriftError as e:
+        logger.warning("autotune sentinel gate REJECTED %s/%s: %s",
+                       plan.compute_dtype, plan.kernel_impl, e)
+        return False
+    except Exception as e:
+        logger.warning("autotune sentinel gate failed to run for %s/%s "
+                       "(%s); candidate rejected", plan.compute_dtype,
+                       plan.kernel_impl, e)
+        return False
+    finally:
+        sim = None  # free device buffers before the next candidate
+    return True
+
+
+def _precision_variants(config: SimConfig, winner: Plan) -> list:
+    """Stage-2 candidates: the structural winner with each non-default
+    precision combination the config leaves to the tuner ('auto' axes
+    only — an explicit pin is respected like a pinned block_impl)."""
+    cdts = (CANDIDATE_COMPUTE_DTYPES
+            if getattr(config, "compute_dtype", "auto") == "auto"
+            else (winner.compute_dtype,))
+    kis = (CANDIDATE_KERNEL_IMPLS
+           if getattr(config, "kernel_impl", "auto") == "auto"
+           else (winner.kernel_impl,))
+    out = []
+    for cdt in cdts:
+        for ki in kis:
+            if (cdt, ki) == (winner.compute_dtype, winner.kernel_impl):
+                continue
+            out.append(dataclasses.replace(
+                winner, compute_dtype=cdt, kernel_impl=ki,
+                telemetry=_escalate_telemetry(winner.telemetry, cdt)))
+    return out
+
+
 def probe_grid(config: SimConfig, slabs: bool = True) -> tuple:
     """Time every candidate plan; returns (best plan, candidate records).
+
+    Two stages: the structural grid (block_impl x scan_unroll x slab x
+    blocks_per_dispatch) probed at the config's resolved precision, then
+    precision variants (``compute_dtype`` / ``kernel_impl``) of the
+    stage-1 winner only.  A variant must first pass
+    :func:`_sentinel_gate` — the default exact/f32 path is never
+    silently replaced by a candidate the drift sentinel has not cleared,
+    no matter how fast it probes.
 
     A candidate that fails to compile/run is recorded with its error and
     skipped; if every candidate fails the static plan is returned so a
@@ -290,15 +432,10 @@ def probe_grid(config: SimConfig, slabs: bool = True) -> tuple:
     global PROBE_COUNT, LAST_PROBE_COMPILE_S
     best = None
     records = []
-    for plan in candidate_plans(config, slabs=slabs):
+
+    def probe_one(plan, rec):
+        global PROBE_COUNT, LAST_PROBE_COMPILE_S
         PROBE_COUNT += 1
-        rec = {
-            "block_impl": plan.block_impl,
-            "scan_unroll": plan.scan_unroll,
-            "stats_fusion": plan.stats_fusion,
-            "slab_chains": plan.slab_chains,
-            "blocks_per_dispatch": plan.blocks_per_dispatch,
-        }
         LAST_PROBE_COMPILE_S = None
         try:
             rate = probe_plan(config, plan)
@@ -306,21 +443,38 @@ def probe_grid(config: SimConfig, slabs: bool = True) -> tuple:
             logger.warning("autotune candidate %s failed: %s", rec, e)
             rec["error"] = str(e)[:200]
             records.append(rec)
-            continue
+            return None
         rec["rate"] = round(rate, 1)
         if LAST_PROBE_COMPILE_S is not None:
             # cache-warm when the persistent compile cache is on
             rec["compile_s"] = round(LAST_PROBE_COMPILE_S, 3)
         records.append(rec)
-        logger.info("autotune probe impl=%s unroll=%d slab=%d kd=%d: "
-                    "%.3g site-s/s", plan.block_impl, plan.scan_unroll,
-                    plan.slab_chains, plan.blocks_per_dispatch, rate)
-        if best is None or rate > best[1]:
+        logger.info("autotune probe impl=%s unroll=%d slab=%d kd=%d "
+                    "dtype=%s kernels=%s: %.3g site-s/s", plan.block_impl,
+                    plan.scan_unroll, plan.slab_chains,
+                    plan.blocks_per_dispatch, plan.compute_dtype,
+                    plan.kernel_impl, rate)
+        return rate
+
+    for plan in candidate_plans(config, slabs=slabs):
+        rate = probe_one(plan, _candidate_record(plan))
+        if rate is not None and (best is None or rate > best[1]):
             best = (plan, rate)
     if best is None:
         logger.warning("every autotune candidate failed; falling back to "
                        "the static plan")
         return static_plan(config), records
+    # stage 2: sentinel-gated precision variants of the winner
+    for plan in _precision_variants(config, best[0]):
+        rec = _candidate_record(plan)
+        if not _sentinel_gate(config, plan):
+            rec["sentinel"] = "fail"
+            records.append(rec)
+            continue
+        rec["sentinel"] = "pass"
+        rate = probe_one(plan, rec)
+        if rate is not None and rate > best[1]:
+            best = (plan, rate)
     return best[0], records
 
 
@@ -371,11 +525,17 @@ def _plan_from_entry(entry: dict) -> Plan:
         # entries persisted before the fused dispatch existed have no
         # blocks_per_dispatch key; they keep meaning per-block dispatch
         blocks_per_dispatch=int(p.get("blocks_per_dispatch", 1)),
+        # entries persisted before the precision axes existed keep
+        # meaning the historical exact/f32 path
+        compute_dtype=str(p.get("compute_dtype", "f32")),
+        kernel_impl=str(p.get("kernel_impl", "exact")),
     )
     if plan.block_impl not in ("wide", "scan", "scan2") or \
             plan.stats_fusion not in ("fused", "split") or \
             plan.scan_unroll < 1 or plan.slab_chains < 1 or \
-            plan.blocks_per_dispatch < 1:
+            plan.blocks_per_dispatch < 1 or \
+            plan.compute_dtype not in ("f32", "bf16") or \
+            plan.kernel_impl not in ("exact", "table"):
         raise ValueError(f"malformed cached plan {p!r}")
     return plan
 
@@ -393,6 +553,8 @@ def _store_plan(path: str, key: str, plan: Plan, candidates: list) -> None:
                 "stats_fusion": plan.stats_fusion,
                 "slab_chains": plan.slab_chains,
                 "blocks_per_dispatch": plan.blocks_per_dispatch,
+                "compute_dtype": plan.compute_dtype,
+                "kernel_impl": plan.kernel_impl,
             },
             "candidates": candidates,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -404,6 +566,8 @@ def _store_plan(path: str, key: str, plan: Plan, candidates: list) -> None:
                     and c.get("slab_chains") == plan.slab_chains
                     and c.get("blocks_per_dispatch",
                               1) == plan.blocks_per_dispatch
+                    and c.get("compute_dtype", "f32") == plan.compute_dtype
+                    and c.get("kernel_impl", "exact") == plan.kernel_impl
                     and c.get("compile_s") is not None):
                 entry["compile_s"] = c["compile_s"]
                 break
@@ -457,10 +621,10 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
                 # cache entries never persist telemetry/analytics (not
                 # tuned knobs); re-apply this config's request.  An
                 # explicit blocks_per_dispatch pin (>= 1) also overrides
-                # whatever an earlier auto probe persisted under this key.
+                # whatever an earlier auto probe persisted under this
+                # key, as do explicit (non-'auto') precision pins.
                 plan = dataclasses.replace(
                     _plan_from_entry(entry),
-                    telemetry=_resolve_telemetry(config),
                     analytics=_resolve_analytics(config),
                 )
                 if config.blocks_per_dispatch >= 1:
@@ -468,16 +632,30 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
                         plan,
                         blocks_per_dispatch=config.blocks_per_dispatch,
                     )
-                return plan
+                if getattr(config, "compute_dtype", "auto") != "auto":
+                    plan = dataclasses.replace(
+                        plan, compute_dtype=_resolve_compute_dtype(config))
+                if getattr(config, "kernel_impl", "auto") != "auto":
+                    plan = dataclasses.replace(
+                        plan, kernel_impl=_resolve_kernel_impl(config))
+                # telemetry escalation must see the FINAL compute_dtype
+                # (a cached bf16 winner escalates an 'off' request too)
+                return dataclasses.replace(
+                    plan,
+                    telemetry=_escalate_telemetry(
+                        _resolve_telemetry(config), plan.compute_dtype),
+                )
             except (KeyError, TypeError, ValueError) as e:
                 logger.warning("ignoring malformed autotune cache entry "
                                "for %s: %s", key, e)
     plan, candidates = probe_grid(config, slabs=slabs)
     if plan.source == "probe":  # don't cache the all-failed fallback
         _store_plan(path, key, plan, candidates)
-    return dataclasses.replace(plan,
-                               telemetry=_resolve_telemetry(config),
-                               analytics=_resolve_analytics(config))
+    return dataclasses.replace(
+        plan,
+        telemetry=_escalate_telemetry(_resolve_telemetry(config),
+                                      plan.compute_dtype),
+        analytics=_resolve_analytics(config))
 
 
 def broadcast_plan(plan: Plan) -> Plan:
@@ -493,10 +671,13 @@ def broadcast_plan(plan: Plan) -> Plan:
 
     impls = ("wide", "scan", "scan2")
     fusions = ("split", "fused")
+    dtypes = ("f32", "bf16")
+    kimpls = ("exact", "table")
     enc = np.asarray([
         impls.index(plan.block_impl), plan.scan_unroll,
         plan.slab_chains, fusions.index(plan.stats_fusion),
         plan.blocks_per_dispatch,
+        dtypes.index(plan.compute_dtype), kimpls.index(plan.kernel_impl),
     ], dtype=np.int32)
     out = np.asarray(multihost_utils.broadcast_one_to_all(enc))
     source = plan.source if jax.process_index() == 0 else "broadcast"
@@ -506,10 +687,14 @@ def broadcast_plan(plan: Plan) -> Plan:
         stats_fusion=fusions[int(out[3])],
         slab_chains=int(out[2]),
         source=source,
-        # not broadcast: every process resolved the same config locally
-        telemetry=plan.telemetry,
+        # telemetry IS broadcast-sensitive through the winner's dtype:
+        # process 0's bf16 pick must escalate 'off' on every host
+        telemetry=_escalate_telemetry(plan.telemetry,
+                                      dtypes[int(out[5])]),
         analytics=plan.analytics,
         blocks_per_dispatch=int(out[4]),
+        compute_dtype=dtypes[int(out[5])],
+        kernel_impl=kimpls[int(out[6])],
     )
 
 

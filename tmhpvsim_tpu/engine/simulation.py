@@ -58,6 +58,7 @@ from tmhpvsim_tpu.models import clearsky_index as ci
 from tmhpvsim_tpu.models import pv as pvmod
 from tmhpvsim_tpu.models import renewal
 from tmhpvsim_tpu.models import solar
+from tmhpvsim_tpu.models import tables as _tables
 from tmhpvsim_tpu.models.timegrid import TimeGridSpec
 
 
@@ -224,6 +225,29 @@ class Simulation:
         )
         self.feats = ci.HostFeatures.from_spec(self.spec)
         self.dtype = jnp.dtype(config.dtype)
+        #: mixed-precision compute path (Plan.compute_dtype): bf16
+        #: applies to the pre-drawn per-second RNG streams, the
+        #: shared-site geometry shipped by host_inputs and the csi handed
+        #: to the physics chain; the scan carry, the time inputs and
+        #: every accumulator stay f32/int32 (merge bit-exactness + the
+        #: drift sentinel remain the correctness gate).  getattr: plans
+        #: rebuilt from pre-precision cache entries predate the fields.
+        self._mixed = getattr(self.plan, "compute_dtype", "f32") == "bf16"
+        self._compute_dtype = (jnp.dtype(jnp.bfloat16) if self._mixed
+                               else self.dtype)
+        #: transcendental-kernel set for the solar/pv models
+        #: (models/tables.py Plan.kernel_impl); None makes every model
+        #: call trace the raw jnp ops — byte-identical historical HLO.
+        self._kernels = (_tables.table_kernels(jnp)
+                         if getattr(self.plan, "kernel_impl",
+                                    "exact") == "table" else None)
+        #: double-buffered trace output (_iter_blocks): overlap the host
+        #: gather of block N with device dispatch of block N+1
+        ov = getattr(config, "output_overlap", "auto")
+        if ov not in ("auto", "off"):
+            raise ValueError(
+                f"output_overlap must be 'auto' or 'off', got {ov!r}")
+        self._output_overlap = ov != "off"
         self.n_blocks = self._padded_s // config.block_s
         self._n_minute_vals = None  # fixed after first block (constant shape)
         # Static per-block sampler-window sizes (windowed arrays: the state
@@ -555,13 +579,18 @@ class Simulation:
             },
         }
         if cfg.site_grid is None:
-            # shared site: exact float64 geometry on the host, cast once
+            # shared site: exact float64 geometry on the host, cast once.
+            # Under the mixed path the cast target is bf16 (except doy,
+            # whose integer-day semantics feed the Spencer term/LUT and
+            # must survive exactly) so the physics chain's type promotion
+            # stays in the compute dtype instead of silently widening.
             geom64 = solar.block_geometry(
                 blk.epoch.astype(np.float64), blk.doy.astype(np.float64),
                 cfg.site, xp=np,
             )
             inputs["geom"] = {
-                k: (np.asarray(v, self.dtype)
+                k: (np.asarray(v, self.dtype if k == "doy"
+                               else self._compute_dtype)
                     if isinstance(v, np.ndarray) else v)
                 for k, v in geom64.items()
             }
@@ -614,6 +643,20 @@ class Simulation:
         )
         return arrays, mvals, cc_carry
 
+    def _narrow_geom(self, geom):
+        """Device-geometry dict narrowed to the compute dtype (mixed
+        path; identity otherwise).  Geometry is always EVALUATED in f32
+        — split-time inputs would not survive bf16's 8-bit mantissa —
+        and only the result narrows, so the per-chain physics promotes
+        to bf16 instead of silently widening back.  ``doy`` keeps its
+        exact integer-valued representation (Spencer term / LUT index).
+        """
+        if not self._mixed:
+            return geom
+        cd = self._compute_dtype
+        return {k: (v if k == "doy" else v.astype(cd))
+                for k, v in geom.items()}
+
     def _block_step(self, state, inputs):
         """(state, inputs) -> (state', meter, pv), all on device.
 
@@ -654,7 +697,9 @@ class Simulation:
                     site["latitude"], site["longitude"], site["altitude"],
                     site["surface_tilt"], site["surface_azimuth"],
                     site["albedo"], turbidity, xp=jnp,
+                    kernels=self._kernels,
                 )
+                geom = self._narrow_geom(geom)
             arrays, mvals, cc_carry = self._windows_one_chain(chain, inputs)
             carry, csi, _covered = ci.csi_scan_block(
                 chain["k_scan"], arrays, mvals, mlo,
@@ -662,9 +707,16 @@ class Simulation:
                 unroll=self._unroll,
                 cloudy_pair=chain["cloudy_pair"],
             )
+            if self._mixed:
+                csi = csi.astype(self._compute_dtype)
             ac = pvmod.power_from_csi(
-                csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
+                csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=jnp,
+                kernels=self._kernels,
             )
+            if self._mixed:
+                # back to the carry/accumulator dtype: every downstream
+                # contract (stats fold, traces, telemetry) stays f32
+                ac = ac.astype(dtype)
             # one hash per global minute + counter-mode 60-draws: see
             # ci.csi_scan_block on why (threefry cost dominates the block)
             meter = ci.meter_block(
@@ -836,8 +888,13 @@ class Simulation:
             # slot s % 60 of group s // 60 — exactly block_s // 60 groups
             g0 = t[0] // 60
             n_groups = t.shape[0] // 60
+            # the u/z streams are the scan path's only (n_chains, block_s)
+            # HBM materialisation; the mixed path halves their footprint.
+            # The meter stream stays f32: its ensemble mean is checked
+            # against a tight analytic band (obs/sentinel.py) that a
+            # quantised uniform could escape.
             u_T, z_T = ci.scan_draws_tmajor(state["k_scan"], g0, n_groups,
-                                            dtype)
+                                            self._compute_dtype)
             meter_T = ci.meter_block_tmajor(
                 state["k_meter"], g0, n_groups, cfg.meter_max_w, dtype
             )
@@ -879,14 +936,21 @@ class Simulation:
                     site["latitude"], site["longitude"], site["altitude"],
                     site["surface_tilt"], site["surface_azimuth"],
                     site["albedo"], turbidity, xp=jnp,
+                    kernels=self._kernels,
                 )
+                g = self._narrow_geom(g)
             else:
                 g = dict(geom_const, **x["geom"])
+            # mixed path: the physics chain runs in the compute dtype;
+            # telemetry still folds the f32 csi (``extras`` below)
+            csi_c = csi.astype(self._compute_dtype) if self._mixed else csi
             # astype: under jax_enable_x64 (test/golden envs) numpy-f64
             # physics constants weakly promote ac, which would break the
-            # scan-carry type contract; on TPU (x32) this is a no-op
+            # scan-carry type contract; on TPU (x32) this is a no-op —
+            # and the mixed path's widening back to the carry dtype
             ac = pvmod.power_from_csi(
-                csi, g, SAPM_MODULE, SANDIA_INVERTER, xp=jnp
+                csi_c, g, SAPM_MODULE, SANDIA_INVERTER, xp=jnp,
+                kernels=self._kernels,
             ).astype(dtype)
             if with_extras:
                 return (rc, x["meter"].astype(dtype), ac,
@@ -1211,6 +1275,11 @@ class Simulation:
         per minute."""
         cfg = self.config
         dtype = self.dtype
+        # mixed path: u/z tiles in the compute dtype (same keyed slots as
+        # scan_draws_tmajor at the same dtype, so scan/scan2 stay
+        # bit-identical to each other); the meter tile stays f32 like the
+        # flat scan's meter stream (_scan_block_setup)
+        cdt = self._compute_dtype
         n_min = xs["t"].shape[0] // 60
         g0 = xs["t"][0] // 60
         xs_t = jax.tree.map(
@@ -1225,9 +1294,9 @@ class Simulation:
             def draws(k):
                 kg = jax.random.fold_in(k, g)
                 u = jax.random.uniform(jax.random.fold_in(kg, 0), (60,),
-                                       dtype)
+                                       cdt)
                 z = jax.random.normal(jax.random.fold_in(kg, 1), (60,),
-                                      dtype)
+                                      cdt)
                 return u, z
 
             u, z = jax.vmap(draws, out_axes=1)(k_scan)       # (60, chains)
@@ -1873,7 +1942,19 @@ class Simulation:
         the same ``make_result`` calls.  ``self.state`` then only
         advances at megablock boundaries; consumers that checkpoint it
         after a yielded block MUST gate on ``self.state_block ==
-        block_index + 1`` (apps/pvsim.py does)."""
+        block_index + 1`` (apps/pvsim.py does).
+
+        ``SimConfig.output_overlap='auto'`` (and per-block dispatch)
+        double-buffers the host side: block N+1 is DISPATCHED before
+        block N's outputs are gathered/yielded, so the device computes
+        N+1 while the host runs ``make_result`` + the consumer's
+        CSV/telemetry work on N.  Donation-safe by construction — only
+        the carried state is donated (argnum 0), never the (a, b)
+        outputs, so the deferred gather reads buffers dispatch N+1
+        cannot alias.  The same checkpoint gate keeps pipelining out of
+        checkpointed runs: while block N is being consumed
+        ``state_block`` is already N+2 (apps/pvsim.py also pins
+        ``output_overlap='off'`` when checkpointing)."""
         cfg = self.config
         jit = self._block_jit if block_jit is None else block_jit
         state = self.init_state() if state is None \
@@ -1882,13 +1963,29 @@ class Simulation:
         self.state = state
         self.state_block = start_block
         pf = InputPrefetcher(self, start_block, self.n_blocks)
-        # No dispatch-ahead here: consumers checkpoint ``self.state`` after
-        # processing the yielded block (apps/pvsim.py), so the state must
-        # always correspond to the last yielded MEGABLOCK.  Host/device
-        # overlap comes from the input prefetcher + async jax dispatch.
+        # No dispatch-ahead BEYOND the one-block double buffer: consumers
+        # checkpoint ``self.state`` after processing the yielded block
+        # (apps/pvsim.py), so the state must always correspond to the
+        # last yielded MEGABLOCK (or the overlap must be off).  Further
+        # host/device overlap comes from the input prefetcher + async
+        # jax dispatch.
         self.timer.reset_clock()
         k = self._k_dispatch
         try:
+            if k == 1 and self._output_overlap:
+                pend = None  # previous block's un-gathered device outputs
+                for bi in range(start_block, self.n_blocks):
+                    inputs, epoch = pf.get(bi)
+                    with annotate("tmhpvsim/block_step"):
+                        self.state, a, b = jit(self.state, inputs)
+                    self.state_block = bi + 1
+                    self._m_dispatch.inc()
+                    if pend is not None:
+                        yield self._gather_result(pend, make_result)
+                    pend = (bi, epoch, a, b)
+                if pend is not None:
+                    yield self._gather_result(pend, make_result)
+                return
             bi = start_block
             while bi < self.n_blocks:
                 kk = min(k, self.n_blocks - bi)
@@ -1930,6 +2027,21 @@ class Simulation:
                 bi += kk
         finally:
             pf.close()
+
+    def _gather_result(self, pend, make_result):
+        """Finish one double-buffered block: gather the deferred device
+        outputs (the make_result host sync), tick the timer — which under
+        overlap measures gather-to-gather, the pipelined steady state —
+        and hand the BlockResult back to ``_iter_blocks``."""
+        bi, epoch, a, b = pend
+        cfg = self.config
+        off = bi * cfg.block_s
+        n_valid = min(cfg.block_s, cfg.duration_s - off)
+        result = make_result(off, np.asarray(epoch[:n_valid]), a, b,
+                             n_valid)
+        self.timer.tick()
+        self._m_blocks.inc()
+        return result
 
     def _trace_result(self, off, epoch, meter, pv, n_valid) -> BlockResult:
         """Per-chain gather: the trace-mode ``make_result``."""
@@ -2193,6 +2305,21 @@ class Simulation:
             out[name] = (int if dkind == "i" else float)(np_op[kind](v))
         return out
 
+    def precision_doc(self):
+        """The report's ``precision`` section when a non-default lever is
+        active (``compute_dtype``/``kernel_impl``), else None — reports
+        written by app code and by :meth:`run_report` must agree."""
+        cdt = getattr(self.plan, "compute_dtype", "f32")
+        kimpl = getattr(self.plan, "kernel_impl", "exact")
+        if cdt == "f32" and kimpl == "exact":
+            return None
+        return {
+            "compute_dtype": cdt,
+            "kernel_impl": kimpl,
+            "telemetry": self.plan.telemetry,
+            "output_overlap": bool(self._output_overlap),
+        }
+
     def run_report(self, app: str = "engine", path=None, headline=None):
         """The run's :class:`~tmhpvsim_tpu.obs.report.RunReport`: config,
         the resolved plan, the internal timer's compile/steady split, and
@@ -2210,6 +2337,9 @@ class Simulation:
         fleet_sec = self.fleet_summary()
         if fleet_sec is not None:
             rep.fleet = fleet_sec
+        prec = self.precision_doc()
+        if prec is not None:
+            rep.precision = prec
         rep.headline = headline if headline is not None else {
             "site_seconds_per_s": summary["site_seconds_per_s"],
         }

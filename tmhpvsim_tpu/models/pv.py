@@ -22,6 +22,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from tmhpvsim_tpu.models import tables as _tables
+
 DEG = np.pi / 180.0
 BOLTZMANN = 1.380649e-23  # J/K
 ELEM_CHARGE = 1.602176634e-19  # C
@@ -29,25 +31,27 @@ T0_C = 25.0  # SAPM reference cell temperature
 
 
 def sapm_cell_temp(poa_global, module, wind_speed=0.0, temp_air_c=20.0,
-                   xp=jnp):
+                   xp=jnp, kernels=None):
     """SAPM back-of-module + cell temperature [C].
 
         T_mod  = POA * exp(a + b*wind) + T_amb
         T_cell = T_mod + POA/1000 * deltaT
     """
-    t_mod = poa_global * xp.exp(module["T_a"] + module["T_b"] * wind_speed) \
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
+    t_mod = poa_global * k.exp(module["T_a"] + module["T_b"] * wind_speed) \
         + temp_air_c
     return t_mod + poa_global / 1000.0 * module["T_deltaT"]
 
 
 def sapm_effective_irradiance(poa_direct, poa_diffuse, airmass_abs, cos_aoi,
-                              module, xp=jnp):
+                              module, xp=jnp, kernels=None):
     """SAPM effective irradiance in suns (reference irradiance 1000 W/m^2).
 
         F1(AMa) = A0 + A1*AMa + ... + A4*AMa^4     (spectral modifier)
         F2(AOI) = B0 + B1*AOI + ... + B5*AOI^5     (AOI in degrees)
         Ee = F1 * (Eb * F2 + FD * Ed) / 1000
     """
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     ama = airmass_abs
     f1 = (
         module["A0"]
@@ -56,7 +60,7 @@ def sapm_effective_irradiance(poa_direct, poa_diffuse, airmass_abs, cos_aoi,
         + module["A3"] * ama**3
         + module["A4"] * ama**4
     )
-    aoi_deg = xp.arccos(xp.clip(cos_aoi, -1.0, 1.0)) / DEG
+    aoi_deg = k.arccos(xp.clip(cos_aoi, -1.0, 1.0)) / DEG
     f2 = (
         module["B0"]
         + module["B1"] * aoi_deg
@@ -70,7 +74,7 @@ def sapm_effective_irradiance(poa_direct, poa_diffuse, airmass_abs, cos_aoi,
     return xp.maximum(ee, 0.0)
 
 
-def sapm_dc(effective_irradiance, temp_cell_c, module, xp=jnp):
+def sapm_dc(effective_irradiance, temp_cell_c, module, xp=jnp, kernels=None):
     """SAPM max-power point: returns dict(i_mp, v_mp, p_mp).
 
     King et al. 2004 eq. 3-5 with the thermal-voltage log terms; Ee in suns.
@@ -78,6 +82,7 @@ def sapm_dc(effective_irradiance, temp_cell_c, module, xp=jnp):
     NaN'd — reference reaches the same end state via fillna(0) at
     pvmodel.py:80).
     """
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     ee = effective_irradiance
     dt = temp_cell_c - T0_C
     ns = module["Cells_in_Series"]
@@ -86,7 +91,7 @@ def sapm_dc(effective_irradiance, temp_cell_c, module, xp=jnp):
     delta = module["N"] * BOLTZMANN * (temp_cell_c + 273.15) / ELEM_CHARGE
 
     pos = ee > 0.0
-    log_ee = xp.log(xp.where(pos, ee, 1.0))
+    log_ee = k.log(xp.where(pos, ee, 1.0))
 
     i_mp = (
         module["Impo"]
@@ -126,7 +131,7 @@ def sandia_inverter_ac(v_dc, p_dc, inverter, xp=jnp):
     return xp.where(p_dc < inverter["Pso"], -xp.abs(inverter["Pnt"]), ac)
 
 
-def power_from_csi(csi, geom, module, inverter, xp=jnp):
+def power_from_csi(csi, geom, module, inverter, xp=jnp, kernels=None):
     """Clear-sky index -> AC watts, given precomputed block geometry.
 
     The chain-dependent half of the reference's ``populate_cache``
@@ -137,23 +142,28 @@ def power_from_csi(csi, geom, module, inverter, xp=jnp):
     Steps: zenith-cap clip of csi -> GHI = csi*GHI_clear -> DISC DNI ->
     DHI closure -> Hay-Davies POA -> SAPM temp/Ee/DC -> Sandia AC ->
     clip(>=0) & NaN->0.
+
+    ``kernels`` selects the transcendental implementation for the whole
+    chain (models/tables.py); ``None`` traces the raw ``xp`` ops.
     """
     from tmhpvsim_tpu.models import solar
 
     csi = xp.minimum(csi, geom["csi_cap"])
     ghi = csi * geom["ghi_clear"]
-    dni = solar.disc_dni(ghi, geom["zenith"], geom["doy"], xp=xp)
+    dni = solar.disc_dni(ghi, geom["zenith"], geom["doy"], xp=xp,
+                         kernels=kernels)
     dhi = xp.maximum(ghi - dni * geom["cos_zenith"], 0.0)
 
     poa = solar.haydavies_poa(
         geom["surface_tilt"], geom["cos_aoi"], geom["apparent_zenith"],
         ghi, dni, dhi, geom["dni_extra"], albedo=geom["albedo"], xp=xp,
+        kernels=kernels,
     )
-    t_cell = sapm_cell_temp(poa["poa_global"], module, xp=xp)
+    t_cell = sapm_cell_temp(poa["poa_global"], module, xp=xp, kernels=kernels)
     ee = sapm_effective_irradiance(
         poa["poa_direct"], poa["poa_diffuse"], geom["airmass_abs"],
-        geom["cos_aoi"], module, xp=xp,
+        geom["cos_aoi"], module, xp=xp, kernels=kernels,
     )
-    dc = sapm_dc(ee, t_cell, module, xp=xp)
+    dc = sapm_dc(ee, t_cell, module, xp=xp, kernels=kernels)
     ac = sandia_inverter_ac(dc["v_mp"], dc["p_mp"], inverter, xp=xp)
     return xp.maximum(ac, 0.0)

@@ -36,6 +36,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from tmhpvsim_tpu.models import tables as _tables
+
 TWO_PI = 2.0 * np.pi
 DEG = np.pi / 180.0
 
@@ -56,7 +58,7 @@ def alt2pres(altitude_m):
     return STD_PRESSURE * (1.0 - 2.25577e-5 * altitude_m) ** 5.25588
 
 
-def sun_position(epoch_s, latitude_deg, longitude_deg, xp=jnp):
+def sun_position(epoch_s, latitude_deg, longitude_deg, xp=jnp, kernels=None):
     """PSA+ sun position at UTC epoch seconds.
 
     ``epoch_s`` MUST be float64 (or int64): absolute epoch seconds (~1.7e9)
@@ -74,6 +76,10 @@ def sun_position(epoch_s, latitude_deg, longitude_deg, xp=jnp):
       ``cos_zenith``  cos of the true zenith
 
     Coefficients: Blanco et al. 2020 update of the PSA ephemeris.
+
+    ``kernels`` selects the transcendental implementation (models/tables.py);
+    ``None`` binds the raw ``xp`` ops — byte-identical traces to the
+    pre-axis code.
     """
     dt_ = np.dtype(getattr(epoch_s, "dtype", np.float64))
     if dt_.kind == "f" and dt_.itemsize < 8:
@@ -81,6 +87,7 @@ def sun_position(epoch_s, latitude_deg, longitude_deg, xp=jnp):
             "sun_position requires float64/int64 epoch seconds; float32 "
             "quantizes absolute epochs to >±64 s (see docstring)"
         )
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     lat = latitude_deg * DEG
     lon = longitude_deg * DEG
 
@@ -94,48 +101,48 @@ def sun_position(epoch_s, latitude_deg, longitude_deg, xp=jnp):
     mean_anom = 6.239468336e0 + 1.720200135e-2 * te
     ecl_lon = (
         mean_lon
-        + 3.338320972e-2 * xp.sin(mean_anom)
-        + 3.497596876e-4 * xp.sin(2.0 * mean_anom)
+        + 3.338320972e-2 * k.sin(mean_anom)
+        + 3.497596876e-4 * k.sin(2.0 * mean_anom)
         - 1.544353226e-4
-        - 8.689729360e-6 * xp.sin(omega)
+        - 8.689729360e-6 * k.sin(omega)
     )
     obliquity = (
-        4.090904909e-1 - 6.213605399e-9 * te + 4.418094944e-5 * xp.cos(omega)
+        4.090904909e-1 - 6.213605399e-9 * te + 4.418094944e-5 * k.cos(omega)
     )
 
     # Celestial coordinates.
-    sin_l = xp.sin(ecl_lon)
-    ra = xp.arctan2(xp.cos(obliquity) * sin_l, xp.cos(ecl_lon)) % TWO_PI
-    dec = xp.arcsin(xp.sin(obliquity) * sin_l)
+    sin_l = k.sin(ecl_lon)
+    ra = k.arctan2(k.cos(obliquity) * sin_l, k.cos(ecl_lon)) % TWO_PI
+    dec = k.arcsin(k.sin(obliquity) * sin_l)
 
     # Local hour angle from Greenwich mean sidereal time.
     gmst_h = 6.697096103e0 + 6.570984737e-2 * te + hour_ut
     lmst = gmst_h * 15.0 * DEG + lon
     ha = lmst - ra
 
-    cos_lat, sin_lat = xp.cos(lat), xp.sin(lat)
-    cos_dec, sin_dec = xp.cos(dec), xp.sin(dec)
-    cos_ha = xp.cos(ha)
+    cos_lat, sin_lat = k.cos(lat), k.sin(lat)
+    cos_dec, sin_dec = k.cos(dec), k.sin(dec)
+    cos_ha = k.cos(ha)
 
     cos_zen = cos_lat * cos_ha * cos_dec + sin_dec * sin_lat
     cos_zen = xp.clip(cos_zen, -1.0, 1.0)
-    zenith = xp.arccos(cos_zen)
-    azimuth = xp.arctan2(
-        -xp.sin(ha), xp.tan(dec) * cos_lat - sin_lat * cos_ha
+    zenith = k.arccos(cos_zen)
+    azimuth = k.arctan2(
+        -k.sin(ha), k.tan(dec) * cos_lat - sin_lat * cos_ha
     ) % TWO_PI
 
     # Parallax correction (sun observed from the surface, not the geocenter).
-    zenith = zenith + _PARALLAX * xp.sin(zenith)
+    zenith = zenith + _PARALLAX * k.sin(zenith)
 
     return {
         "zenith": zenith,
         "azimuth": azimuth,
-        "cos_zenith": xp.cos(zenith),
+        "cos_zenith": k.cos(zenith),
     }
 
 
 def sun_position_split(day2000, sec_of_day, latitude_deg, longitude_deg,
-                       xp=jnp):
+                       xp=jnp, kernels=None):
     """PSA+ sun position from a float32-safe *split* time representation.
 
     ``day2000`` = whole days since 2000-01-01 00:00 UT (int or float,
@@ -149,6 +156,7 @@ def sun_position_split(day2000, sec_of_day, latitude_deg, longitude_deg,
 
     Same return dict as :func:`sun_position`.
     """
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     lat = latitude_deg * DEG
     lon = longitude_deg * DEG
 
@@ -164,17 +172,17 @@ def sun_position_split(day2000, sec_of_day, latitude_deg, longitude_deg,
     mean_anom = lin(6.239468336e0, 1.720200135e-2)
     ecl_lon = (
         mean_lon
-        + 3.338320972e-2 * xp.sin(mean_anom)
-        + 3.497596876e-4 * xp.sin(2.0 * mean_anom)
+        + 3.338320972e-2 * k.sin(mean_anom)
+        + 3.497596876e-4 * k.sin(2.0 * mean_anom)
         - 1.544353226e-4
-        - 8.689729360e-6 * xp.sin(omega)
+        - 8.689729360e-6 * k.sin(omega)
     )
     obliquity = lin(4.090904909e-1, -6.213605399e-9) \
-        + 4.418094944e-5 * xp.cos(omega)
+        + 4.418094944e-5 * k.cos(omega)
 
-    sin_l = xp.sin(ecl_lon)
-    ra = xp.arctan2(xp.cos(obliquity) * sin_l, xp.cos(ecl_lon)) % TWO_PI
-    dec = xp.arcsin(xp.sin(obliquity) * sin_l)
+    sin_l = k.sin(ecl_lon)
+    ra = k.arctan2(k.cos(obliquity) * sin_l, k.cos(ecl_lon)) % TWO_PI
+    dec = k.arcsin(k.sin(obliquity) * sin_l)
 
     # gmst hours: keep the large day product in its own mod-24 reduction
     gmst_h = (6.697096103e0 + 6.570984737e-2 * day2000) % 24.0 \
@@ -182,26 +190,26 @@ def sun_position_split(day2000, sec_of_day, latitude_deg, longitude_deg,
     lmst = gmst_h * 15.0 * DEG + lon
     ha = lmst - ra
 
-    cos_lat, sin_lat = xp.cos(lat), xp.sin(lat)
-    cos_dec, sin_dec = xp.cos(dec), xp.sin(dec)
-    cos_ha = xp.cos(ha)
+    cos_lat, sin_lat = k.cos(lat), k.sin(lat)
+    cos_dec, sin_dec = k.cos(dec), k.sin(dec)
+    cos_ha = k.cos(ha)
 
     cos_zen = cos_lat * cos_ha * cos_dec + sin_dec * sin_lat
     cos_zen = xp.clip(cos_zen, -1.0, 1.0)
-    zenith = xp.arccos(cos_zen)
-    azimuth = xp.arctan2(
-        -xp.sin(ha), xp.tan(dec) * cos_lat - sin_lat * cos_ha
+    zenith = k.arccos(cos_zen)
+    azimuth = k.arctan2(
+        -k.sin(ha), k.tan(dec) * cos_lat - sin_lat * cos_ha
     ) % TWO_PI
-    zenith = zenith + _PARALLAX * xp.sin(zenith)
+    zenith = zenith + _PARALLAX * k.sin(zenith)
     return {
         "zenith": zenith,
         "azimuth": azimuth,
-        "cos_zenith": xp.cos(zenith),
+        "cos_zenith": k.cos(zenith),
     }
 
 
 def apparent_elevation(zenith, pressure=STD_PRESSURE, temperature_c=12.0,
-                       xp=jnp):
+                       xp=jnp, kernels=None):
     """Refraction-corrected elevation [rad] from true zenith.
 
     The NREL SPA atmospheric-refraction correction (Reda & Andreas 2004
@@ -213,46 +221,57 @@ def apparent_elevation(zenith, pressure=STD_PRESSURE, temperature_c=12.0,
     applied only while the top limb of the sun is above the horizon
     (e >= -0.26667 - 0.5667 deg); expressed branchlessly with ``where``.
     """
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     e_deg = (np.pi / 2.0 - zenith) / DEG
     p_mbar = pressure / 100.0
     de = (
         (p_mbar / 1010.0)
         * (283.0 / (273.0 + temperature_c))
         * 1.02
-        / (60.0 * xp.tan((e_deg + 10.3 / (e_deg + 5.11)) * DEG))
+        / (60.0 * k.tan((e_deg + 10.3 / (e_deg + 5.11)) * DEG))
     )
     de = xp.where(e_deg >= -(0.26667 + 0.5667), de, 0.0)
     return (e_deg + de) * DEG
 
 
-def relative_airmass_kasten_young(apparent_zenith, xp=jnp):
+def relative_airmass_kasten_young(apparent_zenith, xp=jnp, kernels=None):
     """Kasten & Young 1989 relative airmass from apparent zenith [rad].
 
     pvlib returns NaN past 90 deg; here the zenith is clamped just below the
     pole of the formula instead — downstream use is always multiplied by a
     night mask, and NaNs are poison on TPU.
     """
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     z_deg = xp.clip(apparent_zenith / DEG, 0.0, 90.0)
     return 1.0 / (
-        xp.cos(z_deg * DEG) + 0.50572 * (96.07995 - z_deg) ** -1.6364
+        k.cos(z_deg * DEG) + 0.50572 * k.powc(96.07995 - z_deg, -1.6364)
     )
 
 
-def relative_airmass_kasten1966(zenith, xp=jnp):
+def relative_airmass_kasten1966(zenith, xp=jnp, kernels=None):
     """Kasten 1966 relative airmass (the DISC model's fit airmass)."""
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     z_deg = xp.clip(zenith / DEG, 0.0, 93.0)
-    return 1.0 / (xp.cos(z_deg * DEG) + 0.15 * (93.885 - z_deg) ** -1.253)
+    return 1.0 / (k.cos(z_deg * DEG) + 0.15 * k.powc(93.885 - z_deg, -1.253))
 
 
-def extra_radiation_spencer(doy, solar_constant=SOLAR_CONSTANT, xp=jnp):
-    """Spencer 1971 extraterrestrial normal irradiance for day-of-year."""
+def extra_radiation_spencer(doy, solar_constant=SOLAR_CONSTANT, xp=jnp,
+                            kernels=None):
+    """Spencer 1971 extraterrestrial normal irradiance for day-of-year.
+
+    With table kernels the four transcendentals collapse to one gather
+    from the 366-entry day-of-year LUT (models/tables.py SPENCER_LUT).
+    """
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
+    if k.spencer_factor is not None:
+        return solar_constant * k.spencer_factor(doy)
     b = TWO_PI * (doy - 1.0) / 365.0
     factor = (
         1.00011
-        + 0.034221 * xp.cos(b)
-        + 0.00128 * xp.sin(b)
-        + 0.000719 * xp.cos(2.0 * b)
-        + 7.7e-5 * xp.sin(2.0 * b)
+        + 0.034221 * k.cos(b)
+        + 0.00128 * k.sin(b)
+        + 0.000719 * k.cos(2.0 * b)
+        + 7.7e-5 * k.sin(2.0 * b)
     )
     return solar_constant * factor
 
@@ -279,18 +298,19 @@ def linke_turbidity(doy, monthly, xp=jnp):
 
 
 def ineichen_ghi(apparent_zenith, airmass_absolute, tl, altitude_m,
-                 dni_extra, xp=jnp):
+                 dni_extra, xp=jnp, kernels=None):
     """Ineichen & Perez 2002 clear-sky GHI [W/m^2].
 
     Same formulation the reference evaluates via Location.get_clearsky
     (pvmodel.py:60): altitude-corrected coefficients and Linke-turbidity
     attenuation (no Perez enhancement factor — see NOTE below).
     """
-    fh1 = xp.exp(-altitude_m / 8000.0)
-    fh2 = xp.exp(-altitude_m / 1250.0)
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
+    fh1 = k.exp(-altitude_m / 8000.0)
+    fh2 = k.exp(-altitude_m / 1250.0)
     cg1 = 5.09e-5 * altitude_m + 0.868
     cg2 = 3.92e-5 * altitude_m + 0.0387
-    cos_zen = xp.maximum(xp.cos(apparent_zenith), 0.0)
+    cos_zen = xp.maximum(k.cos(apparent_zenith), 0.0)
     # NOTE: the classical Perez enhancement factor exp(0.01*am^1.8) is
     # deliberately absent — pvlib disables it by default since 0.6.0, so the
     # reference's Location.get_clearsky path never applies it.
@@ -298,12 +318,12 @@ def ineichen_ghi(apparent_zenith, airmass_absolute, tl, altitude_m,
         cg1
         * dni_extra
         * cos_zen
-        * xp.exp(-cg2 * airmass_absolute * (fh1 + fh2 * (tl - 1.0)))
+        * k.exp(-cg2 * airmass_absolute * (fh1 + fh2 * (tl - 1.0)))
     )
     return xp.maximum(ghi, 0.0)
 
 
-def csi_zenith_cap(zenith, xp=jnp):
+def csi_zenith_cap(zenith, xp=jnp, kernels=None):
     """Physical upper bound on the clear-sky index as a function of zenith.
 
     The reference clips csi to ``27.21*exp(-114*cos z) + 1.665*exp(-4.494*
@@ -311,9 +331,10 @@ def csi_zenith_cap(zenith, xp=jnp):
     Bright et al. model): near-overhead sun admits csi only slightly above 1,
     while low sun admits large cloud-enhancement spikes.
     """
-    cos_z = xp.cos(zenith)
-    cap = (27.21 * xp.exp(-114.0 * cos_z)
-           + 1.665 * xp.exp(-4.494 * cos_z) + 1.08)
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
+    cos_z = k.cos(zenith)
+    cap = (27.21 * k.exp(-114.0 * cos_z)
+           + 1.665 * k.exp(-4.494 * cos_z) + 1.08)
     # Below the horizon the fit explodes (exp(90) ~ 1e39 at night), which
     # overflows the float32 cast on device.  The cap's only consumer is
     # ``minimum(csi, cap)`` and csi stays O(1), so any ceiling >> the
@@ -321,21 +342,22 @@ def csi_zenith_cap(zenith, xp=jnp):
     return xp.minimum(cap, 1e6)
 
 
-def disc_dni(ghi, zenith, doy, xp=jnp):
+def disc_dni(ghi, zenith, doy, xp=jnp, kernels=None):
     """Maxwell 1987 DISC: direct normal irradiance from GHI [W/m^2].
 
     Matches the reference's ``pvlib.irradiance.disc(ghi, zenith, times)``
     (pvmodel.py:63): Kasten 1966 airmass at standard pressure, kt clipped to
     [0, 2], zenith validity limit 87 deg.
     """
-    i0 = extra_radiation_spencer(doy, DISC_SOLAR_CONSTANT, xp=xp)
-    cos_zen = xp.cos(zenith)
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
+    i0 = extra_radiation_spencer(doy, DISC_SOLAR_CONSTANT, xp=xp, kernels=k)
+    cos_zen = k.cos(zenith)
     # 0.065 = pvlib's min_cos_zenith for kt (disc default since 0.6.0):
     # keeps kt bounded through the 86.3-87 deg twilight band
     i0h = i0 * xp.maximum(cos_zen, 0.065)
 
     kt = xp.clip(ghi / i0h, 0.0, 2.0)
-    am = relative_airmass_kasten1966(zenith, xp=xp)
+    am = relative_airmass_kasten1966(zenith, xp=xp, kernels=k)
 
     kt2 = kt * kt
     kt3 = kt2 * kt
@@ -359,7 +381,7 @@ def disc_dni(ghi, zenith, doy, xp=jnp):
     )
     # exponent clamped: past the 87-deg validity limit c*am can overflow
     # float32 before the validity mask zeroes the result
-    delta_kn = a + b * xp.exp(xp.minimum(c * am, 40.0))
+    delta_kn = a + b * k.exp(xp.minimum(c * am, 40.0))
     dni = (knc - delta_kn) * i0
 
     valid = (zenith < 87.0 * DEG) & (ghi > 0.0)
@@ -367,29 +389,31 @@ def disc_dni(ghi, zenith, doy, xp=jnp):
 
 
 def angle_of_incidence_cos(surface_tilt_deg, surface_azimuth_deg, zenith,
-                           azimuth, xp=jnp):
+                           azimuth, xp=jnp, kernels=None):
     """cos(AOI) between the sun vector and the panel normal (unclipped)."""
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     tilt = surface_tilt_deg * DEG
     saz = surface_azimuth_deg * DEG
     return (
-        xp.cos(tilt) * xp.cos(zenith)
-        + xp.sin(tilt) * xp.sin(zenith) * xp.cos(azimuth - saz)
+        k.cos(tilt) * k.cos(zenith)
+        + k.sin(tilt) * k.sin(zenith) * k.cos(azimuth - saz)
     )
 
 
 def haydavies_poa(surface_tilt_deg, cos_aoi, zenith, ghi, dni, dhi,
-                  dni_extra, albedo=0.25, xp=jnp):
+                  dni_extra, albedo=0.25, xp=jnp, kernels=None):
     """Hay & Davies 1980 plane-of-array irradiance + isotropic ground.
 
     Matches PVSystem.get_irradiance's default transposition in the reference
     (pvmodel.py:66-68).  Returns dict with poa_direct / poa_diffuse /
     poa_global.
     """
+    k = kernels if kernels is not None else _tables.exact_kernels(xp)
     tilt = surface_tilt_deg * DEG
-    cos_tilt = xp.cos(tilt)
+    cos_tilt = k.cos(tilt)
 
     rb_num = xp.maximum(cos_aoi, 0.0)
-    rb_den = xp.maximum(xp.cos(zenith), 0.01745)  # pvlib's 89-deg floor
+    rb_den = xp.maximum(k.cos(zenith), 0.01745)  # pvlib's 89-deg floor
     rb = rb_num / rb_den
 
     ai = dni / dni_extra  # anisotropy index
@@ -407,7 +431,7 @@ def haydavies_poa(surface_tilt_deg, cos_aoi, zenith, ghi, dni, dhi,
 
 def device_geometry(day2000, sec_of_day, doy, latitude_deg, longitude_deg,
                     altitude_m, surface_tilt_deg, surface_azimuth_deg,
-                    albedo, turbidity_monthly, xp=jnp):
+                    albedo, turbidity_monthly, xp=jnp, kernels=None):
     """All geometry features from split time + scalar site parameters —
     float32-safe, jit/vmap-friendly (the per-chain site-grid path).
 
@@ -415,27 +439,29 @@ def device_geometry(day2000, sec_of_day, doy, latitude_deg, longitude_deg,
     shared.  Returns the same dict as :func:`block_geometry`.
     """
     pos = sun_position_split(day2000, sec_of_day, latitude_deg,
-                             longitude_deg, xp=xp)
+                             longitude_deg, xp=xp, kernels=kernels)
     pressure = alt2pres(altitude_m)
-    app_elev = apparent_elevation(pos["zenith"], pressure, xp=xp)
+    app_elev = apparent_elevation(pos["zenith"], pressure, xp=xp,
+                                  kernels=kernels)
     app_zen = np.pi / 2.0 - app_elev
 
-    am_rel = relative_airmass_kasten_young(app_zen, xp=xp)
+    am_rel = relative_airmass_kasten_young(app_zen, xp=xp, kernels=kernels)
     am_abs = am_rel * pressure / STD_PRESSURE
 
-    dni_extra = extra_radiation_spencer(doy, xp=xp)
+    dni_extra = extra_radiation_spencer(doy, xp=xp, kernels=kernels)
     tl = linke_turbidity(doy, turbidity_monthly, xp=xp)
     ghi_clear = ineichen_ghi(app_zen, am_abs, tl, altitude_m, dni_extra,
-                             xp=xp)
+                             xp=xp, kernels=kernels)
     cos_aoi = angle_of_incidence_cos(
-        surface_tilt_deg, surface_azimuth_deg, app_zen, pos["azimuth"], xp=xp
+        surface_tilt_deg, surface_azimuth_deg, app_zen, pos["azimuth"], xp=xp,
+        kernels=kernels
     )
     return {
         "zenith": pos["zenith"],
         "cos_zenith": pos["cos_zenith"],
         "apparent_zenith": app_zen,
         "azimuth": pos["azimuth"],
-        "csi_cap": csi_zenith_cap(pos["zenith"], xp=xp),
+        "csi_cap": csi_zenith_cap(pos["zenith"], xp=xp, kernels=kernels),
         "ghi_clear": ghi_clear,
         "dni_extra": dni_extra,
         "airmass_abs": am_abs,
@@ -446,7 +472,7 @@ def device_geometry(day2000, sec_of_day, doy, latitude_deg, longitude_deg,
     }
 
 
-def block_geometry(epoch_s, doy, site, xp=jnp):
+def block_geometry(epoch_s, doy, site, xp=jnp, kernels=None):
     """All chain-independent solar/irradiance features for a time block.
 
     One evaluation per block serves every chain (the csi stream is the only
@@ -459,28 +485,31 @@ def block_geometry(epoch_s, doy, site, xp=jnp):
       ghi_clear, dni_extra, airmass_abs, cos_aoi, doy,
       surface_tilt, albedo
     """
-    pos = sun_position(epoch_s, site.latitude, site.longitude, xp=xp)
+    pos = sun_position(epoch_s, site.latitude, site.longitude, xp=xp,
+                       kernels=kernels)
     pressure = alt2pres(site.altitude)
-    app_elev = apparent_elevation(pos["zenith"], pressure, xp=xp)
+    app_elev = apparent_elevation(pos["zenith"], pressure, xp=xp,
+                                  kernels=kernels)
     app_zen = np.pi / 2.0 - app_elev
 
-    am_rel = relative_airmass_kasten_young(app_zen, xp=xp)
+    am_rel = relative_airmass_kasten_young(app_zen, xp=xp, kernels=kernels)
     am_abs = am_rel * pressure / STD_PRESSURE
 
-    dni_extra = extra_radiation_spencer(doy, xp=xp)
+    dni_extra = extra_radiation_spencer(doy, xp=xp, kernels=kernels)
     tl = linke_turbidity(doy, site.linke_turbidity_monthly, xp=xp)
     ghi_clear = ineichen_ghi(app_zen, am_abs, tl, site.altitude, dni_extra,
-                             xp=xp)
+                             xp=xp, kernels=kernels)
 
     cos_aoi = angle_of_incidence_cos(
-        site.surface_tilt, site.surface_azimuth, app_zen, pos["azimuth"], xp=xp
+        site.surface_tilt, site.surface_azimuth, app_zen, pos["azimuth"],
+        xp=xp, kernels=kernels
     )
     return {
         "zenith": pos["zenith"],
         "cos_zenith": pos["cos_zenith"],
         "apparent_zenith": app_zen,
         "azimuth": pos["azimuth"],
-        "csi_cap": csi_zenith_cap(pos["zenith"], xp=xp),
+        "csi_cap": csi_zenith_cap(pos["zenith"], xp=xp, kernels=kernels),
         "ghi_clear": ghi_clear,
         "dni_extra": dni_extra,
         "airmass_abs": am_abs,

@@ -1,0 +1,380 @@
+"""Tabulated / minimax transcendental kernels for the solar→pv chain.
+
+BENCH_r05's roofline section attributes the raw-speed gap to the
+transcendental-heavy irradiance chain (sin/cos/arccos/exp/log per
+chain-second at ~390 flops/site-s, 1.4 GFLOP/s achieved).  This module
+provides two interchangeable kernel sets behind the ``kernel_impl``
+plan axis:
+
+* :func:`exact_kernels` — every attribute is *literally* the ``xp``
+  libm-equivalent op (``xp.sin`` is ``jnp.sin`` itself, not a wrapper),
+  so model code written against a :class:`KernelSet` traces to the
+  byte-identical jaxpr/HLO it produced before the axis existed.  This
+  is the default and the correctness reference.
+* :func:`table_kernels` — low-degree minimax polynomials (Cody–Waite
+  argument reduction, cephes-derived coefficients) plus a genuine
+  366-entry day-of-year lookup table for the Spencer extraterrestrial-
+  radiation series.  All internal arithmetic is float32 regardless of
+  the input dtype (bf16 inputs are up-cast on entry), which both bounds
+  the error and keeps the bit-twiddling (``2**k`` by exponent-field
+  construction) well-defined.
+
+Published error bounds
+----------------------
+
+``MAX_ULP`` maps kernel name → the maximum error of the table kernel
+measured against a NumPy float64 reference, in float32 ULPs under the
+metric::
+
+    err_ulp = |table - ref64| / max(spacing32(|ref64|), spacing32(1.0))
+
+i.e. ULPs at the reference value with a floor of one ULP-at-1.0 so the
+bound stays meaningful at the zeros of sin/log/…  The bounds hold over
+the argument ranges the simulation actually exercises, published in
+``ARG_RANGES`` and enforced by ``tests/test_precision.py``.
+``spencer_factor`` additionally quantises its argument to the nearest
+integral day-of-year (that is the point of the table); the bound is
+stated at integral ``doy``, which is what the engine passes.
+
+The end-to-end contract (BASELINE): a full ``kernel_impl='table'`` run
+must match the exact-kernel reduce stats to 1e-5 relative, and the
+PR-3 drift sentinel vs the f64 golden mirror must stay green — the
+autotuner only selects ``table`` when the sentinel passes on the probe.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+try:  # pragma: no cover - exercised indirectly everywhere
+    import jax
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover - CPU-only envs without jax
+    jax = None
+    jnp = None
+
+__all__ = [
+    "KernelSet",
+    "exact_kernels",
+    "table_kernels",
+    "MAX_ULP",
+    "ARG_RANGES",
+    "SPENCER_LUT",
+]
+
+#: published max error (float32 ULPs at the f64 reference, floored at
+#: one ULP of 1.0 — see module docstring) of each table kernel.
+MAX_ULP = {
+    "sin": 4,
+    "cos": 4,
+    "tan": 64,
+    "arcsin": 24,
+    "arccos": 24,
+    "arctan2": 8,
+    "exp": 4,
+    "log": 4,
+    "powc": 64,
+    "spencer_factor": 4,
+}
+
+#: argument ranges over which the ``MAX_ULP`` bounds are published —
+#: the ranges the solar/pv chain actually produces.
+ARG_RANGES = {
+    "sin": (-400.0, 400.0),      # mean anomaly/longitude ~0.017*day2000
+    "cos": (-400.0, 400.0),
+    "tan": (-1.5, 1.5),          # apparent-elevation refraction arg
+    "arcsin": (-1.0, 1.0),
+    "arccos": (-1.0, 1.0),
+    "arctan2": None,             # all quadrants, |x|,|y| <= 1e3
+    "exp": (-87.0, 40.0),        # disc_dni clamps at 40; underflow below
+    "log": (1e-6, 1e4),          # sapm_dc effective irradiance ratios
+    "powc": (0.5, 100.0),        # airmass bases, exponents in [-1.7, 0)
+    "spencer_factor": (1.0, 366.0),
+}
+
+_F32 = np.float32
+
+
+def _spencer_factor64(doy: np.ndarray) -> np.ndarray:
+    """Float64 Spencer (1971) Fourier series for Rav^2 — LUT source."""
+    b = 2.0 * np.pi * (np.asarray(doy, np.float64) - 1.0) / 365.0
+    return (1.00011 + 0.034221 * np.cos(b) + 0.00128 * np.sin(b)
+            + 0.000719 * np.cos(2.0 * b) + 0.000077 * np.sin(2.0 * b))
+
+
+#: 366-entry day-of-year lookup table for the Spencer factor, built in
+#: float64 and rounded once to float32.  ~1.5 KiB: HBM-resident, served
+#: by a single gather instead of four transcendentals per element.
+SPENCER_LUT = _spencer_factor64(np.arange(1, 367)).astype(_F32)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSet:
+    """Bundle of the transcendental ops the solar/pv models consume.
+
+    ``exact_kernels(xp)`` binds every field to the raw ``xp`` op, so
+    models calling ``k.sin`` trace identically to calling ``xp.sin``.
+    ``powc(x, p)`` is pow-with-constant-exponent (the airmass laws);
+    ``spencer_factor`` is ``None`` for exact sets (the model computes
+    the Fourier series inline) and the LUT gather for table sets.
+    """
+
+    name: str
+    sin: Callable[..., Any]
+    cos: Callable[..., Any]
+    tan: Callable[..., Any]
+    arcsin: Callable[..., Any]
+    arccos: Callable[..., Any]
+    arctan2: Callable[..., Any]
+    exp: Callable[..., Any]
+    log: Callable[..., Any]
+    powc: Callable[..., Any]
+    spencer_factor: Optional[Callable[..., Any]] = None
+
+
+def _pow_const(x, p):
+    return x ** p
+
+
+_EXACT_CACHE: dict = {}
+
+
+def exact_kernels(xp) -> KernelSet:
+    """The libm-equivalent kernel set: every field IS the ``xp`` op."""
+    key = id(xp)
+    ks = _EXACT_CACHE.get(key)
+    if ks is None:
+        ks = KernelSet(
+            name="exact",
+            sin=xp.sin, cos=xp.cos, tan=xp.tan,
+            arcsin=xp.arcsin, arccos=xp.arccos, arctan2=xp.arctan2,
+            exp=xp.exp, log=xp.log, powc=_pow_const,
+            spencer_factor=None,
+        )
+        _EXACT_CACHE[key] = ks
+    return ks
+
+
+# ---------------------------------------------------------------------------
+# table/minimax implementations (always compute in float32)
+# ---------------------------------------------------------------------------
+
+_LOG2E = _F32(1.44269504088896341)
+# Cody–Waite split of ln(2): hi exact in a handful of bits, lo the rest.
+_LN2_HI = _F32(0.693359375)
+_LN2_LO = _F32(-2.12194440e-4)
+# Cody–Waite split of pi/2 for sin/cos quadrant reduction (cephes DP1..3
+# scaled from pi/4 to pi/2): valid to |x| ~ 1e4 at ~1e-7 abs error.
+_PI2_HI = _F32(1.5703125)
+_PI2_MID = _F32(4.837512969970703125e-4)
+_PI2_LO = _F32(7.549789948768648e-8)
+
+_HALF_PI = _F32(math.pi / 2.0)
+_PI = _F32(math.pi)
+_QUARTER_PI = _F32(math.pi / 4.0)
+# tan(pi/8): atan range-reduction breakpoint.
+_TAN_PI8 = _F32(0.4142135623730951)
+
+
+def _f32(xp, x):
+    return xp.asarray(x).astype(_F32)
+
+
+def _exp2i(xp, k):
+    """2**k for integer-valued f32 ``k`` in [-126, 127] by constructing
+    the float32 exponent field — no transcendental involved."""
+    ki = k.astype(np.int32)
+    bits = (ki + np.int32(127)) << np.int32(23)
+    if jnp is not None and xp is jnp:
+        return jax.lax.bitcast_convert_type(bits, jnp.float32)
+    return np.asarray(bits, np.int32).view(np.float32)
+
+
+def _fast_exp(xp, x):
+    """Minimax expf: |rel err| ~ 2e-7 on the clamped domain."""
+    x = xp.clip(_f32(xp, x), _F32(-87.0), _F32(88.0))
+    kf = xp.round(x * _LOG2E)
+    r = (x - kf * _LN2_HI) - kf * _LN2_LO
+    # cephes expf polynomial for e^r on |r| <= 0.5*ln2
+    p = _F32(1.9875691500e-4)
+    p = p * r + _F32(1.3981999507e-3)
+    p = p * r + _F32(8.3334519073e-3)
+    p = p * r + _F32(4.1665795894e-2)
+    p = p * r + _F32(1.6666665459e-1)
+    p = p * r + _F32(5.0000001201e-1)
+    p = p * r * r + r + _F32(1.0)
+    return p * _exp2i(xp, kf)
+
+
+def _fast_log(xp, x):
+    """Minimax logf via frexp + atanh-style series; |err| ~ 1 ulp@1."""
+    x = _f32(xp, x)
+    m, e = xp.frexp(x)  # x = m * 2**e, m in [0.5, 1)
+    # renormalise m to [sqrt(1/2), sqrt(2)) so log(m) is small
+    lo = m < _F32(0.7071067811865476)
+    m = xp.where(lo, m + m, m)
+    e = xp.where(lo, e - 1, e).astype(_F32)
+    f = m - _F32(1.0)
+    s = f / (_F32(2.0) + f)
+    z = s * s
+    # atanh series: log(m) = 2s * (1 + z/3 + z^2/5 + z^3/7 + z^4/9)
+    w = _F32(0.14798198280)
+    w = w * z + _F32(0.15313838550)
+    w = w * z + _F32(0.20000714765)
+    w = w * z + _F32(0.33333331174)
+    t = s * (_F32(2.0) + _F32(2.0) * z * w)
+    return t + e * _LN2_HI + e * _LN2_LO
+
+
+def _sin_poly(r):
+    """cephes sinf core on |r| <= pi/4."""
+    z = r * r
+    w = _F32(-1.9515295891e-4)
+    w = w * z + _F32(8.3321608736e-3)
+    w = w * z + _F32(-1.6666654611e-1)
+    return w * z * r + r
+
+
+def _cos_poly(r):
+    """cephes cosf core on |r| <= pi/4."""
+    z = r * r
+    w = _F32(2.443315711809948e-5)
+    w = w * z + _F32(-1.388731625493765e-3)
+    w = w * z + _F32(4.166664568298827e-2)
+    return w * z * z - _F32(0.5) * z + _F32(1.0)
+
+
+def _reduce_quadrant(xp, x):
+    x = _f32(xp, x)
+    nf = xp.round(x * _F32(2.0 / math.pi))
+    r = ((x - nf * _PI2_HI) - nf * _PI2_MID) - nf * _PI2_LO
+    q = nf.astype(np.int32) & np.int32(3)
+    return r, q
+
+
+def _fast_sin(xp, x):
+    r, q = _reduce_quadrant(xp, x)
+    sp, cp = _sin_poly(r), _cos_poly(r)
+    v = xp.where((q & 1) == 0, sp, cp)
+    return xp.where(q >= 2, -v, v)
+
+
+def _fast_cos(xp, x):
+    r, q = _reduce_quadrant(xp, x)
+    sp, cp = _sin_poly(r), _cos_poly(r)
+    v = xp.where((q & 1) == 0, cp, sp)
+    neg = ((q + 1) & np.int32(3)) >= 2
+    return xp.where(neg, -v, v)
+
+
+def _fast_tan(xp, x):
+    r, q = _reduce_quadrant(xp, x)
+    sp, cp = _sin_poly(r), _cos_poly(r)
+    even = (q & 1) == 0
+    num = xp.where(even, sp, cp)
+    den = xp.where(even, cp, -sp)
+    return num / den
+
+
+def _fast_arccos(xp, x):
+    """Hastings-style arccos: sqrt(1-|x|) * P(|x|), mirrored for x<0.
+
+    |abs err| <= ~2e-8 from the polynomial; f32 rounding dominates.
+    """
+    x = xp.clip(_f32(xp, x), _F32(-1.0), _F32(1.0))
+    a = xp.abs(x)
+    p = _F32(-0.0012624911)
+    p = p * a + _F32(0.0066700901)
+    p = p * a + _F32(-0.0170881256)
+    p = p * a + _F32(0.0308918810)
+    p = p * a + _F32(-0.0501743046)
+    p = p * a + _F32(0.0889789874)
+    p = p * a + _F32(-0.2145988016)
+    p = p * a + _F32(1.5707963050)
+    v = xp.sqrt(_F32(1.0) - a) * p
+    return xp.where(x < _F32(0.0), _PI - v, v)
+
+
+def _fast_arcsin(xp, x):
+    return _HALF_PI - _fast_arccos(xp, x)
+
+
+def _atan_poly(u):
+    """cephes atanf core on |u| <= tan(pi/8)."""
+    z = u * u
+    w = _F32(8.05374449538e-2)
+    w = w * z + _F32(-1.38776856032e-1)
+    w = w * z + _F32(1.99777106478e-1)
+    w = w * z + _F32(-3.33329491539e-1)
+    return w * z * u + u
+
+
+def _fast_arctan2(xp, y, x):
+    y = _f32(xp, y)
+    x = _f32(xp, x)
+    ax, ay = xp.abs(x), xp.abs(y)
+    mx = xp.maximum(ax, ay)
+    mn = xp.minimum(ax, ay)
+    t = mn / xp.maximum(mx, _F32(1e-30))
+    # second reduction: t in [0,1] -> u in [-tan(pi/8), tan(pi/8)]
+    big = t > _TAN_PI8
+    u = xp.where(big, (t - _F32(1.0)) / (t + _F32(1.0)), t)
+    a = _atan_poly(u)
+    a = xp.where(big, a + _QUARTER_PI, a)
+    a = xp.where(ay > ax, _HALF_PI - a, a)
+    a = xp.where(x < _F32(0.0), _PI - a, a)
+    a = xp.where(y < _F32(0.0), -a, a)
+    # atan2(0, 0) -> 0 like libm
+    return xp.where(mx == _F32(0.0), _F32(0.0) * a, a)
+
+
+def _fast_powc(xp, x, p):
+    """x**p for positive x and constant real p: exp(p * log(x))."""
+    return _fast_exp(xp, _F32(p) * _fast_log(xp, x))
+
+
+def _make_spencer_factor(xp):
+    lut = xp.asarray(SPENCER_LUT)
+
+    def spencer_factor(doy):
+        idx = xp.clip(_f32(xp, doy).astype(np.int32) - 1, 0, 365)
+        if jnp is not None and xp is jnp:
+            return jnp.take(lut, idx)
+        return lut[idx]
+
+    return spencer_factor
+
+
+_TABLE_CACHE: dict = {}
+
+
+def table_kernels(xp) -> KernelSet:
+    """The minimax/LUT kernel set.  Computes internally in float32 and
+    returns float32 whatever the input dtype (bf16 inputs up-cast)."""
+    key = id(xp)
+    ks = _TABLE_CACHE.get(key)
+    if ks is None:
+        import functools
+        bind = lambda f: functools.partial(f, xp)  # noqa: E731
+        ks = KernelSet(
+            name="table",
+            sin=bind(_fast_sin), cos=bind(_fast_cos), tan=bind(_fast_tan),
+            arcsin=bind(_fast_arcsin), arccos=bind(_fast_arccos),
+            arctan2=bind(_fast_arctan2),
+            exp=bind(_fast_exp), log=bind(_fast_log), powc=bind(_fast_powc),
+            spencer_factor=_make_spencer_factor(xp),
+        )
+        _TABLE_CACHE[key] = ks
+    return ks
+
+
+def get_kernels(impl: str, xp) -> KernelSet:
+    """Resolve a ``kernel_impl`` plan value to a :class:`KernelSet`."""
+    if impl == "table":
+        return table_kernels(xp)
+    if impl == "exact":
+        return exact_kernels(xp)
+    raise ValueError(f"unknown kernel_impl: {impl!r}")

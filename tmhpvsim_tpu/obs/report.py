@@ -48,9 +48,16 @@ logger = logging.getLogger(__name__)
 #: checkpoint resumes + supervised restart count, retry/giveup
 #: aggregates, circuit-breaker opens/rejections/state, injected-fault
 #: counts by chokepoint — runtime/resilience.py, runtime/faults.py).
+#: v8: adds the optional ``precision`` section (the mixed-precision /
+#: tabulated-kernel axes: resolved compute_dtype + kernel_impl, the
+#: sentinel-gate outcome for non-default picks, per-variant rates in
+#: bench documents — engine/autotune.py, models/tables.py), the
+#: optional ``probe`` section (bench.py backend-probe attempt/timeout
+#: accounting under runtime/resilience.ResiliencePolicy), and the
+#: ``compute_dtype`` / ``kernel_impl`` fields in the plan echo.
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 7
+REPORT_SCHEMA_VERSION = 8
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -80,6 +87,8 @@ _TOP_SCHEMA = {
     "fleet": (False, _OPT_DICT),
     "serving": (False, _OPT_DICT),
     "resilience": (False, _OPT_DICT),
+    "precision": (False, _OPT_DICT),
+    "probe": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -223,6 +232,9 @@ def _plan_doc(plan) -> Optional[dict]:
             # autotune cache entries may predate the field
             "blocks_per_dispatch": int(getattr(plan, "blocks_per_dispatch",
                                                1)),
+            # getattr: pre-v8 plans predate the precision axes
+            "compute_dtype": str(getattr(plan, "compute_dtype", "f32")),
+            "kernel_impl": str(getattr(plan, "kernel_impl", "exact")),
             "source": plan.source}
 
 
@@ -439,6 +451,13 @@ class RunReport:
         #: ``resilience.*`` / ``faults.*`` metric names by
         #: :meth:`attach_metrics`
         self.resilience: Optional[dict] = None
+        #: precision section (schema v8): the resolved
+        #: compute_dtype/kernel_impl axes, their sentinel-gate outcome,
+        #: and — in bench documents — the per-variant rate pricing
+        self.precision: Optional[dict] = None
+        #: backend-probe section (schema v8): bench.py probe attempt /
+        #: timeout accounting under runtime.resilience.ResiliencePolicy
+        self.probe: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -515,6 +534,8 @@ class RunReport:
             "fleet": self.fleet,
             "serving": self.serving,
             "resilience": self.resilience,
+            "precision": self.precision,
+            "probe": self.probe,
         }
         return validate_report(out) if validate else out
 

@@ -26,11 +26,16 @@ Accepted document shapes (the repo's bench history spans all four):
 The table also carries each row's telemetry/analytics levels (from the
 embedded config echo; pre-instrumentation docs read as 'off'), an
 ``ovh%`` column: the instrumented row's steady block wall vs the best
-same-platform uninstrumented row, and a ``serve`` column: the
+same-platform uninstrumented row, a ``serve`` column: the
 scenario-serving request-coalescing ratio (requests per fused dispatch,
-from a v6 ``serving`` section or a ``bench.py --serve`` doc).
-``--json`` emits the rows + gate verdict as one JSON document for
-machine consumers.
+from a v6 ``serving`` section or a ``bench.py --serve`` doc), and the
+v8 precision axes: ``cdt``/``kimpl`` (the winning plan's compute dtype
+and kernel implementation; pre-v8 docs read as f32/exact) plus a
+``prec`` column pricing the precision levers — the best
+speedup-vs-exact/f32 from the row's own ``precision`` section when its
+sweep timed both, else the row's throughput vs the best same-platform
+exact/f32 row.  ``--json`` emits the rows + gate verdict as one JSON
+document for machine consumers.
 
 No third-party imports: runs anywhere the repo checks out.
 """
@@ -100,6 +105,42 @@ def _serve_ratio(doc) -> float | None:
     return None
 
 
+def _precision_axes(doc) -> tuple:
+    """(compute_dtype, kernel_impl, best_sweep_speedup) of one document.
+
+    Axes come from the winning plan echo (``tuned_plan`` on headline
+    docs, the v8 ``plan`` fields on RunReports); pre-v8 documents
+    predate both fields and read as the exact/f32 defaults.  The third
+    element is the best ``speedup_vs_exact_f32`` among the non-default
+    variants of the doc's own ``precision`` section — the
+    within-process pricing bench.py computed when its sweep timed both
+    sides — or None."""
+    if doc.get("kind") == REPORT_KIND:
+        plan, rep = doc.get("plan"), doc
+    else:
+        rep = doc.get("run_report")
+        rep = rep if isinstance(rep, dict) else {}
+        plan = doc.get("tuned_plan")
+        if not isinstance(plan, dict):
+            plan = rep.get("plan")
+    if not isinstance(plan, dict):
+        plan = {}
+    cdt = plan.get("compute_dtype") or "f32"
+    kimpl = plan.get("kernel_impl") or "exact"
+    speed = None
+    prec = rep.get("precision")
+    if isinstance(prec, dict):
+        for v in (prec.get("variants") or {}).values():
+            if not isinstance(v, dict):
+                continue
+            s = v.get("speedup_vs_exact_f32")
+            nondefault = (v.get("compute_dtype", "f32") != "f32"
+                          or v.get("kernel_impl", "exact") != "exact")
+            if s is not None and nondefault:
+                speed = s if speed is None else max(speed, s)
+    return cdt, kimpl, speed
+
+
 def _levels(cfg) -> tuple:
     """(telemetry, analytics) levels from a config echo; pre-PR-3/PR-6
     documents predate the fields and read as 'off'."""
@@ -114,7 +155,8 @@ def normalize(path: str) -> dict:
     row = {"name": name, "order": name, "platform": None, "value": None,
            "compile_s": None, "steady_block_s": None,
            "telemetry": None, "analytics": None, "serve": None,
-           "failed": True}
+           "compute_dtype": None, "kernel_impl": None,
+           "precision_speedup": None, "failed": True}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -138,6 +180,7 @@ def normalize(path: str) -> dict:
         timing = doc.get("timing") or {}
         headline = doc.get("headline") or {}
         tel, ana = _levels(doc.get("config"))
+        cdt, kimpl, prec_speed = _precision_axes(doc)
         row.update(
             failed=False,
             platform=(doc.get("device") or {}).get("platform"),
@@ -146,6 +189,8 @@ def normalize(path: str) -> dict:
             steady_block_s=timing.get("steady_block_s"),
             telemetry=tel, analytics=ana,
             serve=_serve_ratio(doc),
+            compute_dtype=cdt, kernel_impl=kimpl,
+            precision_speedup=prec_speed,
         )
         return row
 
@@ -155,6 +200,7 @@ def normalize(path: str) -> dict:
         rep = doc.get("run_report")
         tel, ana = _levels(rep.get("config")
                            if isinstance(rep, dict) else None)
+        cdt, kimpl, prec_speed = _precision_axes(doc)
         row.update(
             failed=False,
             platform=doc.get("platform"),
@@ -163,6 +209,8 @@ def normalize(path: str) -> dict:
             steady_block_s=_steady_from_headline(doc),
             telemetry=tel, analytics=ana,
             serve=_serve_ratio(doc),
+            compute_dtype=cdt, kernel_impl=kimpl,
+            precision_speedup=prec_speed,
         )
         return row
 
@@ -205,20 +253,53 @@ def annotate_overhead(rows: list) -> None:
             r["overhead_pct"] = (r["steady_block_s"] / b - 1.0) * 100.0
 
 
+def annotate_precision(rows: list) -> None:
+    """Price the precision levers across rows: every row running a
+    non-default compute_dtype/kernel_impl whose own document carried no
+    sweep pricing gets ``precision_speedup`` = its throughput vs the
+    best same-platform exact/f32 row.  Rows priced by their own v8
+    ``precision`` section (bench.py timed both sides in one process —
+    the cleaner comparison) keep that number."""
+    base: dict = {}
+    for r in rows:
+        if r["failed"] or r["value"] is None:
+            continue
+        if (r.get("compute_dtype") or "f32") == "f32" and \
+                (r.get("kernel_impl") or "exact") == "exact":
+            p = r["platform"]
+            if p not in base or r["value"] > base[p]:
+                base[p] = r["value"]
+    for r in rows:
+        r.setdefault("precision_speedup", None)
+        if r.get("precision_speedup") is not None:
+            continue
+        if r["failed"] or r["value"] is None:
+            continue
+        if (r.get("compute_dtype") or "f32") == "f32" and \
+                (r.get("kernel_impl") or "exact") == "exact":
+            continue
+        b = base.get(r["platform"])
+        if b:
+            r["precision_speedup"] = round(r["value"] / b, 2)
+
+
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
-            "note")
+            "cdt", "kimpl", "prec", "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
         srv = r.get("serve")
+        prec = r.get("precision_speedup")
         table.append((
             r["name"], r["platform"] or "-", _fmt(r["value"]),
             _fmt(r["compile_s"]), _fmt(r["steady_block_s"]),
             r.get("telemetry") or "-", r.get("analytics") or "-",
             "-" if ovh is None else f"{ovh:+.1f}",
             "-" if srv is None else f"{srv:.2f}x",
+            r.get("compute_dtype") or "-", r.get("kernel_impl") or "-",
+            "-" if prec is None else f"{prec:.2f}x",
             r.get("note", ""),
         ))
     widths = [max(len(str(line[i])) for line in table)
@@ -309,6 +390,7 @@ def main(argv=None) -> int:
     rows = [normalize(p) for p in files]
     rows.sort(key=lambda r: r["order"])
     annotate_overhead(rows)
+    annotate_precision(rows)
     ok, msg = check_regression(rows, args.max_regress)
     if args.json:
         print(json.dumps({

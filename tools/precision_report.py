@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the ``precision`` / ``probe`` sections of
+run reports (schema v8).
+
+Accepts any mix of the shapes the repo's tooling writes (same intake as
+``serve_report.py`` / ``fleet_report.py``):
+
+* a bare RunReport JSON (``kind == "tmhpvsim_tpu.run_report"``);
+* a bench doc — one JSON object with an embedded ``run_report`` key
+  (bench.py stdout lines / BENCH_*.json);
+* a JSONL stream of either (bench batteries append one doc per phase).
+
+Two emitters write ``precision`` sections and both shapes are checked:
+
+* the engine echo (``Simulation.run_report``): the resolved
+  ``compute_dtype`` / ``kernel_impl`` axes of a non-default run, the
+  run's telemetry level, and whether host-output overlap was active —
+  validated for legal axis values and for the bf16 invariant (mixed
+  precision auto-escalates telemetry, so a bf16 section claiming
+  ``telemetry: off`` means the escalation chain broke);
+* the bench pricing (``bench._precision_doc``): per-variant rates keyed
+  by their axes plus ``speedup_vs_exact_f32`` against the sweep's own
+  exact/f32 baseline — validated for positive rates and for the
+  speedups actually being rate/baseline.
+
+``probe`` sections (bench.py's resilience-wrapped backend probe) are
+checked for attempt/timeout accounting consistency.
+
+Exit code 0 when every *present* section validates — reports without
+one (default-precision runs, pre-v8 documents) are fine and just noted,
+which is how ``run_tpu_round5b.sh`` consumes this non-fatally after
+each bench doc.  Nonzero means a malformed section.
+
+No third-party imports: runs anywhere the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+_NUM = (int, float)
+
+_DTYPES = ("f32", "bf16")
+_KIMPLS = ("exact", "table")
+_TELEMETRY = ("off", "light", "full")
+
+
+def _check(cond: bool, errors: list, msg: str) -> None:
+    if not cond:
+        errors.append(msg)
+
+
+def _validate_axes(doc: dict, prefix: str, errors: list) -> None:
+    cdt = doc.get("compute_dtype", "f32")
+    kimpl = doc.get("kernel_impl", "exact")
+    _check(cdt in _DTYPES, errors,
+           f"{prefix}compute_dtype {cdt!r} not in {_DTYPES}")
+    _check(kimpl in _KIMPLS, errors,
+           f"{prefix}kernel_impl {kimpl!r} not in {_KIMPLS}")
+
+
+def validate_precision(sec) -> list:
+    """Schema errors for one ``precision`` section (empty list = ok)."""
+    errors: list = []
+    if not isinstance(sec, dict):
+        return [f"precision section is {type(sec).__name__}, "
+                f"not an object"]
+    variants = sec.get("variants")
+    if variants is not None:                      # bench pricing shape
+        if not isinstance(variants, dict) or not variants:
+            return ["variants present but not a non-empty object"]
+        base = sec.get("baseline_rate_exact_f32")
+        _check(base is None or (isinstance(base, _NUM) and base > 0),
+               errors, f"baseline_rate_exact_f32 not positive: {base!r}")
+        for name, v in variants.items():
+            if not isinstance(v, dict):
+                errors.append(f"variants[{name}] not an object")
+                continue
+            _validate_axes(v, f"variants[{name}].", errors)
+            rate = v.get("rate")
+            if not isinstance(rate, _NUM) or rate <= 0:
+                errors.append(f"variants[{name}].rate not positive: "
+                              f"{rate!r}")
+                continue
+            speed = v.get("speedup_vs_exact_f32")
+            if speed is None:
+                continue
+            _check(isinstance(speed, _NUM) and speed > 0, errors,
+                   f"variants[{name}].speedup_vs_exact_f32 not "
+                   f"positive: {speed!r}")
+            if isinstance(speed, _NUM) and isinstance(base, _NUM) and base:
+                # bench rounds the stored speedup to 2 decimals
+                want = rate / base
+                _check(abs(speed - want) <= 0.005 + 1e-9, errors,
+                       f"variants[{name}]: speedup {speed} != "
+                       f"rate/baseline {want:.4f}")
+        return errors
+
+    # engine echo shape
+    _validate_axes(sec, "", errors)
+    tel = sec.get("telemetry")
+    if tel is not None:
+        _check(tel in _TELEMETRY, errors,
+               f"telemetry {tel!r} not in {_TELEMETRY}")
+        # the bf16 auto-escalation invariant (engine/autotune.py): a
+        # mixed-precision run never executes with the sentinel off
+        _check(not (sec.get("compute_dtype") == "bf16" and tel == "off"),
+               errors, "bf16 section claims telemetry 'off' — the "
+                       "auto-escalation chain broke")
+    ov = sec.get("output_overlap")
+    _check(ov is None or isinstance(ov, bool), errors,
+           f"output_overlap neither bool nor absent: {ov!r}")
+    return errors
+
+
+def validate_probe(sec) -> list:
+    """Schema errors for one ``probe`` section (empty list = ok)."""
+    errors: list = []
+    if not isinstance(sec, dict):
+        return [f"probe section is {type(sec).__name__}, not an object"]
+    att = sec.get("probe_attempts")
+    tmo = sec.get("probe_timeouts")
+    for key, v in (("probe_attempts", att), ("probe_timeouts", tmo)):
+        if not isinstance(v, int) or isinstance(v, bool):
+            errors.append(f"{key} missing/not an int")
+        elif v < 0:
+            errors.append(f"{key} negative: {v}")
+    if not errors:
+        _check(att >= 1, errors,
+               f"probe section written without an attempt ({att})")
+        _check(tmo <= att, errors,
+               f"probe_timeouts ({tmo}) exceed probe_attempts ({att})")
+    for key in ("attempt_timeout_s", "total_timeout_s"):
+        v = sec.get(key)
+        _check(v is None or (isinstance(v, _NUM) and v > 0), errors,
+               f"{key} not positive: {v!r}")
+    return errors
+
+
+def print_precision(sec: dict, label: str) -> None:
+    variants = sec.get("variants")
+    if variants is None:
+        print(f"{label}: precision axes compute_dtype="
+              f"{sec.get('compute_dtype', 'f32')} kernel_impl="
+              f"{sec.get('kernel_impl', 'exact')} telemetry="
+              f"{sec.get('telemetry', '-')} output_overlap="
+              f"{sec.get('output_overlap', '-')}")
+        return
+    base = sec.get("baseline_rate_exact_f32")
+    print(f"{label}: precision pricing "
+          f"(baseline exact/f32 rate: "
+          f"{base if base is not None else 'none in sweep'})")
+    width = max(len(n) for n in variants)
+    for name, v in sorted(variants.items()):
+        speed = v.get("speedup_vs_exact_f32")
+        print(f"  {name.ljust(width)}  {v.get('compute_dtype', 'f32'):>4}"
+              f"/{v.get('kernel_impl', 'exact'):<5}  "
+              f"rate={v.get('rate'):,}  "
+              + ("-" if speed is None else f"{speed:.2f}x vs exact/f32"))
+
+
+def print_probe(sec: dict, label: str) -> None:
+    print(f"{label}: backend probe attempts={sec.get('probe_attempts')} "
+          f"timeouts={sec.get('probe_timeouts')} "
+          f"(attempt {sec.get('attempt_timeout_s')}s / total "
+          f"{sec.get('total_timeout_s')}s budget)")
+
+
+def _iter_docs(path: str):
+    """Parsed JSON documents in ``path``: one whole-file document, or
+    one per line (bench batteries write JSONL)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            yield json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+
+
+def _extract_reports(doc):
+    """(label_suffix, report_dict) pairs embedded in one parsed doc."""
+    if not isinstance(doc, dict):
+        return
+    if doc.get("kind") == REPORT_KIND:
+        yield "", doc
+        return
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and rep.get("kind") == REPORT_KIND:
+        label = doc.get("phase") or doc.get("variant") or rep.get("app")
+        yield f"[{label}]" if label else "", rep
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate (and print) every precision/probe section in one file;
+    True when all present sections pass.  None present passes
+    trivially."""
+    name = os.path.basename(path)
+    try:
+        docs = list(_iter_docs(path))
+    except OSError as e:
+        print(f"{name}: UNREADABLE ({e})", file=sys.stderr)
+        return False
+    found = 0
+    ok = True
+    for doc in docs:
+        for suffix, rep in _extract_reports(doc):
+            for key, validate, show in (
+                    ("precision", validate_precision, print_precision),
+                    ("probe", validate_probe, print_probe)):
+                sec = rep.get(key)
+                if sec is None:
+                    continue
+                found += 1
+                errors = validate(sec)
+                if errors:
+                    ok = False
+                    print(f"{name}{suffix}: INVALID {key} section "
+                          f"({len(errors)} error(s))", file=sys.stderr)
+                    for e in errors[:10]:
+                        print(f"  {e}", file=sys.stderr)
+                    if len(errors) > 10:
+                        print(f"  ... and {len(errors) - 10} more",
+                              file=sys.stderr)
+                elif not quiet:
+                    show(sec, f"{name}{suffix}")
+    if not found and not quiet:
+        print(f"{name}: no precision/probe section (default-precision "
+              f"run or pre-v8 report)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print RunReport precision/probe "
+                    "sections (bare reports, bench docs, or JSONL of "
+                    "either)")
+    ap.add_argument("files", nargs="+", help="report/bench files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the tables (errors still print)")
+    args = ap.parse_args(argv)
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
