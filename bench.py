@@ -257,7 +257,7 @@ def _bench_timing(compile_s, steady_wall_s, n_timed_blocks, rate) -> dict:
 def _bench_report(app: str, *, config=None, plan=None, timing=None,
                   headline=None, profile=None, slabs=None,
                   device=None, executor=None,
-                  precision=None) -> dict | None:
+                  precision=None, checkpoint=None) -> dict | None:
     """A validated obs RunReport document, embedded ADDITIVELY in a bench
     artifact as ``doc["run_report"]`` (the legacy ad-hoc fields stay —
     battery scripts key richness decisions off them).  Never raises: a
@@ -282,6 +282,7 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
         rep.device = device
         rep.executor = executor
         rep.precision = precision
+        rep.checkpoint = checkpoint
         # every bench artifact records how the backend probe went — the
         # v8 ``probe`` section; None when this path never probed
         rep.probe = _probe_doc()
@@ -289,6 +290,64 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
     except Exception as e:
         print(f"# run_report build failed ({app}): {e}", file=sys.stderr)
         return None
+
+
+def _checkpoint_overhead_doc(n_chains: int, n_blocks: int = 4) -> dict:
+    """Price checkpointing against the steady block wall: the same
+    reduce run three times — no checkpoint, synchronous per-block save,
+    async writer (engine/checkpoint.py AsyncCheckpointWriter) — timing
+    only the post-compile blocks.  ``overhead_frac`` is each mode's
+    steady-block slowdown vs the checkpoint-off baseline; the async
+    number is the ISSUE-10 acceptance lever (≤ 2 % at 65536 chains,
+    tested at scale in tests/test_checkpoint.py slow marks)."""
+    import shutil
+    import tempfile
+
+    from tmhpvsim_tpu.engine import Simulation
+    from tmhpvsim_tpu.engine import checkpoint as ckpt
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    out = {"n_chains": n_chains, "timed_blocks": n_blocks}
+    try:
+        for mode in ("off", "sync", "async"):
+            cfg = _make_cfg(n_chains, n_blocks + 1)
+            sim = Simulation(cfg)
+            path = os.path.join(tmpdir, f"ck_{mode}.npz")
+            writer = (ckpt.AsyncCheckpointWriter(path, config=cfg)
+                      if mode == "async" else None)
+            ticks: list = []
+
+            def on_block(bi, state, acc, _sim=sim, _cfg=cfg,
+                         _writer=writer, _path=path, _mode=mode,
+                         _ticks=ticks):
+                if _mode != "off" and _sim.state_block == bi + 1:
+                    tree = _sim.host_local_tree(
+                        {"state": state, "acc": acc})
+                    if _writer is not None:
+                        _writer.submit(tree, bi + 1)
+                    else:
+                        ckpt.save(_path, tree, bi + 1, _cfg)
+                _ticks.append(time.monotonic())
+
+            sim.run_reduced(on_block=on_block)
+            if writer is not None:
+                writer.close()
+            del sim
+            # ticks[0] lands after the compile-inclusive first block;
+            # the remaining intervals are the steady blocks (with their
+            # per-block save, in the checkpointed modes)
+            steady = ((ticks[-1] - ticks[0]) / (len(ticks) - 1)
+                      if len(ticks) > 1 else None)
+            out[mode] = {"steady_block_s": steady}
+        base = out["off"]["steady_block_s"]
+        if base:
+            for mode in ("sync", "async"):
+                s = out[mode]["steady_block_s"]
+                if s is not None:
+                    out[mode]["overhead_frac"] = round(s / base - 1.0, 4)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return out
 
 
 def _hot_jit_cost(sim) -> dict:
@@ -544,6 +603,7 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
         device={"platform": platform,
                 "device_kind": extra.get("device_kind")},
         precision=_precision_doc(variants),
+        checkpoint=extra.get("checkpoint_overhead"),
     )
     return doc
 
@@ -888,8 +948,20 @@ def headline() -> None:
     except Exception as e:  # sharded failure must not lose the main number
         print(f"# sharded bench failed: {e}", file=sys.stderr)
         sharded = {"error": str(e)[:200]}
+    _progress()
+
+    # checkpoint-overhead pricing (off / sync / async steady-block walls,
+    # engine/checkpoint.py) — non-fatal like the other tail phases
+    ck_overhead = None
+    try:
+        ck_overhead = _checkpoint_overhead_doc(n_chains)
+    except Exception as e:
+        print(f"# checkpoint-overhead bench failed: {e}", file=sys.stderr)
+    _progress()
 
     extra = dict(roofline=roofline) if roofline is not None else {}
+    if ck_overhead is not None:
+        extra["checkpoint_overhead"] = ck_overhead
     doc = _headline_doc(
         variants, platform,
         device_kind=device_kind, n_chains=n_chains, block_s=BLOCK_S,

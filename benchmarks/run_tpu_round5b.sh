@@ -236,5 +236,19 @@ for bench_doc in benchmarks/HEADLINE_*.json benchmarks/REPRO_*.jsonl \
   python tools/precision_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- precision_report: MALFORMED PRECISION SECTION $bench_doc rc=$?" >> "$LOG"
 done
+# checkpoint sanity (non-fatal), same contract as the loops above: any
+# doc carrying a RunReport 'checkpoint' section (schema v9 — save/restore
+# totals, generation rotation, integrity fallbacks, async-writer and
+# preemption accounting; the headline doc carries the overhead pricing)
+# must carry a WELL-FORMED one; checkpoint-free docs just note the
+# absence.  ckpt_report.py also verifies on-disk checkpoints (manifest
+# checksums, resumability) when pointed at one.
+for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
+                 benchmarks/BENCH_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- ckpt_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/ckpt_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- ckpt_report: MALFORMED CHECKPOINT SECTION $bench_doc rc=$?" >> "$LOG"
+done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

@@ -300,3 +300,80 @@ class TestCheckpointChokepoints:
                     ckpt.save(path, state, 2)
         assert ckpt.peek_meta(path)["next_block"] == 2
         assert not os.path.exists(path + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# the truncate action + the preemption/corruption chokepoints
+# ---------------------------------------------------------------------------
+
+
+class TestTruncateAction:
+    def test_truncate_rule_parses(self):
+        r = plan("checkpoint.corrupt=truncate:120@n2").rules[0]
+        assert (r.point, r.action, r.arg, r.trigger, r.k) == \
+            ("checkpoint.corrupt", "truncate", 120, "n", 2)
+
+    @pytest.mark.parametrize("spec,match", [
+        ("checkpoint.corrupt=truncate@n1",
+         "truncate needs a byte offset"),
+        ("checkpoint.corrupt=truncate:zap@n1",
+         "truncate needs a byte offset"),
+        ("checkpoint.corrupt=truncate:-1@n1",
+         "truncate offset must be >= 0"),
+    ])
+    def test_truncate_parse_errors(self, spec, match):
+        with pytest.raises(ValueError, match=match):
+            plan(spec)
+
+    def test_new_points_registered(self):
+        for point in ("checkpoint.corrupt", "signal.preempt"):
+            assert point in faults.POINTS
+
+    def test_fire_truncates_the_context_path(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"x" * 100)
+        reg = MetricsRegistry()
+        with use_registry(reg), \
+                faults.active(plan("checkpoint.corrupt=truncate:10@n1")):
+            assert faults.fire("checkpoint.corrupt", path=str(p)) == \
+                "truncate"
+        assert p.stat().st_size == 10
+        c = reg.snapshot()["counters"]
+        assert c["faults.injected.checkpoint.corrupt"] == 1.0
+
+    def test_truncate_beyond_size_is_clamped(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"x" * 5)
+        with use_registry(MetricsRegistry()), \
+                faults.active(plan("checkpoint.corrupt=truncate:99@n1")):
+            faults.fire("checkpoint.corrupt", path=str(p))
+        assert p.stat().st_size == 5
+
+    def test_truncate_without_path_warns_not_crashes(self):
+        # a truncate rule on a point that passes no path= context is a
+        # misconfiguration, not a crash
+        with use_registry(MetricsRegistry()), \
+                faults.active(plan("signal.preempt=truncate:1@n1")):
+            assert faults.fire("signal.preempt") == "truncate"
+
+    def test_truncate_missing_file_warns_not_crashes(self, tmp_path):
+        with use_registry(MetricsRegistry()), \
+                faults.active(plan("checkpoint.corrupt=truncate:1@n1")):
+            assert faults.fire("checkpoint.corrupt",
+                               path=str(tmp_path / "nope")) == "truncate"
+
+    def test_save_chokepoint_tears_then_rotation_recovers(self, tmp_path):
+        """checkpoint.corrupt=truncate:K tears the generation that was
+        JUST committed (the anchor hard-links it), and the loader falls
+        back to the previous generation — the in-process version of the
+        chaos torn-write recovery."""
+        path = str(tmp_path / "s.npz")
+        state = {"x": np.arange(6)}
+        with use_registry(MetricsRegistry()):
+            ckpt.save(path, state, 1)
+            with faults.active(
+                    plan("checkpoint.corrupt=truncate:64@n1")):
+                ckpt.save(path, state, 2)  # g2 torn right after commit
+            tree, nb = ckpt.load(path)
+        assert nb == 1
+        np.testing.assert_array_equal(tree["x"], state["x"])

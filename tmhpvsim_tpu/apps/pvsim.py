@@ -289,6 +289,99 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
                 sink.close()
 
 
+class _PreemptStop(Exception):
+    """Internal signal: stop the run loop at a block boundary with the
+    latest snapshot durable — raised by the checkpoint hooks on a
+    SIGTERM under ``--preempt-grace`` or a chaos ``signal.preempt``."""
+
+    def __init__(self, block: int):
+        super().__init__(f"preempted after block {block}")
+        self.block = block
+
+
+class _PreemptWatch:
+    """Preemption-notice watcher for a checkpointed run.
+
+    With ``grace_s > 0`` a SIGTERM handler is armed that only sets a
+    flag — the run finishes the in-flight block, takes/drains one final
+    snapshot and exits cleanly inside the grace window (the supervisor
+    SIGKILLs past it, runtime/supervise.py).  The chaos chokepoint
+    ``signal.preempt`` (runtime/faults.py) is consulted either way, so
+    the preemption path is testable in-process without real signals.
+    """
+
+    def __init__(self, grace_s: float):
+        import signal as _signal
+
+        self.grace_s = grace_s
+        self._flag = False
+        self._old = None
+        if grace_s and grace_s > 0:
+            try:
+                self._old = _signal.signal(_signal.SIGTERM, self._on_term)
+            except ValueError:  # pragma: no cover - non-main thread
+                self._old = None
+
+    def _on_term(self, signum, frame):
+        self._flag = True
+        logger.warning("SIGTERM received; finishing the current block "
+                       "and snapshotting (grace %.1f s)", self.grace_s)
+
+    def should_stop(self) -> bool:
+        if self._flag:
+            return True
+        from tmhpvsim_tpu.runtime import faults
+
+        if faults.ACTIVE is not None:
+            try:
+                faults.fire("signal.preempt")
+            except faults.FaultInjected:
+                return True
+        return False
+
+    def restore(self) -> None:
+        import signal as _signal
+
+        if self._old is not None:
+            _signal.signal(_signal.SIGTERM, self._old)
+            self._old = None
+
+
+def _ckpt_teardown(writer, watch, suppress: bool = False) -> None:
+    """Restore the SIGTERM handler and drain/close the async writer.
+    ``suppress`` is the error-unwind path: a close failure must not mask
+    the exception already in flight."""
+    if watch is not None:
+        watch.restore()
+    if writer is None:
+        return
+    if not suppress:
+        writer.close()
+        return
+    try:
+        writer.close(timeout=10.0)
+    except Exception as e:
+        logger.warning("async checkpoint writer close failed during "
+                       "error unwind: %s", e)
+
+
+def _resume_source(checkpoint, ckpt_global, sim):
+    """(path, chain_slice) to resume from, or (None, None).
+
+    Preference order: this process's own checkpoint (the per-host file
+    on a pod slice, the plain path otherwise; shards of a previous
+    multi-host run also count — ``checkpoint.resumable``), then the
+    unsuffixed global checkpoint of a run saved under a DIFFERENT
+    process layout, loaded elastically as this host's chain slice."""
+    from tmhpvsim_tpu.engine import checkpoint as ckpt
+
+    if ckpt.resumable(checkpoint):
+        return checkpoint, None
+    if ckpt_global != checkpoint and ckpt.resumable(ckpt_global):
+        return ckpt_global, sim.resume_chain_slice()
+    return None, None
+
+
 def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               start: Optional[str] = None, chain: int = 0,
               sharded: bool = False,
@@ -311,12 +404,23 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               blocks_per_dispatch: int = 0,
               compute_dtype: str = "auto",
               kernel_impl: str = "auto",
-              output_overlap: str = "auto") -> None:
+              output_overlap: str = "auto",
+              checkpoint_keep: int = 3,
+              checkpoint_async: str = "off",
+              preempt_grace_s: float = 0.0) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
     checkpoint resumes the run (appending to the CSV) — restart-safe long
     simulations, which the reference cannot do at all (SURVEY.md §5).
+    Saves rotate through ``checkpoint_keep`` integrity-verified
+    generations (engine/checkpoint.py manifest); ``checkpoint_async='on'``
+    moves serialization to a background writer; ``preempt_grace_s > 0``
+    arms a SIGTERM handler that finishes the current block, drains one
+    final snapshot and exits cleanly — the preemption-notice shape.
+    Resume is topology-elastic: a checkpoint saved under a different
+    device count/mesh (or as per-host shards) is reassembled/resliced on
+    load; only identity keys (seed, chains, models, rng_stream) refuse.
 
     With ``realtime``, rows are released on the 1 Hz wall-clock grid (the
     reference's default streaming mode) while the device simulates blocks
@@ -393,6 +497,9 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 blocks_per_dispatch=blocks_per_dispatch,
                 compute_dtype=compute_dtype, kernel_impl=kernel_impl,
                 output_overlap=output_overlap,
+                checkpoint_keep=checkpoint_keep,
+                checkpoint_async=checkpoint_async,
+                preempt_grace_s=preempt_grace_s,
             )
         except (Exception, KeyboardInterrupt):
             if tracer:
@@ -462,7 +569,10 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    blocks_per_dispatch: int = 0,
                    compute_dtype: str = "auto",
                    kernel_impl: str = "auto",
-                   output_overlap: str = "auto"):
+                   output_overlap: str = "auto",
+                   checkpoint_keep: int = 3,
+                   checkpoint_async: str = "off",
+                   preempt_grace_s: float = 0.0):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
     the wrapper can assemble the run report from its config/plan/timer."""
     import contextlib
@@ -498,11 +608,14 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
 
     import jax
 
+    ckpt_global = checkpoint  # the unsuffixed path (elastic resume)
     if jax.process_count() > 1:
         # Pod slice: every host writes (and checkpoints) only the chains
         # its own devices hold — per-host files, no DCN gathers.  Resume
-        # must use the same process count/layout; mismatched shard shapes
-        # fail loudly in ShardedSimulation._place_resume.
+        # under a DIFFERENT layout is elastic: _resume_source falls back
+        # to the global checkpoint resliced to this host's chains, and a
+        # later single-process run reassembles the .hostN shards
+        # (checkpoint.load_elastic).
         suffix = f".host{jax.process_index()}"
         file = f"{file}{suffix}"
         if checkpoint:
@@ -547,6 +660,9 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         compute_dtype=compute_dtype,
         kernel_impl=kernel_impl,
         output_overlap=output_overlap,
+        checkpoint_keep=checkpoint_keep,
+        checkpoint_async=checkpoint_async,
+        preempt_grace_s=preempt_grace_s,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation
@@ -572,6 +688,29 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         logger.info("checkpointing disables chain slabbing "
                     "(slab_chains=%d ignored)", plan.slab_chains)
 
+    writer, preempt = None, None
+    if checkpoint:
+        preempt = _PreemptWatch(cfg.preempt_grace_s)
+        if cfg.checkpoint_async == "on":
+            writer = ckpt.AsyncCheckpointWriter(
+                checkpoint, config=cfg, keep=cfg.checkpoint_keep)
+
+    def _save_ckpt(tree, next_block):
+        lay = sim.checkpoint_layout()
+        if writer is not None:
+            writer.submit(tree, next_block, layout=lay)
+        else:
+            ckpt.save(checkpoint, tree, next_block, cfg,
+                      keep=cfg.checkpoint_keep, layout=lay)
+
+    def _preempt_report(stop: _PreemptStop) -> None:
+        reg.counter("checkpoint.preempt_snapshots_total").inc()
+        print(
+            f"pvsim: preempted — state through block {stop.block + 1}"
+            f"/{sim.n_blocks} checkpointed to {checkpoint}; rerun the "
+            f"same command to finish"
+        )
+
     if output == "reduce":
         if realtime:
             raise ValueError("reduce mode has no per-second rows to pace; "
@@ -582,11 +721,14 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         # is written once at the end, so unlike trace mode there is no
         # partial-rows window to truncate on resume.
         state, acc, start_block = None, None, 0
-        if checkpoint and os.path.exists(checkpoint):
-            tree, start_block = ckpt.load(checkpoint, cfg)
+        src, rsl = (_resume_source(checkpoint, ckpt_global, sim)
+                    if checkpoint else (None, None))
+        if src:
+            tree, start_block = ckpt.load_elastic(src, cfg,
+                                                  chain_slice=rsl)
             state, acc = tree["state"], tree["acc"]
             logger.info("resuming reduce run from %s at block %d",
-                        checkpoint, start_block)
+                        src, start_block)
             reg.counter("resilience.resumed_total").inc()
             reg.gauge("resilience.resumed_block").set(start_block)
         dtrace = device_trace(profile_dir) if profile_dir else \
@@ -611,14 +753,27 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             if checkpoint and sim.state_block == bi + 1:
                 # host_local_tree: on a pod slice each host saves only its
                 # chain slice (the per-host file this process owns)
-                ckpt.save(checkpoint,
-                          sim.host_local_tree({"state": state, "acc": acc}),
-                          bi + 1, cfg)
+                _save_ckpt(
+                    sim.host_local_tree({"state": state, "acc": acc}),
+                    bi + 1)
+            if preempt is not None and preempt.should_stop():
+                raise _PreemptStop(bi)
 
-        with dtrace:
-            reduced = sim.run_reduced(state=state, acc=acc,
-                                      start_block=start_block,
-                                      on_block=on_block)
+        try:
+            with dtrace:
+                reduced = sim.run_reduced(state=state, acc=acc,
+                                          start_block=start_block,
+                                          on_block=on_block)
+        except _PreemptStop as stop:
+            # the writer drain below IS the final snapshot (sync mode
+            # already saved synchronously in on_block)
+            _ckpt_teardown(writer, preempt)
+            _preempt_report(stop)
+            return sim
+        except BaseException:
+            _ckpt_teardown(writer, preempt, suppress=True)
+            raise
+        _ckpt_teardown(writer, preempt)
         ensemble = sim.ensemble_stats()
         sl, local = sim.local_reduced_view(reduced)
         _write_reduced_csv(file, local, ensemble, chain_start=sl.start or 0)
@@ -660,9 +815,11 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             )
 
     state, start_block = None, 0
-    if checkpoint and os.path.exists(checkpoint):
-        state, start_block = ckpt.load(checkpoint, cfg)
-        logger.info("resuming from %s at block %d", checkpoint, start_block)
+    src, rsl = (_resume_source(checkpoint, ckpt_global, sim)
+                if checkpoint else (None, None))
+    if src:
+        state, start_block = ckpt.load_elastic(src, cfg, chain_slice=rsl)
+        logger.info("resuming from %s at block %d", src, start_block)
         reg.counter("resilience.resumed_total").inc()
         reg.gauge("resilience.resumed_block").set(start_block)
         # Exactly-once CSV rows: a crash can land between "rows of block b
@@ -707,20 +864,33 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             # megablock boundaries under blocks_per_dispatch > 1, where
             # sim.state is ahead of mid-megablock bi values.
             if checkpoint and sim.state_block == bi + 1:
-                ckpt.save(checkpoint, sim.host_local_tree(sim.state),
-                          bi + 1, cfg)
+                _save_ckpt(sim.host_local_tree(sim.state), bi + 1)
+            if preempt is not None and preempt.should_stop():
+                raise _PreemptStop(bi)
 
     tzname = (cfg.site_grid.timezone if cfg.site_grid is not None
               else cfg.site.timezone)
     dtrace = device_trace(profile_dir) if profile_dir else \
         contextlib.nullcontext()
-    with dtrace:
-        if write_trace:
-            write_csv(file, blocks(), chain=chain, tz=ZoneInfo(tzname),
-                      append=start_block > 0)
-        else:  # non-owning host: run every block (collectives), no CSV
-            for _ in blocks():
-                pass
+    try:
+        with dtrace:
+            if write_trace:
+                write_csv(file, blocks(), chain=chain, tz=ZoneInfo(tzname),
+                          append=start_block > 0)
+            else:  # non-owning host: run every block (collectives), no CSV
+                for _ in blocks():
+                    pass
+    except _PreemptStop as stop:
+        # rows through stop.block are on disk (the save fires only after
+        # write_csv consumed the block); draining the writer makes the
+        # matching snapshot durable before the clean exit
+        _ckpt_teardown(writer, preempt)
+        _preempt_report(stop)
+        return sim
+    except BaseException:
+        _ckpt_teardown(writer, preempt, suppress=True)
+        raise
+    _ckpt_teardown(writer, preempt)
     stats = timer.summary()
     # steady_block_s is None when only the compile-inclusive first block
     # was timed (single-block runs) — say so rather than fake a steady rate

@@ -87,10 +87,13 @@ def _activate_chaos(chaos, chaos_seed) -> None:
         faults.install_from_env()
 
 
-def _maybe_supervise(subcommand: str, supervise: int) -> None:
+def _maybe_supervise(subcommand: str, supervise: int,
+                     grace_s=None) -> None:
     """``--supervise N``: rerun this command as a restarting child
     (runtime/supervise.py) and exit with its final code.  A supervised
-    child (env marker set) falls through and just runs."""
+    child (env marker set) falls through and just runs.  ``grace_s``
+    (``--preempt-grace``) bounds the child's final-snapshot window after
+    a forwarded stop signal before the supervisor SIGKILLs it."""
     if supervise <= 0:
         return
     from tmhpvsim_tpu.runtime import supervise as sup
@@ -98,7 +101,8 @@ def _maybe_supervise(subcommand: str, supervise: int) -> None:
     if os.environ.get(sup.ENV_RESTART) is not None:
         return
     raise SystemExit(sup.run_supervised(sup.child_argv(subcommand),
-                                        max_restarts=supervise))
+                                        max_restarts=supervise,
+                                        grace_s=grace_s))
 
 
 def _setup_logging(verbose: int) -> None:
@@ -321,6 +325,27 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "backend): overlap block N's gather/CSV with block "
                    "N+1's device dispatch; forced off by --checkpoint "
                    "(config.SimConfig.output_overlap)")
+@click.option("--checkpoint-keep", "checkpoint_keep", type=int, default=3,
+              show_default=True, metavar="N",
+              help="Checkpoint generations retained on disk (jax "
+                   "backend): the anchor plus the newest N rotated "
+                   ".g<gen> snapshots named by the sidecar integrity "
+                   "manifest; a torn latest generation falls back to "
+                   "the newest one that verifies (engine/checkpoint.py)")
+@click.option("--checkpoint-async", "checkpoint_async",
+              type=click.Choice(["off", "on"]), default="off",
+              show_default=True,
+              help="Background checkpoint writes (jax backend): the "
+                   "scan loop pays only the device->host gather; "
+                   "serialization, checksums, fsync and rotation happen "
+                   "on a writer thread.  off = today's synchronous save")
+@click.option("--preempt-grace", "preempt_grace", type=float, default=0.0,
+              show_default=True, metavar="S",
+              help="Preemption grace seconds (jax backend): SIGTERM "
+                   "finishes the current block, drains one final "
+                   "snapshot and exits cleanly; with --supervise the "
+                   "supervisor SIGKILLs a child still alive S seconds "
+                   "after the stop signal.  0 = SIGTERM dies immediately")
 @click.option("--supervise", "supervise", type=int, default=0,
               metavar="N",
               help="Run as a supervised child and warm-restart it on a "
@@ -334,10 +359,12 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
           analytics, metrics_path, run_report_path, compile_cache,
           blocks_per_dispatch, compute_dtype, kernel_impl, output_overlap,
+          checkpoint_keep, checkpoint_async, preempt_grace,
           supervise, chaos, chaos_seed):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
-    _maybe_supervise("pvsim", supervise)
+    _maybe_supervise("pvsim", supervise,
+                     grace_s=preempt_grace if preempt_grace > 0 else None)
     _activate_chaos(chaos, chaos_seed)
     if (site_grid_spec or sites_csv) and backend != "jax":
         raise click.UsageError("--site-grid/--sites-csv require "
@@ -370,6 +397,16 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--kernel-impl requires --backend=jax")
     if output_overlap != "auto" and backend != "jax":
         raise click.UsageError("--output-overlap requires --backend=jax")
+    if checkpoint_keep != 3 and backend != "jax":
+        raise click.UsageError("--checkpoint-keep requires --backend=jax")
+    if checkpoint_async != "off" and backend != "jax":
+        raise click.UsageError("--checkpoint-async requires --backend=jax")
+    if preempt_grace != 0.0 and backend != "jax":
+        raise click.UsageError("--preempt-grace requires --backend=jax")
+    if checkpoint_keep < 1:
+        raise click.UsageError("--checkpoint-keep must be >= 1")
+    if preempt_grace < 0:
+        raise click.UsageError("--preempt-grace must be >= 0")
     if backend == "jax":
         from tmhpvsim_tpu.apps.pvsim import pvsim_jax
 
@@ -385,13 +422,13 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         else:
             site_grid = _parse_site_grid(site_grid_spec)
         if seed is None:
-            import os as _os
+            from tmhpvsim_tpu.engine import checkpoint as _ckpt
 
-            if checkpoint and _os.path.exists(checkpoint):
+            if checkpoint and _ckpt.resumable(checkpoint):
                 # resuming without --seed: adopt the checkpoint's seed (a
-                # fresh random one would fail the config echo check)
-                from tmhpvsim_tpu.engine import checkpoint as _ckpt
-
+                # fresh random one would fail the config echo check);
+                # resumable() also sees rotated generations and per-host
+                # shards where a bare os.path.exists would miss
                 seed = _ckpt.peek_meta(checkpoint).get(
                     "config", {}).get("seed")
             if seed is None:
@@ -413,7 +450,10 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   trace=trace, compile_cache=compile_cache,
                   blocks_per_dispatch=blocks_per_dispatch,
                   compute_dtype=compute_dtype, kernel_impl=kernel_impl,
-                  output_overlap=output_overlap)
+                  output_overlap=output_overlap,
+                  checkpoint_keep=checkpoint_keep,
+                  checkpoint_async=checkpoint_async,
+                  preempt_grace_s=preempt_grace)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main

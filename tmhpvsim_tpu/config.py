@@ -503,3 +503,25 @@ class SimConfig:
     #: bucket that fits (padding rows carry horizon_s=0 and fold
     #: nothing).
     serve_batch_sizes: tuple = ()
+
+    #: checkpoint generations retained on disk (engine/checkpoint.py
+    #: rotation: the anchor plus the newest N ``.g<gen>`` siblings named
+    #: by the sidecar manifest).  Operational robustness, not identity —
+    #: NOT part of the checkpoint config echo, so changing it across a
+    #: resume is safe.
+    checkpoint_keep: int = 3
+
+    #: "on" moves checkpoint serialization to a background writer thread
+    #: (the scan loop pays only the device->host gather; the disk write,
+    #: checksum, fsync and rotation happen off the critical path).
+    #: "off" (the default) keeps today's synchronous save.  Pure host
+    #: plumbing — NOT part of the checkpoint config echo.
+    checkpoint_async: str = "off"
+
+    #: seconds of preemption grace: > 0 arms a SIGTERM handler that
+    #: finishes the current block, takes one final synchronous snapshot
+    #: and exits cleanly (the supervisor bounds the window with SIGKILL,
+    #: runtime/supervise.py).  0 keeps SIGTERM's default die-now
+    #: behaviour.  Host-side lifecycle only — NOT part of the
+    #: checkpoint config echo.
+    preempt_grace_s: float = 0.0

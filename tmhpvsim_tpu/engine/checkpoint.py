@@ -1,4 +1,4 @@
-"""Checkpoint/resume for the blockwise simulation.
+"""Preemption-safe checkpoint/resume for the blockwise simulation.
 
 The reference has no checkpointing at all — every restart loses the whole
 stochastic state (SURVEY.md §5).  Here the design makes it nearly free: all
@@ -7,23 +7,72 @@ simulation state is one pytree of arrays plus a block offset
 ``save -> restart -> load -> resume`` reproduces the uninterrupted run
 bit-for-bit (verified by test_checkpoint.py).
 
-Format: a single ``.npz`` with '/'-joined pytree paths; PRNG key arrays are
-stored via ``jax.random.key_data`` under a ``key:`` prefix and re-wrapped on
-load.  No orbax dependency — the state is a few MB and plain npz keeps the
-file greppable and future-proof.
+Format: each snapshot is a single ``.npz`` with '/'-joined pytree paths;
+PRNG key arrays are stored via ``jax.random.key_data`` under a ``key:``
+prefix and re-wrapped on load.  No orbax dependency — the state is a few
+MB and plain npz keeps the file greppable and future-proof.
+
+On-disk layout (rotation + integrity, this module's preemption story):
+
+* ``PATH`` — the anchor the caller names.  Always a complete npz of the
+  newest generation (a hard link to it, so it costs no space), which
+  keeps every ``os.path.exists(PATH)`` / ``load(PATH)`` consumer and
+  every pre-rotation checkpoint working unchanged.
+* ``PATH.g<N>`` — generation N's snapshot; the newest ``keep`` of them
+  are retained.
+* ``PATH.manifest.json`` — the sidecar integrity manifest: per-generation
+  size + CRC32 + sha256 + resume block, and which generation is
+  last-known-good.  ``load`` verifies the newest generation against it
+  and falls back generation by generation when a torn write is detected
+  — a WARN and one lost block, never a dead run.  A checkpoint without
+  a manifest is a legacy single file and loads as generation 0.
+
+Durability: the snapshot bytes are fsync'd before the atomic rename and
+the parent directory is fsync'd after it (and again after the manifest
+rewrite), so a power loss after ``save`` returns cannot lose the
+generation — the satellite fix for the rename-only window the original
+writer had.
+
+Topology elasticity: ``save`` records the logical chain-axis *layout*
+(which global chains this file holds, under what mesh/process topology)
+as placement metadata, strictly separate from the identity echo
+(``_config_echo``).  Identity mismatches — seed, rng_stream, models,
+chain count — are still refused with the exact config-diff error;
+placement deltas never refuse: ``load_elastic`` reassembles per-host
+``PATH.host<i>`` shards into the full chain axis and reslices to the
+resuming topology, so a run saved on 8 devices (or K host shards)
+resumes on 1 device or a different mesh.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import glob
+import hashlib
 import json
-from typing import Tuple
+import logging
+import os
+import re
+import shutil
+import threading
+import time
+import zlib
+from typing import List, Optional, Tuple
 
 import jax
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 _KEY_PREFIX = "key:"
 _META = "__meta__"
+
+#: generations retained by ``save`` when the caller does not say
+DEFAULT_KEEP = 3
+
+#: sidecar manifest format (bumped only on incompatible manifest changes)
+MANIFEST_FORMAT = 1
 
 #: Version of the *random-stream layout* (how draws are derived from keys
 #: and global indices).  Bump whenever the derivation changes — v2
@@ -37,13 +86,44 @@ _META = "__meta__"
 RNG_STREAM_VERSION = 3
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint that cannot be used: missing, truncated, not an npz,
+    or metadata-less.  Carries the path/size/verify detail and an
+    actionable hint instead of a raw ``zipfile.BadZipFile``/``KeyError``.
+    """
+
+    _HINT = ("delete the checkpoint (and its .manifest.json / .g* "
+             "siblings) to start fresh, or point --checkpoint at the "
+             "file that belongs to this run")
+
+    def __init__(self, path: str, detail: str, *,
+                 size: Optional[int] = None, hint: Optional[str] = None):
+        self.path = path
+        self.detail = detail
+        self.size = size
+        msg = f"checkpoint {path}: {detail}"
+        if size is not None:
+            msg += f" (size {size} bytes)"
+        super().__init__(f"{msg} — {hint or self._HINT}")
+
+
+class CheckpointCorruptError(CheckpointError):
+    """Every recorded generation failed integrity verification — raised
+    only after the generation-by-generation fallback is exhausted."""
+
+
 def _config_echo(config) -> dict:
-    """The full run configuration as JSON-able data — including site and
-    model options, whose silent divergence across a resume would change
-    physics/branch selection mid-trace.  Performance knobs (block_impl,
-    scan_unroll, slab_chains, blocks_per_dispatch, ...) are deliberately
-    NOT echoed: every plan produces bit-identical trajectories, so a
-    resume may run under a different plan than the run that saved."""
+    """The *identity* half of the config split: the full run
+    configuration as JSON-able data — including site and model options,
+    whose silent divergence across a resume would change physics/branch
+    selection mid-trace.  A mismatch on any of these keys REFUSES the
+    resume.  Performance knobs (block_impl, scan_unroll, slab_chains,
+    blocks_per_dispatch, ...) are deliberately NOT echoed: every plan
+    produces bit-identical trajectories, so a resume may run under a
+    different plan than the run that saved.  *Placement* (mesh shape,
+    device/process count, which chain slice a file holds) is never part
+    of the echo either — it rides ``meta['layout']`` and a mismatch
+    there reshards on load instead of refusing (``load_elastic``)."""
     return {
         "start": config.start,
         "duration_s": config.duration_s,
@@ -91,92 +171,686 @@ def _unflatten(flat, prng_impl: str = "threefry2x32"):
     return tree
 
 
-def save(path: str, state, next_block: int, config=None) -> None:
+def _build_meta(flat, next_block: int, config, layout) -> dict:
+    meta = {"next_block": int(next_block)}
+    if config is not None:
+        meta["prng_impl"] = getattr(config, "prng_impl", "threefry2x32")
+        meta["config"] = _config_echo(config)
+    else:
+        # no config: infer the impl from the stored key_data layout
+        # (threefry: 2 words, rbg: 4) so bare save()/load()
+        # round-trips still reconstruct the right key type
+        widths = {v.shape[-1] for k, v in flat.items()
+                  if k.startswith(_KEY_PREFIX)}
+        meta["prng_impl"] = "rbg" if widths == {4} else "threefry2x32"
+    if layout is not None:
+        meta["layout"] = dict(layout)
+    return meta
+
+
+def manifest_path(path: str) -> str:
+    return path + ".manifest.json"
+
+
+def _dir_of(path: str) -> str:
+    return os.path.dirname(path) or "."
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """Durability for renames/creates: fsync the directory entry itself
+    (no-op on filesystems/platforms that refuse directory fds)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - platform-dependent
+        pass
+    finally:
+        os.close(fd)
+
+
+def _digest(path: str) -> Tuple[int, int, str]:
+    """(size, crc32, sha256-hex) of a file, streamed."""
+    crc = 0
+    sha = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            size += len(chunk)
+            crc = zlib.crc32(chunk, crc)
+            sha.update(chunk)
+    return size, crc & 0xFFFFFFFF, sha.hexdigest()
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    """The sidecar manifest of checkpoint ``path``, or None when absent
+    or unreadable (an unreadable manifest degrades to legacy single-file
+    behaviour with a WARN — the data file may still be fine)."""
+    mp = manifest_path(path)
+    try:
+        with open(mp) as f:
+            man = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        logger.warning("checkpoint manifest %s unreadable (%s); "
+                       "treating checkpoint as a legacy single file",
+                       mp, e)
+        return None
+    if not isinstance(man, dict) or \
+            not isinstance(man.get("generations"), list):
+        logger.warning("checkpoint manifest %s malformed; treating "
+                       "checkpoint as a legacy single file", mp)
+        return None
+    return man
+
+
+def _write_manifest(path: str, man: dict) -> None:
+    mp = manifest_path(path)
+    tmp = mp + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, mp)
+    _fsync_dir(_dir_of(path))
+
+
+def _point_anchor(path: str, gpath: str) -> None:
+    """Atomically make the anchor ``path`` a complete copy of the newest
+    generation.  A hard link costs no space and shares the inode (so a
+    torn write through either name damages exactly one generation);
+    filesystems without hard links get a plain copy."""
+    lnk = path + ".lnk.tmp"
+    with contextlib.suppress(FileNotFoundError):
+        os.remove(lnk)
+    try:
+        os.link(gpath, lnk)
+    except OSError:  # pragma: no cover - no-hardlink filesystems
+        shutil.copyfile(gpath, lnk)
+        with open(lnk, "rb") as f:
+            with contextlib.suppress(OSError):
+                os.fsync(f.fileno())
+    os.replace(lnk, path)
+
+
+def _write_generation(path: str, flat: dict, meta: dict, keep: int) -> None:
+    """One durable rotation step: serialize to tmp, fsync, checksum,
+    promote to ``path.g<N>``, re-point the anchor, rewrite the manifest,
+    prune beyond ``keep``.  The anchor and manifest always describe a
+    fully-written generation — there is no window where a crash leaves
+    the checkpoint unusable (test_checkpoint.py torn-write matrix)."""
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+    d = _dir_of(path)
+    man = read_manifest(path)
+    gen = int((man or {}).get("latest", 0)) + 1
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat, **{_META: json.dumps(meta)})
+        f.flush()
+        os.fsync(f.fileno())
+    size, crc, sha = _digest(tmp)
+    gpath = f"{path}.g{gen}"
+    os.replace(tmp, gpath)
+    _fsync_dir(d)
+    _point_anchor(path, gpath)
+    _fsync_dir(d)
+    entries = [e for e in (man or {}).get("generations", [])
+               if isinstance(e, dict)
+               and os.path.exists(os.path.join(d, e.get("file", "")))]
+    entries.append({
+        "gen": gen,
+        "file": os.path.basename(gpath),
+        "size": size,
+        "crc32": crc,
+        "sha256": sha,
+        "next_block": int(meta.get("next_block", 0)),
+        "saved_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    })
+    keep = max(1, int(keep))
+    kept, pruned = entries[-keep:], entries[:-keep]
+    _write_manifest(path, {
+        "format": MANIFEST_FORMAT,
+        "keep": keep,
+        "latest": gen,
+        "generations": kept,
+    })
+    for e in pruned:
+        with contextlib.suppress(OSError):
+            os.remove(os.path.join(d, e["file"]))
+    reg = obs_metrics.get_registry()
+    reg.gauge("checkpoint.generations").set(len(kept))
+    reg.gauge("checkpoint.latest_generation").set(gen)
+
+
+def _commit(path: str, flat: dict, meta: dict, keep: int) -> None:
+    """Chokepoint-instrumented write: ``checkpoint.write`` fires before
+    anything touches disk (a failed save must leave the previous good
+    checkpoint intact); ``checkpoint.corrupt`` fires after the commit so
+    a ``truncate:K`` rule tears the just-written generation — the
+    deterministic torn write the fallback tests recover from;
+    ``checkpoint.committed`` fires last (a kill scheduled there is the
+    crash-with-valid-checkpoint the recovery tests resume from)."""
+    from tmhpvsim_tpu.runtime import faults
+
+    if faults.ACTIVE is not None:
+        faults.fire("checkpoint.write")
+    _write_generation(path, flat, meta, keep)
+    if faults.ACTIVE is not None:
+        faults.fire("checkpoint.corrupt", path=path)
+        faults.fire("checkpoint.committed")
+
+
+def save(path: str, state, next_block: int, config=None, *,
+         keep: Optional[int] = None, layout: Optional[dict] = None) -> None:
     """Write state + resume point (+ config echo for sanity checks).
 
-    Atomic: writes ``path + '.tmp'`` then ``os.replace``s it, so a crash
-    mid-save never corrupts the previous good checkpoint.  Writing through
-    an open file object also keeps the exact filename (bare ``np.savez``
-    silently appends '.npz', which would break resume-by-existence checks).
+    Durable and atomic: the snapshot is fsync'd, promoted to a new
+    generation via ``os.replace``, the anchor re-pointed, the manifest
+    rewritten and the parent directory fsync'd — a crash or power loss
+    at ANY instant leaves the newest verifiable generation loadable.
+    ``keep`` bounds the generations retained (default
+    :data:`DEFAULT_KEEP`); ``layout`` attaches placement metadata
+    (``Simulation.checkpoint_layout()``) for topology-elastic resume.
     """
-    import os
-
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import annotate
-    from tmhpvsim_tpu.runtime import faults
 
     with obs_metrics.get_registry().timed("checkpoint.save_s"), \
             annotate("tmhpvsim/checkpoint.save"):
-        if faults.ACTIVE is not None:
-            # "write" fires before anything touches disk (a failed save
-            # must leave the previous good checkpoint intact)
-            faults.fire("checkpoint.write")
         flat = _flatten(state)
-        meta = {"next_block": int(next_block)}
-        if config is not None:
-            meta["prng_impl"] = getattr(config, "prng_impl",
-                                        "threefry2x32")
-            meta["config"] = _config_echo(config)
-        else:
-            # no config: infer the impl from the stored key_data layout
-            # (threefry: 2 words, rbg: 4) so bare save()/load()
-            # round-trips still reconstruct the right key type
-            widths = {v.shape[-1] for k, v in flat.items()
-                      if k.startswith(_KEY_PREFIX)}
-            meta["prng_impl"] = "rbg" if widths == {4} else "threefry2x32"
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **flat, **{_META: json.dumps(meta)})
-        os.replace(tmp, path)
-        if faults.ACTIVE is not None:
-            # "committed" fires after the atomic rename: a kill scheduled
-            # here is the deterministic crash-with-valid-checkpoint the
-            # recovery tests resume from
-            faults.fire("checkpoint.committed")
+        meta = _build_meta(flat, next_block, config, layout)
+        _commit(path, flat, meta,
+                DEFAULT_KEEP if keep is None else keep)
+
+
+def _size_of(path: str) -> Optional[int]:
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return None
+
+
+def _read_npz(fpath: str) -> Tuple[dict, dict]:
+    with np.load(fpath, allow_pickle=False) as data:
+        meta = json.loads(str(data[_META]))
+        flat = {k: data[k] for k in data.files if k != _META}
+    return flat, meta
+
+
+def _verify_entry(fpath: str, entry: dict) -> Optional[str]:
+    """None when ``fpath`` matches its manifest entry, else the verify
+    failure (missing / size / crc32 / sha256 mismatch)."""
+    try:
+        st_size = os.path.getsize(fpath)
+    except OSError as e:
+        return f"missing ({e.__class__.__name__})"
+    want_size = entry.get("size")
+    if want_size is not None and st_size != want_size:
+        return f"size {st_size} != recorded {want_size}"
+    size, crc, sha = _digest(fpath)
+    if entry.get("crc32") is not None and crc != entry["crc32"]:
+        return f"crc32 {crc:#010x} != recorded {entry['crc32']:#010x}"
+    if entry.get("sha256") is not None and sha != entry["sha256"]:
+        return "sha256 mismatch"
+    return None
+
+
+def _check_config(meta: dict, config) -> None:
+    if config is None or "config" not in meta:
+        return
+    saved = meta["config"]
+    # Echoes written before a key existed compare as that key's
+    # then-implicit value, so old checkpoints stay resumable when the
+    # echo schema grows (keys added in round 2 listed here).
+    saved.setdefault("site_grid", None)
+    saved.setdefault("output", "trace")
+    saved.setdefault("prng_impl", "threefry2x32")
+    # no rng_stream key = stream layout v1: deliberately NOT defaulted
+    # to the current version, so pre-v2 checkpoints are refused rather
+    # than resumed onto a different random stream
+    saved.setdefault("rng_stream", 1)
+    current = json.loads(json.dumps(_config_echo(config)))  # tuple->list
+    if saved != current:
+        keys = set(saved) | set(current)
+        miss = object()
+        diffs = {k: (saved.get(k, miss), current.get(k, miss))
+                 for k in sorted(keys)
+                 if saved.get(k, miss) != current.get(k, miss)}
+        raise ValueError(
+            f"checkpoint was written by a different configuration: "
+            f"{diffs}"
+        )
+
+
+def _load_verified(path: str, config=None,
+                   want_block: Optional[int] = None) -> Tuple[dict, dict]:
+    """(flat, meta) of the newest generation that verifies against the
+    manifest — falling back generation by generation on torn writes
+    (WARN + ``checkpoint.verify_fail_total``/``checkpoint.fallback_total``
+    counters), :class:`CheckpointCorruptError` only when nothing does.
+    No manifest = legacy single file, loaded as generation 0 with typed
+    errors instead of raw zipfile/KeyError surprises.  ``want_block``
+    restricts the search to generations whose resume point matches (the
+    shard-reassembly path aligning stragglers)."""
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.get_registry()
+    d = _dir_of(path)
+    man = read_manifest(path)
+    if man is not None:
+        entries = sorted(
+            (e for e in man["generations"] if isinstance(e, dict)),
+            key=lambda e: e.get("gen", 0), reverse=True)
+        if want_block is not None:
+            entries = [e for e in entries
+                       if e.get("next_block") == want_block]
+        newest_nb = entries[0].get("next_block") if entries else None
+        tried: List[str] = []
+        for e in entries:
+            fpath = os.path.join(d, e.get("file", ""))
+            if not os.path.exists(fpath) and \
+                    e.get("gen") == man.get("latest") and \
+                    os.path.exists(path):
+                fpath = path  # anchor survives when the .g file was lost
+            bad = _verify_entry(fpath, e)
+            if bad is None:
+                try:
+                    flat, meta = _read_npz(fpath)
+                except Exception as exc:
+                    bad = (f"verified but unreadable "
+                           f"({exc.__class__.__name__}: {exc})")
+            if bad is not None:
+                reg.counter("checkpoint.verify_fail_total").inc()
+                logger.warning(
+                    "checkpoint %s generation %s failed verification: %s",
+                    path, e.get("gen"), bad)
+                tried.append(f"g{e.get('gen')}: {bad}")
+                continue
+            if tried:
+                reg.counter("checkpoint.fallback_total").inc()
+                lost = ""
+                if isinstance(newest_nb, int) and \
+                        isinstance(e.get("next_block"), int):
+                    lost = (f"; {newest_nb - e['next_block']} block(s) "
+                            f"of progress lost")
+                logger.warning(
+                    "checkpoint %s: falling back to generation %s "
+                    "(resumes at block %s%s)", path, e.get("gen"),
+                    e.get("next_block"), lost)
+            _check_config(meta, config)
+            return flat, meta
+        raise CheckpointCorruptError(
+            path, "no generation passed integrity verification "
+                  f"[{'; '.join(tried) or 'manifest lists none'}]",
+            size=_size_of(path))
+    # legacy single file: pre-rotation checkpoints load as generation 0
+    try:
+        flat, meta = _read_npz(path)
+    except FileNotFoundError as exc:
+        raise CheckpointError(path, "missing") from exc
+    except Exception as exc:
+        raise CheckpointError(
+            path, f"unreadable as a checkpoint npz "
+                  f"({exc.__class__.__name__}: {exc})",
+            size=_size_of(path)) from exc
+    _check_config(meta, config)
+    return flat, meta
+
+
+def _candidates(path: str):
+    """File paths that may hold this checkpoint's metadata, best first:
+    the anchor, then manifest generations newest-first, then per-host
+    shard anchors (a multi-host run has no combined anchor at all)."""
+    if os.path.exists(path):
+        yield path
+    man = read_manifest(path)
+    if man is not None:
+        d = _dir_of(path)
+        for e in sorted((e for e in man["generations"]
+                         if isinstance(e, dict)),
+                        key=lambda e: e.get("gen", 0), reverse=True):
+            fp = os.path.join(d, e.get("file", ""))
+            if fp != path and os.path.exists(fp):
+                yield fp
+    for sp in _shard_paths(path):
+        yield from _candidates(sp)
 
 
 def peek_meta(path: str) -> dict:
-    """Read only the metadata record (resume point + config echo)."""
-    with np.load(path, allow_pickle=False) as data:
-        return json.loads(str(data[_META]))
+    """Read only the metadata record (resume point + config echo) of the
+    newest readable generation — falls back like :func:`load` but skips
+    checksumming (callers peek for the seed, not for integrity)."""
+    last: Optional[BaseException] = None
+    for fpath in _candidates(path):
+        try:
+            with np.load(fpath, allow_pickle=False) as data:
+                return json.loads(str(data[_META]))
+        except Exception as exc:
+            last = exc
+    if last is None:
+        raise CheckpointError(path, "missing")
+    raise CheckpointError(
+        path, f"no readable metadata in any generation "
+              f"({last.__class__.__name__}: {last})",
+        size=_size_of(path)) from last
+
+
+def resumable(path: str) -> bool:
+    """True when an existing run can resume from ``path``: the anchor
+    exists, the manifest names a surviving generation, or per-host
+    ``path.host<i>`` shards exist (``load_elastic`` reassembles them).
+    The rotation-aware replacement for bare ``os.path.exists``."""
+    if os.path.exists(path):
+        return True
+    man = read_manifest(path)
+    if man is not None:
+        d = _dir_of(path)
+        if any(os.path.exists(os.path.join(d, e.get("file", "")))
+               for e in man["generations"] if isinstance(e, dict)):
+            return True
+    return any(resumable(sp) for sp in _shard_paths(path))
+
+
+def _shard_paths(path: str) -> List[str]:
+    """Per-host shard anchors ``path.host<i>`` in host order (the
+    multi-host pvsim naming, apps/pvsim.py)."""
+    found = []
+    pat = re.compile(re.escape(path) + r"\.host(\d+)$")
+    for p in glob.glob(glob.escape(path) + ".host*"):
+        m = pat.match(p)
+        if m:
+            found.append((int(m.group(1)), p))
+    return [p for _, p in sorted(found)]
 
 
 def load(path: str, config=None) -> Tuple[dict, int]:
-    """Read (state, next_block); verifies the config echo when given."""
+    """Read (state, next_block); verifies integrity against the manifest
+    (falling back to the newest generation that passes) and the config
+    echo when given."""
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import annotate
 
     with obs_metrics.get_registry().timed("checkpoint.restore_s"), \
             annotate("tmhpvsim/checkpoint.restore"):
-        return _load(path, config)
+        flat, meta = _load_verified(path, config)
+    return _finish_load(path, flat, meta)
 
 
+def _finish_load(path: str, flat: dict, meta: dict) -> Tuple[dict, int]:
+    nb = meta.get("next_block")
+    if not isinstance(nb, int):
+        raise CheckpointError(path, "metadata lacks a next_block resume "
+                                    "point")
+    return _unflatten(flat, meta.get("prng_impl", "threefry2x32")), nb
+
+
+# legacy private alias (kept: the old single-file loader's name)
 def _load(path: str, config=None) -> Tuple[dict, int]:
-    with np.load(path, allow_pickle=False) as data:
-        meta = json.loads(str(data[_META]))
-        flat = {k: data[k] for k in data.files if k != _META}
-    prng_impl = meta.get("prng_impl", "threefry2x32")
-    if config is not None and "config" in meta:
-        saved = meta["config"]
-        # Echoes written before a key existed compare as that key's
-        # then-implicit value, so old checkpoints stay resumable when the
-        # echo schema grows (keys added in round 2 listed here).
-        saved.setdefault("site_grid", None)
-        saved.setdefault("output", "trace")
-        saved.setdefault("prng_impl", "threefry2x32")
-        # no rng_stream key = stream layout v1: deliberately NOT defaulted
-        # to the current version, so pre-v2 checkpoints are refused rather
-        # than resumed onto a different random stream
-        saved.setdefault("rng_stream", 1)
-        current = json.loads(json.dumps(_config_echo(config)))  # tuple->list
-        if saved != current:
-            keys = set(saved) | set(current)
-            miss = object()
-            diffs = {k: (saved.get(k, miss), current.get(k, miss))
-                     for k in sorted(keys)
-                     if saved.get(k, miss) != current.get(k, miss)}
-            raise ValueError(
-                f"checkpoint was written by a different configuration: "
-                f"{diffs}"
-            )
-    return _unflatten(flat, prng_impl), meta["next_block"]
+    return _finish_load(path, *_load_verified(path, config))
+
+
+def _shard_chains(flat: dict, layout: Optional[dict]) -> int:
+    """The chain count of one shard/file: from its layout when recorded,
+    else inferred from a per-chain PRNG-key leaf (key_data is always
+    (n_chains, words))."""
+    if layout and isinstance(layout.get("chain_start"), int) and \
+            isinstance(layout.get("chain_stop"), int):
+        return layout["chain_stop"] - layout["chain_start"]
+    for k, v in flat.items():
+        if k.startswith(_KEY_PREFIX) and getattr(v, "ndim", 0) >= 1:
+            return int(v.shape[0])
+    raise CheckpointError(
+        "<shard>", "cannot infer the shard's chain count (no layout "
+                   "metadata and no per-chain key leaf)")
+
+
+def _assemble_shards(path: str, shards: List[str],
+                     config) -> Tuple[dict, dict]:
+    """Reassemble per-host ``path.host<i>`` shard files into one full
+    chain axis: every per-chain leaf (leading dim == the shard's chain
+    count) is concatenated in chain order; replicated leaves ride from
+    shard 0.  Shards whose newest generations disagree on the resume
+    point align on the OLDEST common block (each shard's rotation keeps
+    the generations to find it in)."""
+    loaded = []
+    for sp in shards:
+        flat, meta = _load_verified(sp, config)
+        loaded.append([sp, flat, meta])
+    blocks = {m.get("next_block") for _, _, m in loaded}
+    if len(blocks) > 1:
+        nb = min(b for b in blocks if isinstance(b, int))
+        logger.warning(
+            "checkpoint shards of %s disagree on the resume point %s; "
+            "aligning all shards on block %d", path, sorted(blocks), nb)
+        for rec in loaded:
+            if rec[2].get("next_block") != nb:
+                try:
+                    rec[1], rec[2] = _load_verified(rec[0], config,
+                                                    want_block=nb)
+                except CheckpointError as exc:
+                    raise CheckpointCorruptError(
+                        path, f"shard {rec[0]} has no generation at the "
+                              f"common resume block {nb} ({exc.detail})"
+                    ) from exc
+    # chain order: by recorded layout when present, else host-index order
+    def start_of(rec):
+        lay = rec[2].get("layout") or {}
+        return lay.get("chain_start", shards.index(rec[0]))
+
+    loaded.sort(key=start_of)
+    sizes = [_shard_chains(flat, meta.get("layout"))
+             for _, flat, meta in loaded]
+    lays = [m.get("layout") or {} for _, _, m in loaded]
+    if all(isinstance(l.get("chain_start"), int) for l in lays):
+        pos = 0
+        for sp, lay, n in zip(shards, lays, sizes):
+            if lay["chain_start"] != pos:
+                raise CheckpointError(
+                    path, f"shard chain slices are not contiguous: "
+                          f"expected a shard starting at chain {pos}, "
+                          f"found [{lay['chain_start']}, "
+                          f"{lay.get('chain_stop')})")
+            pos += n
+    out = {}
+    base = loaded[0][1]
+    for k, v0 in base.items():
+        per_chain = getattr(v0, "ndim", 0) >= 1 and \
+            v0.shape[0] == sizes[0]
+        if per_chain:
+            out[k] = np.concatenate(
+                [flat[k] for _, flat, _ in loaded], axis=0)
+        else:
+            out[k] = v0
+    meta = dict(loaded[0][2])
+    lay = dict(lays[0]) if lays[0] else {}
+    total = sum(sizes)
+    lay.update(n_chains=lay.get("n_chains", total),
+               chain_start=0, chain_stop=total)
+    meta["layout"] = lay
+    return out, meta
+
+
+def _slice_chains(path: str, flat: dict, meta: dict,
+                  chain_slice: Tuple[int, int]) -> Tuple[dict, dict]:
+    """Restrict a loaded flat tree to global chains [a, b) — the resume
+    side of topology elasticity (a full checkpoint resuming on a pod
+    slice, or a reslice after shard reassembly)."""
+    a, b = int(chain_slice[0]), int(chain_slice[1])
+    lay = meta.get("layout") or {}
+    cur_a = lay.get("chain_start", 0)
+    n_cur = _shard_chains(flat, lay if lay else None)
+    cur_b = lay.get("chain_stop", cur_a + n_cur)
+    if (cur_a, cur_b) == (a, b):
+        return flat, meta
+    if not (cur_a <= a and b <= cur_b):
+        raise CheckpointError(
+            path, f"holds chains [{cur_a}, {cur_b}) which does not cover "
+                  f"the requested slice [{a}, {b})",
+            hint="resume with the checkpoint that holds these chains, "
+                 "or reassemble the full run from its .hostN shards")
+    off = a - cur_a
+    out = {k: (v[off:off + (b - a)]
+               if getattr(v, "ndim", 0) >= 1 and v.shape[0] == n_cur
+               else v)
+           for k, v in flat.items()}
+    meta = dict(meta)
+    lay = dict(lay)
+    lay.update(chain_start=a, chain_stop=b)
+    meta["layout"] = lay
+    return out, meta
+
+
+def load_elastic(path: str, config=None, *,
+                 chain_slice: Optional[Tuple[int, int]] = None
+                 ) -> Tuple[dict, int]:
+    """Topology-elastic :func:`load`: resume a checkpoint on a different
+    chain-axis placement than it was saved under.
+
+    * ``path`` exists (anchor or manifest): verified load, then — when
+      ``chain_slice=(a, b)`` asks for a sub-range — the per-chain leaves
+      are sliced to global chains [a, b) (a full single-host checkpoint
+      resuming on one host of a pod slice).
+    * ``path`` absent but ``path.host<i>`` shards exist: the shards are
+      reassembled into the full chain axis (and then optionally sliced)
+      — a K-host run resuming on 1 host, or on a different K.
+
+    Identity is still enforced per underlying file (``_config_echo``
+    diff ValueError); only placement is elastic.
+    """
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.obs.profiler import annotate
+
+    with obs_metrics.get_registry().timed("checkpoint.restore_s"), \
+            annotate("tmhpvsim/checkpoint.restore"):
+        if os.path.exists(path) or read_manifest(path) is not None:
+            flat, meta = _load_verified(path, config)
+        else:
+            shards = _shard_paths(path)
+            if not shards:
+                raise CheckpointError(
+                    path, "missing (no anchor, no manifest generation, "
+                          "no .host<i> shards)")
+            flat, meta = _assemble_shards(path, shards, config)
+        if chain_slice is not None:
+            flat, meta = _slice_chains(path, flat, meta, chain_slice)
+    return _finish_load(path, flat, meta)
+
+
+class AsyncCheckpointWriter:
+    """Checkpoint serialization off the critical path.
+
+    ``submit`` runs the device→host gather synchronously (``_flatten``'s
+    ``np.asarray`` per leaf IS the copy, so the snapshot is safe against
+    the donation of the next block's carry — the same staging discipline
+    as the double-buffered host output, PR 9) and hands the host bytes
+    to a daemon thread that serializes, checksums, fsyncs, rotates and
+    commits.  The scan loop never waits on the disk.
+
+    Latest-wins queue of depth one: submitting while a snapshot is still
+    pending replaces it (``checkpoint.async_dropped_total`` counts the
+    superseded ones) — a newer state strictly dominates an older
+    unwritten one, and a slow disk degrades checkpoint *cadence*, never
+    block walls.  Write failures WARN and count
+    (``checkpoint.async_write_failures_total``); :meth:`close` drains
+    the queue and re-raises if the LAST write failed, so a run cannot
+    silently finish without its final checkpoint durable on disk.
+    """
+
+    def __init__(self, path: str, *, config=None,
+                 keep: Optional[int] = None):
+        from tmhpvsim_tpu.obs import metrics as obs_metrics
+
+        self.path = path
+        self.config = config
+        self.keep = DEFAULT_KEEP if keep is None else keep
+        self._reg = obs_metrics.get_registry()
+        self._depth = self._reg.gauge("checkpoint.async_queue_depth")
+        self._cond = threading.Condition()
+        self._pending: Optional[Tuple[dict, dict]] = None
+        self._busy = False
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def submit(self, state, next_block: int,
+               layout: Optional[dict] = None) -> None:
+        """Snapshot ``state`` (synchronous host gather) and queue the
+        durable write.  Returns as soon as the host copy exists."""
+        flat = _flatten(state)
+        meta = _build_meta(flat, next_block, self.config, layout)
+        with self._cond:
+            if self._pending is not None:
+                self._reg.counter("checkpoint.async_dropped_total").inc()
+            self._pending = (flat, meta)
+            self._depth.set(1 + (1 if self._busy else 0))
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait()
+                if self._pending is None:
+                    return  # stopped and drained
+                flat, meta = self._pending
+                self._pending = None
+                self._busy = True
+                self._depth.set(1)
+            err: Optional[BaseException] = None
+            try:
+                with self._reg.timed("checkpoint.save_s"):
+                    _commit(self.path, flat, meta, self.keep)
+                self._reg.counter("checkpoint.async_saves_total").inc()
+            except BaseException as e:  # surfaces at close(); run goes on
+                err = e
+                self._reg.counter(
+                    "checkpoint.async_write_failures_total").inc()
+                logger.warning("async checkpoint write to %s failed: %s",
+                               self.path, e)
+            with self._cond:
+                self._error = err  # a later success clears it
+                self._busy = False
+                self._depth.set(1 if self._pending is not None else 0)
+                self._cond.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until the queue is drained (True) or ``timeout`` expires
+        (False) — the preemption-grace path's bounded final sync."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending is not None or self._busy:
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(rem)
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain and stop the writer.  Raises :class:`CheckpointError`
+        when the final write failed — a finishing run must not pretend
+        its last checkpoint is on disk when it is not."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():  # pragma: no cover - stuck disk
+            raise CheckpointError(
+                self.path, "async checkpoint writer failed to drain",
+                hint="the filesystem is stalled; the last snapshot may "
+                     "not be durable")
+        if self._error is not None:
+            raise CheckpointError(
+                self.path,
+                f"final async checkpoint write failed "
+                f"({self._error.__class__.__name__}: {self._error})"
+            ) from self._error

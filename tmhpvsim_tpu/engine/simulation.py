@@ -2280,6 +2280,33 @@ class Simulation:
         (per-host checkpoint files, apps/pvsim.py)."""
         return tree
 
+    def checkpoint_layout(self) -> dict:
+        """Placement metadata for ``checkpoint.save(layout=...)``: which
+        global chains this process's checkpoint file holds and under what
+        topology.  Never identity — a resume under a different topology
+        reshards from this record (checkpoint.load_elastic) instead of
+        refusing.  An explicit slab config (autotune's chain_offset
+        carving) reports its slice of the notional full run."""
+        from tmhpvsim_tpu.parallel.distributed import chain_layout
+
+        cfg = self.config
+        total = getattr(cfg, "n_chains_total", None) or cfg.n_chains
+        lay = chain_layout(total, getattr(self, "mesh", None))
+        off = getattr(cfg, "chain_offset", 0) or 0
+        if total != cfg.n_chains or off:
+            lay.update(n_chains=int(total),
+                       chain_start=int(off),
+                       chain_stop=int(off + cfg.n_chains))
+        return lay
+
+    def resume_chain_slice(self):
+        """The (start, stop) global chain range this process should load
+        when resuming from a FULL (unsharded) checkpoint, or None when it
+        needs the whole chain axis.  The multi-host sharded subclass
+        returns its local slice so ``checkpoint.load_elastic`` can hand
+        each host exactly its chains (topology-elastic resume)."""
+        return None
+
     def local_reduced_view(self, reduced: dict) -> tuple:
         """(global chain slice, host-local dict) of a ``run_reduced``
         result — trivially everything on a single host; the sharded class

@@ -73,6 +73,15 @@ class SlabScheduler:
     def __len__(self):
         return len(self.slab_cfgs)
 
+    def checkpoint_layout(self) -> dict:
+        """Placement metadata for the scheduler's notional full run —
+        parity with ``Simulation.checkpoint_layout`` so a slabbed run's
+        checkpoints carry the same (full-axis) layout the unslabbed run
+        would write."""
+        from tmhpvsim_tpu.parallel.distributed import chain_layout
+
+        return chain_layout(self.config.n_chains, None)
+
     def _make_sim(self, cfg: SimConfig):
         from tmhpvsim_tpu.engine.simulation import Simulation
 

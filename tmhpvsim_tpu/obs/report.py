@@ -55,9 +55,18 @@ logger = logging.getLogger(__name__)
 #: optional ``probe`` section (bench.py backend-probe attempt/timeout
 #: accounting under runtime/resilience.ResiliencePolicy), and the
 #: ``compute_dtype`` / ``kernel_impl`` fields in the plan echo.
+#: v9: enriches the optional ``checkpoint`` section with the
+#: preemption-safe subsystem's accounting (engine/checkpoint.py):
+#: generation rotation (``generations`` on disk, ``latest_generation``),
+#: integrity outcomes (``verify_failures``, ``fallbacks`` to an older
+#: generation), the async writer (``async_saves``, ``async_dropped``
+#: latest-wins supersessions, ``async_write_failures``, peak
+#: ``async_queue_depth``) and ``preempt_snapshots`` (SIGTERM-grace /
+#: chaos-preempt final snapshots).  All additive — v8 readers of the
+#: section's original four keys are unaffected.
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 8
+REPORT_SCHEMA_VERSION = 9
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -475,15 +484,39 @@ class RunReport:
         self.metrics = snap
         hists = snap.get("histograms", {})
         gauges = snap.get("gauges", {})
+        counters = snap.get("counters", {})
         save = hists.get("checkpoint.save_s")
         restore = hists.get("checkpoint.restore_s")
-        if save or restore:
+        ck_extra = {name for src in (counters, gauges) for name in src
+                    if name.startswith("checkpoint.")}
+        if save or restore or ck_extra:
             self.checkpoint = {
                 "saves": (save or {}).get("count", 0),
                 "save_total_s": (save or {}).get("sum", 0.0),
                 "restores": (restore or {}).get("count", 0),
                 "restore_total_s": (restore or {}).get("sum", 0.0),
             }
+            # v9 additive keys, present only when the subsystem used
+            # the corresponding feature (engine/checkpoint.py)
+            for key, src, metric in (
+                ("generations", gauges, "checkpoint.generations"),
+                ("latest_generation", gauges,
+                 "checkpoint.latest_generation"),
+                ("verify_failures", counters,
+                 "checkpoint.verify_fail_total"),
+                ("fallbacks", counters, "checkpoint.fallback_total"),
+                ("async_saves", counters, "checkpoint.async_saves_total"),
+                ("async_dropped", counters,
+                 "checkpoint.async_dropped_total"),
+                ("async_write_failures", counters,
+                 "checkpoint.async_write_failures_total"),
+                ("async_queue_depth", gauges,
+                 "checkpoint.async_queue_depth"),
+                ("preempt_snapshots", counters,
+                 "checkpoint.preempt_snapshots_total"),
+            ):
+                if metric in src:
+                    self.checkpoint[key] = int(src[metric])
         if "slab.total" in gauges:
             self.slabs = {"completed": int(gauges.get("slab.completed", 0)),
                           "total": int(gauges["slab.total"])}

@@ -94,6 +94,35 @@ def local_chain_slice(n_chains: int, mesh) -> slice:
     return slice(lo * per_dev, hi * per_dev)
 
 
+def chain_layout(n_chains: int, mesh=None) -> dict:
+    """The logical chain-axis layout of this process's checkpoint file —
+    placement metadata riding ``meta['layout']`` (engine/checkpoint.py).
+
+    Strictly descriptive: which global chains [chain_start, chain_stop)
+    of the n_chains total this file holds, and under what topology
+    (process/device counts, mesh shape) it was written.  NEVER part of
+    the identity echo — a resume under a different topology reshards
+    from this record instead of refusing.
+    """
+    lay = {
+        "n_chains": int(n_chains),
+        "chain_start": 0,
+        "chain_stop": int(n_chains),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+    }
+    if mesh is not None:
+        lay["n_devices"] = int(mesh.devices.size)
+        lay["mesh_shape"] = [int(s) for s in mesh.devices.shape]
+        if jax.process_count() > 1:
+            sl = local_chain_slice(n_chains, mesh)
+            lay["chain_start"] = int(sl.start)
+            lay["chain_stop"] = int(sl.stop)
+    else:
+        lay["n_devices"] = 1
+    return lay
+
+
 def host_gather_ensemble(arr) -> np.ndarray:
     """Fetch a replicated (ensemble) array to host numpy.
 

@@ -485,11 +485,14 @@ class ShardedSimulation(Simulation):
         saved from (host numpy otherwise reaches ``_host_view`` unplaced
         when a resume has no blocks left to run).
 
-        Single host: a plain ``device_put`` of the full tree.  Pod slice:
-        each host loaded only ITS chain slice from its per-host checkpoint
-        file (``host_local_tree`` + apps/pvsim.py naming), so the global
-        sharded arrays are assembled with
-        ``jax.make_array_from_process_local_data`` — every process
+        Single host: a plain ``device_put`` of the full tree — including
+        a tree loaded from a checkpoint written under a DIFFERENT device
+        count or mesh shape (``checkpoint.load_elastic`` already
+        reassembled/resliced the chain axis; placement is elastic, only
+        identity refuses).  Pod slice: each host loaded only ITS chain
+        slice (its per-host checkpoint file, or its ``resume_chain_slice``
+        of a full checkpoint), so the global sharded arrays are assembled
+        with ``jax.make_array_from_process_local_data`` — every process
         contributes the contiguous chains its devices own, no DCN
         traffic.  PRNG-key leaves ride as their key_data words and are
         re-wrapped on the assembled array."""
@@ -526,6 +529,20 @@ class ShardedSimulation(Simulation):
             return self._host_view(v)
 
         return jax.tree.map(conv, tree)
+
+    def resume_chain_slice(self):
+        """This host's (start, stop) chain range for an elastic resume
+        from a FULL checkpoint (one written without per-host sharding):
+        None on a single host (load everything); on a pod slice the
+        contiguous range this host's devices own, so
+        ``checkpoint.load_elastic`` slices the full chain axis down to
+        exactly what ``_place_resume`` will contribute."""
+        if not self._is_multihost():
+            return None
+        from tmhpvsim_tpu.parallel.distributed import local_chain_slice
+
+        sl = local_chain_slice(self.config.n_chains, self.mesh)
+        return (int(sl.start), int(sl.stop))
 
     @staticmethod
     def _host_view(arr) -> np.ndarray:

@@ -13,8 +13,10 @@ Spec grammar (``--chaos SPEC`` / ``TMHPVSIM_CHAOS``)::
     RULE    := POINT '=' ACTION [':' ARG] '@' TRIGGER ['x' COUNT]
     POINT   := broker.connect | broker.publish | broker.deliver
              | tcp.partition | funnel.stall | serve.dispatch
-             | checkpoint.write | checkpoint.committed
+             | checkpoint.write | checkpoint.corrupt
+             | checkpoint.committed | signal.preempt
     ACTION  := raise | delay:SECONDS | drop | dup | kill
+             | truncate:BYTES
     TRIGGER := 'n'K        fire on the K-th call (1-based); 'x'C extends
                            the window to calls K .. K+C-1
              | 'every'K    fire on every K-th call; 'x'C caps total fires
@@ -27,12 +29,18 @@ Examples::
     broker.deliver=dup@p0.05x2       ~5% of deliveries duplicated, max 2
     funnel.stall=delay:0.5@every100  every 100th put stalls 0.5 s
     checkpoint.committed=kill@n2     SIGKILL right after the 2nd commit
+    checkpoint.corrupt=truncate:120@n2   tear the 2nd checkpoint write
+    signal.preempt=raise@n3          preemption notice on the 3rd block
 
 Actions: ``raise`` raises :class:`FaultInjected` (a ``ConnectionError``,
 so transport retry paths treat it as transient), ``delay:S`` sleeps,
 ``drop``/``dup`` are returned to the chokepoint which suppresses or
-repeats the unit of work, and ``kill`` delivers SIGKILL to this process
-— the deterministic mid-run crash used by the recovery tests.
+repeats the unit of work, ``kill`` delivers SIGKILL to this process
+— the deterministic mid-run crash used by the recovery tests — and
+``truncate:BYTES`` truncates the file the chokepoint passed as
+``path=...`` context down to BYTES bytes (the deterministic torn write
+the checkpoint fallback tests recover from; only ``checkpoint.corrupt``
+supplies a path today).
 
 Determinism: probability triggers draw from ``random.Random`` seeded
 from ``(plan seed, rule index)``, so firing is independent of rule
@@ -64,10 +72,12 @@ POINTS = (
     "funnel.stall",
     "serve.dispatch",
     "checkpoint.write",
+    "checkpoint.corrupt",
     "checkpoint.committed",
+    "signal.preempt",
 )
 
-ACTIONS = ("raise", "delay", "drop", "dup", "kill")
+ACTIONS = ("raise", "delay", "drop", "dup", "kill", "truncate")
 
 
 class FaultInjected(ConnectionError):
@@ -131,6 +141,16 @@ def _parse_rule(raw: str, idx: int, seed: int) -> _Rule:
             raise ValueError(
                 f"chaos rule {text!r}: delay needs seconds "
                 f"(delay:0.5)") from None
+    elif action == "truncate":
+        try:
+            arg = int(argtext)
+        except ValueError:
+            raise ValueError(
+                f"chaos rule {text!r}: truncate needs a byte offset "
+                f"(truncate:128)") from None
+        if arg < 0:
+            raise ValueError(
+                f"chaos rule {text!r}: truncate offset must be >= 0")
     elif argtext:
         raise ValueError(
             f"chaos rule {text!r}: action {action!r} takes no argument")
@@ -256,38 +276,55 @@ def _record(point: str, action: str) -> None:
     logger.warning("chaos: injecting %s at %s", action, point)
 
 
-def _apply(rule: _Rule, point: str):
+def _apply(rule: _Rule, point: str, ctx: dict):
     """Common tail of fire/afire once a rule fired: record, then either
-    kill/raise here or hand drop/dup/delay back to the caller."""
+    kill/raise/truncate here or hand drop/dup/delay back to the
+    caller.  ``ctx`` is the keyword context the chokepoint passed to
+    :func:`fire` (``truncate`` needs a ``path``)."""
     _record(point, rule.action)
     if rule.action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
         time.sleep(60)  # pragma: no cover - signal delivery race
     if rule.action == "raise":
         raise FaultInjected(f"injected fault at {point} ({rule.spec})")
+    if rule.action == "truncate":
+        path = ctx.get("path")
+        if path is None:
+            logger.warning("chaos: %s fired at %s but the chokepoint "
+                           "passed no path= context; nothing truncated",
+                           rule.spec, point)
+        else:
+            try:
+                size = os.path.getsize(path)
+                os.truncate(path, min(int(rule.arg), size))
+                logger.warning("chaos: truncated %s from %d to %d bytes",
+                               path, size, min(int(rule.arg), size))
+            except OSError as e:
+                logger.warning("chaos: truncate of %s failed: %s",
+                               path, e)
     return rule.action
 
 
-def fire(point: str):
+def fire(point: str, **ctx):
     """Synchronous chokepoint: returns ``"drop"``/``"dup"``/``None``;
     ``delay`` sleeps inline; ``raise`` raises :class:`FaultInjected`;
-    ``kill`` does not return.  Callers guard with
-    ``if faults.ACTIVE is not None:`` so the default path stays a single
-    attribute test."""
+    ``kill`` does not return; ``truncate`` tears the ``path=`` keyword
+    file in place.  Callers guard with ``if faults.ACTIVE is not None:``
+    so the default path stays a single attribute test."""
     plan = ACTIVE
     if plan is None:
         return None
     rule = plan.decide(point)
     if rule is None:
         return None
-    action = _apply(rule, point)
+    action = _apply(rule, point, ctx)
     if action == "delay":
         time.sleep(rule.arg)
         return None
     return action
 
 
-async def afire(point: str):
+async def afire(point: str, **ctx):
     """Async chokepoint twin of :func:`fire` (``delay`` awaits instead
     of blocking the loop)."""
     plan = ACTIVE
@@ -296,7 +333,7 @@ async def afire(point: str):
     rule = plan.decide(point)
     if rule is None:
         return None
-    action = _apply(rule, point)
+    action = _apply(rule, point, ctx)
     if action == "delay":
         import asyncio
 

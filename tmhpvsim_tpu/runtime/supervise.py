@@ -19,7 +19,16 @@ own supervisor even if a ``--supervise`` flag leaks through.
 
 A SIGINT/SIGTERM at the supervisor is forwarded to the child and ends
 supervision — an operator's ^C must stop the run, not fight a restart
-loop.
+loop.  With ``grace_s`` set (``--preempt-grace S``) the supervisor also
+bounds how long the child may spend on its final snapshot after the
+forwarded signal: a child still alive ``grace_s`` seconds after the stop
+signal is SIGKILLed — the TPU-preemption-notice shape, where the
+platform revokes the slice whether or not the snapshot finished.
+
+Restart backoff rides :class:`~tmhpvsim_tpu.runtime.resilience
+.ResiliencePolicy`'s decorrelated jitter rather than a hand-rolled
+deterministic exponential, so N supervised hosts restarting off the
+same outage don't synchronize into a thundering herd.
 """
 
 from __future__ import annotations
@@ -31,6 +40,8 @@ import subprocess
 import sys
 import time
 from typing import List, Optional, Sequence
+
+from tmhpvsim_tpu.runtime.resilience import ResiliencePolicy
 
 log = logging.getLogger(__name__)
 
@@ -82,18 +93,47 @@ def _describe_exit(rc: int) -> str:
     return f"with code {rc}"
 
 
+def _graceful_wait(proc: subprocess.Popen, stop_at: List[float],
+                   grace_s: Optional[float]) -> int:
+    """``proc.wait()`` that, once a stop signal has been forwarded
+    (``stop_at`` holds its monotonic timestamp), gives the child at most
+    ``grace_s`` seconds to finish its final snapshot before SIGKILL."""
+    if grace_s is None:
+        return proc.wait()
+    while True:
+        try:
+            return proc.wait(timeout=0.5)
+        except subprocess.TimeoutExpired:
+            if stop_at and time.monotonic() - stop_at[0] > grace_s:
+                log.warning(
+                    "supervised child still alive %.1f s after the stop "
+                    "signal; preemption grace expired — killing",
+                    grace_s)
+                proc.kill()
+                return proc.wait()
+
+
 def run_supervised(argv: Sequence[str], *, max_restarts: int,
                    backoff_base_s: float = 1.0,
                    backoff_max_s: float = 30.0,
+                   grace_s: Optional[float] = None,
                    env: Optional[dict] = None) -> int:
     """Run ``argv`` as a child, restarting on crash; returns the final
-    child's exit code (0 on any clean exit)."""
+    child's exit code (0 on any clean exit).  ``grace_s`` bounds the
+    child's final-snapshot window after a forwarded stop signal."""
     base_env = dict(os.environ if env is None else env)
     attempt = 0
     proc: Optional[subprocess.Popen] = None
     stop_sig: List[int] = []
+    stop_at: List[float] = []
+    policy = ResiliencePolicy(attempts=max_restarts + 1,
+                              base_delay_s=backoff_base_s,
+                              max_delay_s=backoff_max_s,
+                              name="supervise.restart")
 
     def _forward(signum, frame):
+        if not stop_sig:
+            stop_at.append(time.monotonic())
         stop_sig.append(signum)
         if proc is not None and proc.poll() is None:
             proc.send_signal(signum)
@@ -104,11 +144,12 @@ def run_supervised(argv: Sequence[str], *, max_restarts: int,
             old_handlers[s] = signal.signal(s, _forward)
         except ValueError:  # pragma: no cover - non-main-thread caller
             pass
+    prev = backoff_base_s
     try:
         while True:
             base_env[ENV_RESTART] = str(attempt)
             proc = subprocess.Popen(list(argv), env=base_env)
-            rc = proc.wait()
+            rc = _graceful_wait(proc, stop_at, grace_s)
             if rc == 0 or stop_sig:
                 return rc
             if attempt >= max_restarts:
@@ -118,8 +159,8 @@ def run_supervised(argv: Sequence[str], *, max_restarts: int,
                     max_restarts)
                 return rc
             attempt += 1
-            delay = min(backoff_max_s,
-                        backoff_base_s * 2.0 ** (attempt - 1))
+            delay = policy.backoff(attempt, prev)
+            prev = max(delay, backoff_base_s)
             log.warning(
                 "supervised child exited %s; warm restart %d/%d in "
                 "%.1f s", _describe_exit(rc), attempt, max_restarts,

@@ -1,0 +1,271 @@
+#!/usr/bin/env python3
+"""Verify checkpoints and pretty-print the v9 ``checkpoint`` report
+section.
+
+Two input kinds, auto-detected per argument:
+
+* a checkpoint path (the anchor a run's ``--checkpoint`` named, or its
+  ``.manifest.json`` sidecar): every generation recorded in the
+  integrity manifest is re-verified — file present, size, CRC32, sha256
+  — plus resumability (at least one generation loads), printed as a
+  table.  A manifest-less single file is checked as a legacy
+  generation-0 checkpoint.  Exit 1 when NOTHING verifies (a torn latest
+  generation with a good older one still exits 0: that is exactly the
+  fallback the runtime performs).
+* a JSON/JSONL document holding run reports (bench artifacts embed them
+  as ``run_report``): the ``checkpoint`` section is validated —
+  counts/totals well-typed, v9 keys integral when present — and
+  pretty-printed.  A document whose reports carry no checkpoint section
+  passes trivially (not every run checkpoints).
+
+Stdlib-only, like the other tools/ validators; wired non-fatally into
+benchmarks/run_tpu_round5b.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_NUM = (int, float)
+
+#: section keys: name -> (required, value must be an int, counts must
+#: be >= 0).  The four v8 keys are always present in a non-null section;
+#: the v9 rotation/async/preempt keys are additive.
+_KEYS = {
+    "saves": (True, True),
+    "save_total_s": (True, False),
+    "restores": (True, True),
+    "restore_total_s": (True, False),
+    "generations": (False, True),
+    "latest_generation": (False, True),
+    "verify_failures": (False, True),
+    "fallbacks": (False, True),
+    "async_saves": (False, True),
+    "async_dropped": (False, True),
+    "async_write_failures": (False, True),
+    "async_queue_depth": (False, True),
+    "preempt_snapshots": (False, True),
+}
+
+
+def validate_checkpoint(sec) -> list:
+    """Problems with one report's ``checkpoint`` section ([] = valid)."""
+    if sec is None:
+        return []
+    if not isinstance(sec, dict):
+        return [f"checkpoint section is {type(sec).__name__}, not dict"]
+    problems = []
+    for key, (required, integral) in _KEYS.items():
+        if key not in sec:
+            if required:
+                problems.append(f"missing key {key!r}")
+            continue
+        v = sec[key]
+        if integral and not isinstance(v, int):
+            problems.append(f"{key} is {type(v).__name__}, not int")
+        elif not isinstance(v, _NUM) or isinstance(v, bool):
+            problems.append(f"{key} is {type(v).__name__}, not numeric")
+        elif v < 0:
+            problems.append(f"{key} is negative ({v})")
+    if isinstance(sec.get("saves"), int) and isinstance(
+            sec.get("async_saves"), int):
+        if sec["async_saves"] > sec["saves"]:
+            problems.append(
+                f"async_saves {sec['async_saves']} exceeds saves "
+                f"{sec['saves']}")
+    return problems
+
+
+def print_checkpoint(label: str, sec: dict) -> None:
+    print(f"  [{label}]")
+    print(f"    saves {sec.get('saves', 0)} "
+          f"({sec.get('save_total_s', 0.0):.3f} s total), "
+          f"restores {sec.get('restores', 0)} "
+          f"({sec.get('restore_total_s', 0.0):.3f} s total)")
+    if "generations" in sec or "latest_generation" in sec:
+        print(f"    rotation: {sec.get('generations', '?')} "
+              f"generation(s) on disk, latest g"
+              f"{sec.get('latest_generation', '?')}")
+    vf, fb = sec.get("verify_failures", 0), sec.get("fallbacks", 0)
+    if vf or fb:
+        print(f"    integrity: {vf} verify failure(s), {fb} "
+              f"fallback(s) to an older generation")
+    if "async_saves" in sec:
+        print(f"    async: {sec['async_saves']} background save(s), "
+              f"{sec.get('async_dropped', 0)} superseded, "
+              f"{sec.get('async_write_failures', 0)} failed")
+    if sec.get("preempt_snapshots"):
+        print(f"    preemption: {sec['preempt_snapshots']} graceful "
+              f"final snapshot(s)")
+
+
+# --------------------------------------------------------------------------
+# on-disk checkpoint verification
+# --------------------------------------------------------------------------
+
+
+def _looks_like_checkpoint(path: str) -> bool:
+    """Heuristic input-kind switch: manifest sidecars and npz
+    checkpoints are verified on disk; .json/.jsonl go down the report
+    path."""
+    from tmhpvsim_tpu.engine import checkpoint as ckpt
+
+    if path.endswith(".manifest.json"):
+        return True
+    if path.endswith((".json", ".jsonl")):
+        return False
+    if ckpt.read_manifest(path) is not None or ckpt._shard_paths(path):
+        return True
+    if os.path.exists(path):  # a bare file: npz magic = zip "PK"
+        try:
+            with open(path, "rb") as f:
+                return f.read(2) == b"PK"
+        except OSError:
+            return False
+    return False
+
+
+def check_checkpoint(path: str, quiet: bool = False) -> bool:
+    """Verify one checkpoint's generations; True when it can resume."""
+    from tmhpvsim_tpu.engine import checkpoint as ckpt
+
+    if path.endswith(".manifest.json"):
+        path = path[: -len(".manifest.json")]
+    shards = ckpt._shard_paths(path)
+    if not os.path.exists(path) and \
+            ckpt.read_manifest(path) is None and shards:
+        if not quiet:
+            print(f"{path}: {len(shards)} per-host shard(s)")
+        return all(check_checkpoint(sp, quiet) for sp in shards)
+
+    man = ckpt.read_manifest(path)
+    d = os.path.dirname(path) or "."
+    ok_any = False
+    if man is None:
+        try:
+            meta = ckpt.peek_meta(path)
+            ok_any = True
+            if not quiet:
+                print(f"{path}: legacy single file (generation 0), "
+                      f"resumes at block {meta.get('next_block')}")
+        except ckpt.CheckpointError as e:
+            print(f"{path}: FAIL — {e}")
+        return ok_any
+
+    if not quiet:
+        print(f"{path}: manifest format {man.get('format')}, keep "
+              f"{man.get('keep')}, latest g{man.get('latest')}")
+    rows = []
+    for e in sorted((e for e in man["generations"] if isinstance(e, dict)),
+                    key=lambda e: e.get("gen", 0), reverse=True):
+        fpath = os.path.join(d, e.get("file", ""))
+        bad = ckpt._verify_entry(fpath, e)
+        if bad is None:
+            ok_any = True
+        rows.append((e.get("gen"), e.get("next_block"),
+                     e.get("size"), bad or "ok"))
+    if not quiet:
+        for gen, nb, size, verdict in rows:
+            print(f"    g{gen}: next_block {nb}, {size} bytes — "
+                  f"{verdict}")
+    anchor = ("ok" if os.path.exists(path) else "MISSING")
+    if not quiet:
+        print(f"    anchor: {anchor}; resumable: "
+              f"{'yes' if ok_any else 'NO'}")
+    if not ok_any:
+        print(f"{path}: FAIL — no generation passes verification")
+    return ok_any
+
+
+# --------------------------------------------------------------------------
+# report-document path (resilience_report.py shape)
+# --------------------------------------------------------------------------
+
+
+def _iter_docs(path: str):
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except ValueError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            yield json.loads(line)
+
+
+def _extract_reports(doc: dict):
+    """(label, report) pairs in a bench/report document."""
+    if doc.get("kind") == "tmhpvsim_tpu.run_report":
+        yield doc.get("app", "run"), doc
+        return
+    rep = doc.get("run_report")
+    if isinstance(rep, dict):
+        label = (doc.get("phase") or doc.get("variant")
+                 or rep.get("app") or "run")
+        yield label, rep
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate every checkpoint section in ``path``; True when all
+    present sections pass (absent = trivially true, with a note)."""
+    ok = True
+    seen = 0
+    for doc in _iter_docs(path):
+        if not isinstance(doc, dict):
+            continue
+        for label, rep in _extract_reports(doc):
+            sec = rep.get("checkpoint")
+            if sec is None:
+                continue
+            seen += 1
+            problems = validate_checkpoint(sec)
+            if problems:
+                ok = False
+                print(f"{path}: [{label}] INVALID checkpoint section:")
+                for p in problems:
+                    print(f"    - {p}")
+            elif not quiet:
+                print(f"{path}: checkpoint section valid")
+                print_checkpoint(label, sec)
+    if seen == 0 and not quiet:
+        print(f"{path}: no checkpoint sections (ok — not every run "
+              f"checkpoints)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="verify checkpoints on disk and validate/pretty-"
+                    "print v9 checkpoint report sections")
+    ap.add_argument("paths", nargs="+",
+                    help="checkpoint anchors / .manifest.json sidecars "
+                         "and/or JSON(L) report documents")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only print failures")
+    args = ap.parse_args(argv)
+    ok = True
+    for path in args.paths:
+        try:
+            if _looks_like_checkpoint(path):
+                ok = check_checkpoint(path, quiet=args.quiet) and ok
+            else:
+                ok = check_file(path, quiet=args.quiet) and ok
+        except FileNotFoundError:
+            print(f"{path}: no such file")
+            ok = False
+        except ValueError as e:
+            print(f"{path}: malformed JSON ({e})")
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
