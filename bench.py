@@ -68,17 +68,12 @@ N_ROUNDS = 3   # best-of rounds: the remote-TPU tunnel's throughput varies
 CPU_N_CHAINS = 256
 CPU_N_BLOCKS = 2
 
-#: Peak rates used for the roofline fractions, per chip.
-#: * TPU v5e HBM: 819 GB/s (public v5e spec sheet).
-#: * TPU v5e VPU f32: ~6.1e12 op/s — DERIVED estimate, not a published
-#:   number: the public 197 TFLOP/s bf16 MXU spec with 4 128x128 MXUs
-#:   implies a ~1.5 GHz clock; the VPU is (8, 128) lanes x 4 independent
-#:   ALUs (scaling-book hardware chapter) = 4096 f32 lanes -> 6.1e12/s.
-#: Fractions against an estimated peak are labelled as such in the output.
-_PEAKS = {
-    "TPU v5 lite": {"hbm_gbs": 819.0, "vpu_f32_gops": 6100.0,
-                    "vpu_is_estimate": True},
-}
+#: Peak rates used for the roofline fractions, per chip — the single
+#: definition (provenance included) lives in obs/cost.py now so the
+#: live device.cost.* gauges, report validation and bench price against
+#: the same numbers.
+from tmhpvsim_tpu.obs.cost import NORTH_STAR  # noqa: E402
+from tmhpvsim_tpu.obs.cost import PEAKS as _PEAKS  # noqa: E402
 
 _PROBE_SRC = (
     "import jax, jax.numpy as jnp;"
@@ -257,7 +252,8 @@ def _bench_timing(compile_s, steady_wall_s, n_timed_blocks, rate) -> dict:
 def _bench_report(app: str, *, config=None, plan=None, timing=None,
                   headline=None, profile=None, slabs=None,
                   device=None, executor=None,
-                  precision=None, checkpoint=None) -> dict | None:
+                  precision=None, checkpoint=None,
+                  cost=None) -> dict | None:
     """A validated obs RunReport document, embedded ADDITIVELY in a bench
     artifact as ``doc["run_report"]`` (the legacy ad-hoc fields stay —
     battery scripts key richness decisions off them).  Never raises: a
@@ -283,12 +279,29 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
         rep.executor = executor
         rep.precision = precision
         rep.checkpoint = checkpoint
+        rep.cost = cost  # v10 cost-attribution section (obs/cost.py)
         # every bench artifact records how the backend probe went — the
         # v8 ``probe`` section; None when this path never probed
         rep.probe = _probe_doc()
         return rep.doc()
     except Exception as e:
         print(f"# run_report build failed ({app}): {e}", file=sys.stderr)
+        return None
+
+
+def _config_cost(plan, rate, device_kind) -> dict | None:
+    """Static-model cost doc (obs/cost.py) for a config artifact's
+    resolved plan × measured per-chip rate.  Never raises."""
+    try:
+        from tmhpvsim_tpu.obs import cost as obs_cost
+
+        p = plan if isinstance(plan, dict) else (_plan_doc(plan) or {})
+        return obs_cost.cost_doc(
+            site_s_per_s=rate, block_impl=p.get("block_impl"),
+            compute_dtype=p.get("compute_dtype"),
+            kernel_impl=p.get("kernel_impl"), device_kind=device_kind)
+    except Exception as e:
+        print(f"# cost doc failed: {e}", file=sys.stderr)
         return None
 
 
@@ -422,7 +435,7 @@ def _impl_label(sim) -> str:
         return sim._impl
     return "fused" if sim._use_fused else "split"
 
-NORTH_STAR = 100_000 * 365.25 * 86400 / 60.0 / 8.0  # site-s/s/chip
+# NORTH_STAR (site-s/s/chip) is imported from obs/cost.py above
 REF_CEILING = 100.0  # simulated s/s/process, reference --no-realtime
 
 
@@ -570,6 +583,35 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
     pick = full or ok
     best_name = max(pick, key=lambda k: pick[k]["rate"])
     rate = ok[best_name]["rate"]
+
+    # price EVERY landed variant (obs/cost.py): static plan-cell model ×
+    # its measured rate; the winner additionally carries the measured XLA
+    # per-site flops/bytes when the roofline tail ran (basis: measured)
+    import math
+
+    from tmhpvsim_tpu.obs import cost as obs_cost
+
+    roofline = extra.get("roofline") or {}
+    for name, v in ok.items():
+        vplan = v.get("plan") or {}
+        measured = {}
+        f_ss = roofline.get("flops_per_site_second")
+        if (name == best_name and isinstance(f_ss, (int, float))
+                and math.isfinite(f_ss) and f_ss > 0):
+            measured = dict(
+                measured_flops_per_site_s=f_ss,
+                measured_bytes_per_site_s=roofline.get(
+                    "bytes_per_site_second"))
+        try:
+            v["cost"] = obs_cost.cost_doc(
+                site_s_per_s=v["rate"],
+                block_impl=vplan.get("block_impl") or v.get("impl"),
+                compute_dtype=vplan.get("compute_dtype"),
+                kernel_impl=vplan.get("kernel_impl"),
+                device_kind=extra.get("device_kind"), **measured)
+        except Exception as e:  # pricing must never cost the headline
+            print(f"# cost doc failed for {name}: {e}", file=sys.stderr)
+
     doc = {
         "metric": "simulated site-seconds/sec/chip",
         "value": rate,
@@ -604,6 +646,7 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
                 "device_kind": extra.get("device_kind")},
         precision=_precision_doc(variants),
         checkpoint=extra.get("checkpoint_overhead"),
+        cost=ok[best_name].get("cost"),
     )
     return doc
 
@@ -1029,6 +1072,7 @@ def _reduce_config_run(label: str, cfg, sharded: bool, note: str,
         f"bench.config.{label}", config=cfg, plan=_plan_doc(sim.plan),
         timing=_bench_timing(compile_s, steady_s, sim.n_blocks - 1, rate),
         headline={"site_seconds_per_s": doc["value"]},
+        cost=_config_cost(sim.plan, doc["value"], doc["device_kind"]),
     )
     _persist_partial({"phase": "config", **doc})
     print(json.dumps(doc))
@@ -1120,6 +1164,7 @@ def _reduce_config_run_slabs(label: str, cfgs: list, note: str,
                              rate),
         headline={"site_seconds_per_s": doc["value"]},
         slabs={"completed": len(slab_echo), "total": len(cfgs)},
+        cost=_config_cost(slab_plan, doc["value"], doc["device_kind"]),
     )
     _persist_partial({"phase": "config", **doc})
     print(json.dumps(doc))

@@ -250,5 +250,19 @@ for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
   python tools/ckpt_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- ckpt_report: MALFORMED CHECKPOINT SECTION $bench_doc rc=$?" >> "$LOG"
 done
+# cost sanity (non-fatal), same contract as the loops above: any doc
+# carrying a v10 'cost' section (obs/cost.py — static model flops/bytes
+# per site-second for the plan's block_impl x compute_dtype x
+# kernel_impl cell, achieved GFLOP/s-GB/s, roofline and north-star
+# fractions) must carry a WELL-FORMED one; pre-v10 docs just note the
+# absence.  The headline doc is where bench.py prices every landed
+# variant.
+for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
+                 benchmarks/BENCH_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- cost_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/cost_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- cost_report: MALFORMED COST SECTION $bench_doc rc=$?" >> "$LOG"
+done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

@@ -149,6 +149,8 @@ _SLOW_LANE = {
     # warm-start executor acceptance: two full-size timed arms (fused vs
     # per-block dispatch) at 65536 chains on CPU
     "test_fused_dispatch_no_slower_65536_chains",
+    # live-ops acceptance: trace-stamped vs off arms at 65536 chains
+    "test_trace_stamp_overhead_65536_chains",
 }
 
 

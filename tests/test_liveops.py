@@ -1,0 +1,985 @@
+"""Live ops plane (obs/live.py, obs/cost.py, trace propagation):
+
+* OpenMetrics exposition format + the pinned ``quantile_from_snapshot``
+  edge rules (single-bucket snapshots, boundary quantiles);
+* the static cost model, its v10 ``cost`` report section (v1–v9 docs
+  still validate), ``device.cost.*`` gauges, and tools/cost_report.py;
+* the HTTP endpoints (/metrics /healthz /readyz /flight) over both
+  lifecycles, readiness semantics under drain + breaker chaos (driven
+  with runtime/faults.py and an injected breaker clock — no sleeps);
+* cross-process trace propagation: off-by-default wire identity,
+  stamp/extract/scope mechanics, HLO byte-identity with propagation on,
+  the 8-client serve soak proving one trace id correlates
+  client → broker → batcher → fused dispatch → reply on all three
+  transports, and ``tools/trace_stats.py --stitch``;
+* the bench_trend ``cost`` column.
+
+Port-binding tests carry the ``netport`` marker (deselect with
+``-m 'not netport'`` in sandboxes that forbid localhost listeners).
+"""
+
+import asyncio
+import contextlib
+import json
+import pathlib
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.obs import cost as obs_cost
+from tmhpvsim_tpu.obs import trace as obs_trace
+from tmhpvsim_tpu.obs.live import ObsServer, maybe_obs_server
+from tmhpvsim_tpu.obs.metrics import (
+    OPENMETRICS_CONTENT_TYPE,
+    MetricsRegistry,
+    quantile_from_snapshot,
+    use_registry,
+)
+from tmhpvsim_tpu.obs.report import (
+    REPORT_SCHEMA_VERSION,
+    RunReport,
+    validate_report,
+)
+from tmhpvsim_tpu.obs.trace import Tracer
+from tmhpvsim_tpu.runtime import faults
+from tmhpvsim_tpu.runtime.faults import FaultPlan
+from tmhpvsim_tpu.runtime.tcpbroker import TcpFanoutBroker
+from tmhpvsim_tpu.serve.server import (
+    ScenarioClient,
+    ScenarioServer,
+    ServeConfig,
+)
+
+# reuse test_amqp's fake aio_pika (registers the fixture here too)
+from test_amqp import fake_aio_pika  # noqa: F401
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TRACE_STATS = REPO / "tools" / "trace_stats.py"
+COST_REPORT = REPO / "tools" / "cost_report.py"
+BENCH_TREND = REPO / "tools" / "bench_trend.py"
+sys.path.insert(0, str(REPO / "tools"))
+
+import trace_stats  # noqa: E402  (the stitcher, imported as a library)
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def scfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=120,
+        n_chains=4,
+        seed=7,
+        block_s=60,
+        dtype="float32",
+        output="reduce",
+        block_impl="scan",
+        scan_unroll=1,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+async def _http_get(port: int, path: str, host: str = "127.0.0.1"):
+    """Raw one-shot GET against an ObsServer; returns (status, headers,
+    body-bytes)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: {host}\r\n\r\n"
+                 .encode("ascii"))
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    with contextlib.suppress(Exception):
+        await writer.wait_closed()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, body
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+
+class TestOpenMetrics:
+    def test_counter_total_suffix_and_eof(self):
+        reg = MetricsRegistry()
+        reg.counter("broker.published").inc(3)
+        reg.gauge("clock.lag_s").set(1.5)
+        text = reg.openmetrics_text()
+        assert "# TYPE tmhpvsim_broker_published counter" in text
+        assert "tmhpvsim_broker_published_total 3" in text
+        assert "tmhpvsim_clock_lag_s 1.5" in text
+        # the two spec-mandated divergences from Prometheus text format
+        assert text.endswith("# EOF\n")
+        prom = reg.prometheus_text()
+        assert "tmhpvsim_broker_published 3" in prom
+        assert "# EOF" not in prom
+
+    def test_histogram_exposition_is_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.5, 2.0):
+            h.observe(v)
+        text = reg.openmetrics_text(prefix="")
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1"} 3' in text
+        assert 'lat_bucket{le="+Inf"} 4' in text
+        assert "lat_count 4" in text
+
+    def test_exposition_parses(self):
+        """Every sample line is ``name[{labels}] value`` — the shape an
+        OpenMetrics scraper tokenises."""
+        import re
+
+        reg = MetricsRegistry()
+        reg.counter("a.b-c").inc()
+        reg.gauge("g").set(-0.25)
+        reg.histogram("h").observe(1e9)
+        lines = reg.openmetrics_text().splitlines()
+        assert lines[-1] == "# EOF"
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9eE.+-]+$')
+        for line in lines[:-1]:
+            if line.startswith("#"):
+                assert line.startswith("# TYPE "), line
+            else:
+                assert sample.match(line), line
+
+    def test_content_type_constant(self):
+        assert "application/openmetrics-text" in OPENMETRICS_CONTENT_TYPE
+
+
+# ---------------------------------------------------------------------------
+# quantile_from_snapshot: the pinned edge rules
+# ---------------------------------------------------------------------------
+
+
+class TestQuantileEdges:
+    def test_single_bucket_interpolates_observed_span(self):
+        snap = {"count": 4, "min": 0.2, "max": 0.6,
+                "buckets": [(1.0, 4), (5.0, 4)]}
+        assert quantile_from_snapshot(snap, 0.5) == pytest.approx(0.4)
+        assert quantile_from_snapshot(snap, 0.0) == pytest.approx(0.2)
+        assert quantile_from_snapshot(snap, 1.0) == pytest.approx(0.6)
+
+    def test_single_bucket_without_minmax_returns_bound(self):
+        # a snapshot rebuilt from sparse JSON: min/max lost
+        snap = {"count": 4, "buckets": [(1.0, 4), (5.0, 4)]}
+        assert quantile_from_snapshot(snap, 0.5) == pytest.approx(1.0)
+
+    def test_boundary_quantile_returns_bucket_bound(self):
+        # q*count lands EXACTLY on the first bucket's cumulative count:
+        # the answer is that bound, never an interpolation past it
+        snap = {"count": 10, "min": 0.0, "max": 2.0,
+                "buckets": [(1.0, 5), (2.0, 10)]}
+        assert quantile_from_snapshot(snap, 0.5) == pytest.approx(1.0)
+        # interior target interpolates as usual
+        assert quantile_from_snapshot(snap, 0.75) == pytest.approx(1.5)
+
+    def test_beyond_last_finite_bucket_is_observed_max(self):
+        snap = {"count": 10, "min": 0.5, "max": 9.0,
+                "buckets": [(1.0, 5)]}
+        assert quantile_from_snapshot(snap, 0.9) == pytest.approx(9.0)
+
+    def test_empty_and_zero_count_are_none(self):
+        assert quantile_from_snapshot(None, 0.5) is None
+        assert quantile_from_snapshot({}, 0.5) is None
+        assert quantile_from_snapshot({"count": 0, "buckets": []},
+                                      0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# cost model + v10 report section
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_base_cell_is_the_round5_anchor(self):
+        doc = obs_cost.model_cost()
+        assert doc["model"] == obs_cost.MODEL
+        assert doc["flops_per_site_s"] == obs_cost.BASE_FLOPS_PER_SITE_S
+        assert doc["bytes_per_site_s"] == obs_cost.BASE_BYTES_PER_SITE_S
+        assert (doc["block_impl"], doc["compute_dtype"],
+                doc["kernel_impl"]) == ("scan", "f32", "exact")
+
+    def test_axis_factors_compose(self):
+        doc = obs_cost.model_cost("scan2", "bf16", "table")
+        assert doc["flops_per_site_s"] == pytest.approx(
+            390.0 * 0.98 * 1.0 * 0.45, abs=0.01)
+        assert doc["bytes_per_site_s"] == pytest.approx(
+            96.0 * 0.97 * 0.55 * 1.15, abs=0.01)
+
+    def test_auto_and_unknown_axes_price_as_default(self):
+        assert obs_cost.model_cost("auto", None, "") \
+            == obs_cost.model_cost()
+        weird = obs_cost.model_cost("hypothetical-impl")
+        assert weird["flops_per_site_s"] \
+            == obs_cost.BASE_FLOPS_PER_SITE_S
+
+    def test_cost_doc_north_star_and_roofline(self):
+        doc = obs_cost.cost_doc(site_s_per_s=obs_cost.NORTH_STAR,
+                                device_kind="TPU v5 lite")
+        assert doc["north_star_frac"] == pytest.approx(1.0)
+        assert doc["achieved_gflops"] == pytest.approx(
+            390.0 * obs_cost.NORTH_STAR / 1e9, rel=1e-3)
+        assert doc["roofline_frac_vpu"] == pytest.approx(
+            doc["achieved_gflops"] / 6100.0, rel=1e-3)
+        assert doc["peaks"]["vpu_is_estimate"] is True
+        assert doc["basis"] == "model"
+        assert obs_cost.validate_cost(doc) == []
+
+    def test_unknown_device_has_no_roofline(self):
+        doc = obs_cost.cost_doc(site_s_per_s=1e6, device_kind="cpu")
+        assert "roofline_frac_vpu" not in doc
+        assert "peaks" not in doc
+        assert obs_cost.validate_cost(doc) == []
+
+    def test_measured_inputs_take_precedence(self):
+        doc = obs_cost.cost_doc(site_s_per_s=1e9,
+                                measured_flops_per_site_s=500.0,
+                                measured_bytes_per_site_s=100.0)
+        assert doc["basis"] == "measured"
+        assert doc["achieved_gflops"] == pytest.approx(500.0)
+        assert doc["achieved_gbs"] == pytest.approx(100.0)
+        # the static prediction stays alongside as a model-quality signal
+        assert doc["flops_per_site_s"] == 390.0
+
+    def test_no_rate_no_achieved_fields(self):
+        doc = obs_cost.cost_doc(site_s_per_s=None)
+        assert "achieved_gflops" not in doc
+        assert "north_star_frac" not in doc
+        assert obs_cost.validate_cost(doc) == []
+
+    def test_publish_gauges_numeric_fields_only(self):
+        reg = MetricsRegistry()
+        doc = obs_cost.cost_doc(site_s_per_s=1.2e9,
+                                device_kind="TPU v5 lite")
+        obs_cost.publish_gauges(reg, doc)
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["device.cost.north_star_frac"] \
+            == doc["north_star_frac"]
+        assert gauges["device.cost.achieved_gflops"] \
+            == doc["achieved_gflops"]
+        assert "device.cost.model" not in gauges  # strings don't gauge
+
+    def test_validate_cost_catches_malformed(self):
+        doc = obs_cost.cost_doc(site_s_per_s=1e6)
+        assert obs_cost.validate_cost("nope")
+        bad = dict(doc)
+        del bad["model"]
+        bad["north_star_frac"] = "0.18"
+        bad["basis"] = "vibes"
+        errs = "; ".join(obs_cost.validate_cost(bad))
+        assert "cost.model" in errs
+        assert "cost.north_star_frac" in errs
+        assert "cost.basis" in errs
+
+    def test_north_star_matches_roadmap(self):
+        # 100k users x 1 simulated year / 1 min wall on 8 chips
+        assert obs_cost.NORTH_STAR == pytest.approx(
+            100_000 * 365.25 * 86400 / 60.0 / 8.0)
+
+
+class TestReportV10:
+    def test_cost_section_round_trips(self):
+        assert REPORT_SCHEMA_VERSION == 10
+        rep = RunReport("test")
+        rep.cost = obs_cost.cost_doc(
+            site_s_per_s=1.2e9, block_impl="scan2",
+            compute_dtype="bf16", kernel_impl="table",
+            device_kind="TPU v5 lite")
+        doc = json.loads(json.dumps(rep.doc()))
+        assert doc["schema_version"] == 10
+        validate_report(doc)
+
+    def test_malformed_cost_section_rejected(self):
+        rep = RunReport("test")
+        rep.cost = {"model": None}
+        with pytest.raises(ValueError, match="cost"):
+            rep.doc()
+
+    @pytest.mark.parametrize("old", list(range(1, 10)))
+    def test_v1_v9_documents_still_validate(self, old):
+        since = {"telemetry": 2, "streaming": 3, "executor": 4,
+                 "fleet": 5, "serving": 6, "resilience": 7,
+                 "precision": 8, "probe": 8, "cost": 10}
+        rep = RunReport("test")
+        rep.cost = obs_cost.cost_doc(site_s_per_s=1e6)
+        doc = rep.doc()
+        legacy = {k: v for k, v in doc.items()
+                  if since.get(k, 1) <= old}
+        legacy["schema_version"] = old
+        validate_report(legacy)
+
+
+# ---------------------------------------------------------------------------
+# trace propagation: mechanics + off-path identity
+# ---------------------------------------------------------------------------
+
+
+class TestTracePropagation:
+    def test_off_by_default_and_stamp_is_identity(self):
+        assert obs_trace.propagation_enabled() is False
+        meta = {"seq": 3}
+        # the wire-identity contract: the off path returns the SAME
+        # object, so no transport encodes anything extra
+        assert obs_trace.stamp(meta) is meta
+        assert obs_trace.stamp(None) is None
+        assert obs_trace.extract({"trace_id": "t"}) is None
+
+    def test_stamp_mints_and_does_not_mutate(self):
+        meta = {"seq": 1}
+        with obs_trace.use_propagation(True):
+            out = obs_trace.stamp(meta)
+        assert meta == {"seq": 1}  # input untouched
+        assert out["seq"] == 1
+        assert len(out["trace_id"]) == 32
+        assert len(out["span_id"]) == 16
+
+    def test_scope_continues_trace_across_stamp(self):
+        with obs_trace.use_propagation(True):
+            with obs_trace.trace_scope("feedcafe" * 4):
+                a = obs_trace.stamp({})
+                b = obs_trace.stamp({})
+            assert a["trace_id"] == b["trace_id"] == "feedcafe" * 4
+            assert a["span_id"] != b["span_id"]
+
+    def test_extracted_binds_consume_side_context(self):
+        with obs_trace.use_propagation(True):
+            wire = obs_trace.stamp({"seq": 9})
+            assert obs_trace.current_trace() is None
+            with obs_trace.extracted(wire) as ctx:
+                assert ctx[0] == wire["trace_id"]
+                assert obs_trace.current_trace() == ctx
+            assert obs_trace.current_trace() is None
+            # foreign/malformed metas never raise, never bind
+            with obs_trace.extracted({"trace_id": 7}) as ctx:
+                assert ctx is None
+
+    def test_spans_carry_bound_trace_id(self):
+        tracer = Tracer()
+        with obs_trace.use_propagation(True):
+            with obs_trace.trace_scope("ab" * 16):
+                with tracer.span("work", "test"):
+                    pass
+                tracer.instant("mark", "test")
+        events = tracer.events()
+        assert all(e["args"]["trace_id"] == "ab" * 16 for e in events)
+
+    def test_spans_unstamped_when_off(self):
+        tracer = Tracer()
+        with tracer.span("work", "test"):
+            pass
+        assert "trace_id" not in tracer.events()[0].get("args", {})
+
+    def test_scope_follows_created_tasks(self):
+        async def main():
+            with obs_trace.use_propagation(True):
+                with obs_trace.trace_scope("cd" * 16):
+                    task = asyncio.create_task(_child())
+                return await task
+
+        async def _child():
+            return obs_trace.current_trace()
+
+        ctx = _run(main())
+        assert ctx[0] == "cd" * 16
+
+
+class TestHLOIdentityWithPropagation:
+    @pytest.mark.parametrize("impl", ["scan", "scan2"])
+    def test_block_jit_identical_on_vs_off(self, impl):
+        """Propagation is host-side only: the reduce block jit must
+        lower to byte-identical HLO whether or not stamping is enabled
+        and a trace context is bound while building/lowering."""
+        from tmhpvsim_tpu.engine import Simulation
+
+        def lowered() -> str:
+            sim = Simulation(scfg(block_impl=impl))
+            state = sim.init_state()
+            acc = sim.init_reduce_acc()
+            inputs, _ = sim.host_inputs(0)
+            jit = (sim._scan_acc_jit if impl == "scan"
+                   else sim._scan2_acc_jit)
+            return jit.lower(state, inputs, acc).as_text()
+
+        off = lowered()
+        with obs_trace.use_propagation(True), \
+                obs_trace.trace_scope(obs_trace.new_trace_id()):
+            on = lowered()
+        assert on == off
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.netport
+class TestObsServerEndpoints:
+    def test_metrics_healthz_readyz_flight(self):
+        async def main():
+            reg = MetricsRegistry()
+            reg.counter("engine.blocks").inc(2)
+            tracer = Tracer()
+            tracer.instant("block", "engine", block=0)
+            state = {"ok": False}
+            obs = ObsServer(0, registry=reg, tracer=tracer,
+                            ready=lambda: (state["ok"],
+                                           {"detail": "warming"}))
+            await obs.start()
+            assert obs.port != 0  # resolved from the ephemeral bind
+            try:
+                st, hd, body = await _http_get(obs.port, "/healthz")
+                assert st == 200 and body == b"ok\n"
+
+                st, hd, body = await _http_get(obs.port, "/metrics")
+                assert st == 200
+                assert hd["content-type"] == OPENMETRICS_CONTENT_TYPE
+                text = body.decode()
+                assert "tmhpvsim_engine_blocks_total 2" in text
+                assert text.endswith("# EOF\n")
+                # the scrape itself is counted — visible next scrape
+                st, _, body = await _http_get(obs.port, "/metrics")
+                assert b"tmhpvsim_obs_live_requests_total" in body
+
+                st, _, body = await _http_get(obs.port, "/readyz")
+                assert st == 503
+                assert json.loads(body) == {"detail": "warming",
+                                            "ready": False}
+                state["ok"] = True
+                st, _, body = await _http_get(obs.port, "/readyz")
+                assert st == 200 and json.loads(body)["ready"] is True
+
+                st, hd, body = await _http_get(obs.port, "/flight")
+                assert st == 200
+                doc = json.loads(body)
+                names = [e.get("name") for e in doc["traceEvents"]]
+                assert "block" in names
+
+                assert (await _http_get(obs.port, "/nope"))[0] == 404
+            finally:
+                await obs.stop()
+        _run(main())
+
+    def test_flight_404_when_tracing_off(self):
+        async def main():
+            obs = await ObsServer(0, registry=MetricsRegistry()).start()
+            try:
+                st, _, body = await _http_get(obs.port, "/flight")
+                assert st == 404 and b"tracing off" in body
+            finally:
+                await obs.stop()
+        _run(main())
+
+    def test_non_get_is_405_and_broken_probe_is_503(self):
+        async def main():
+            def broken():
+                raise RuntimeError("probe exploded")
+
+            obs = ObsServer(0, registry=MetricsRegistry(), ready=broken)
+            await obs.start()
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1",
+                                                     obs.port)
+                w.write(b"POST /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+                await w.drain()
+                raw = await r.read()
+                w.close()
+                assert b"405" in raw.split(b"\r\n", 1)[0]
+
+                st, _, body = await _http_get(obs.port, "/readyz")
+                assert st == 503
+                assert "probe exploded" in json.loads(body)["error"]
+            finally:
+                await obs.stop()
+        _run(main())
+
+    def test_threaded_lifecycle_and_bind_error_in_caller(self):
+        reg = MetricsRegistry()
+        reg.gauge("device.cost.north_star_frac").set(0.18)
+        obs = ObsServer(0, registry=reg).start_threaded()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{obs.port}/metrics",
+                    timeout=5) as resp:
+                text = resp.read().decode()
+            assert "tmhpvsim_device_cost_north_star_frac 0.18" in text
+            # a second server on the SAME port: the bind error must
+            # surface in the caller, synchronously, not on the thread
+            clash = ObsServer(obs.port, registry=reg)
+            with pytest.raises(OSError):
+                clash.start_threaded()
+        finally:
+            obs.close_threaded()
+        # idempotent close
+        obs.close_threaded()
+
+    def test_maybe_obs_server_none_is_inert(self):
+        async def main():
+            async with maybe_obs_server(None) as obs:
+                assert obs is None
+        _run(main())
+
+
+# ---------------------------------------------------------------------------
+# /readyz semantics: warm-up, breaker chaos, drain — no sleeps
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.netport
+@pytest.mark.chaos
+class TestReadyzServeSemantics:
+    def test_readyz_tracks_warmup_breaker_and_drain(self):
+        url = "local://readyz-chaos"
+        cfg = ServeConfig(sim=scfg(), url=url, window_s=0.05,
+                          batch_sizes=(1,), timeout_s=300.0,
+                          breaker_threshold=2, breaker_reset_s=60.0)
+        scen = {"demand_scale": 1.1, "horizon_s": 120}
+
+        async def ask(client, timeout=60.0):
+            return await client.request(scen, timeout=timeout)
+
+        async def readyz(port):
+            st, _, body = await _http_get(port, "/readyz")
+            return st, json.loads(body)
+
+        async def main():
+            reg = MetricsRegistry()
+            with use_registry(reg):
+                server = ScenarioServer(cfg, registry=reg)
+                obs = ObsServer(0, registry=reg,
+                                ready=server.readiness)
+                await obs.start()
+                try:
+                    # before warm-up: 503, and the detail says why
+                    st, body = await readyz(obs.port)
+                    assert st == 503 and body["warm"] is False
+
+                    await server.start()
+                    st, body = await readyz(obs.port)
+                    assert st == 200 and body == {
+                        "breaker": "closed", "draining": False,
+                        "ready": True, "warm": True}
+
+                    # drive the breaker with injected dispatch faults
+                    # and an injected clock — deterministic, no sleeps
+                    clock = [1000.0]
+                    breaker = server.batcher.breaker
+                    breaker._now = lambda: clock[0]
+                    async with ScenarioClient(url) as c:
+                        with faults.active(FaultPlan.parse(
+                                "serve.dispatch=raise@n1x2")):
+                            for _ in range(2):
+                                r = await ask(c)
+                                assert not r["ok"]
+                                assert r["error"]["code"] == "internal"
+                        st, body = await readyz(obs.port)
+                        assert st == 503 and body["breaker"] == "open"
+
+                        # past reset_s: half-open is still NOT ready
+                        # (the probe hasn't proven anything yet)
+                        clock[0] += cfg.breaker_reset_s + 1
+                        st, body = await readyz(obs.port)
+                        assert st == 503
+                        assert body["breaker"] == "half_open"
+
+                        # a successful probe closes it: ready again
+                        r = await ask(c)
+                        assert r["ok"]
+                        st, body = await readyz(obs.port)
+                        assert st == 200 and body["breaker"] == "closed"
+
+                    # draining: immediately not ready
+                    server.begin_drain()
+                    st, body = await readyz(obs.port)
+                    assert st == 503 and body["draining"] is True
+                finally:
+                    await obs.stop()
+                    await server.stop()
+        _run(main())
+
+
+# ---------------------------------------------------------------------------
+# the serve soak: 8 clients, one trace id across the whole path,
+# stitched per-process timelines — on all three transports
+# ---------------------------------------------------------------------------
+
+
+N_SOAK_CLIENTS = 8
+
+
+async def _soak(url, tmp_path, tag):
+    """8 concurrent clients against a warm server with propagation on;
+    returns after asserting the stitched client/server timelines
+    correlate every request end to end."""
+    cfg = ServeConfig(sim=scfg(), url=url, window_s=0.1,
+                      batch_sizes=(1, 4, 8), timeout_s=300.0)
+    reg = MetricsRegistry()
+    tracer = Tracer()
+    with use_registry(reg), obs_trace.use_tracer(tracer), \
+            obs_trace.use_propagation(True):
+        server = ScenarioServer(cfg, registry=reg)
+        await server.start()
+        clients = [ScenarioClient(url) for _ in range(N_SOAK_CLIENTS)]
+        try:
+            for c in clients:
+                await c.__aenter__()
+            replies = await asyncio.gather(*[
+                clients[i].request(
+                    {"demand_scale": 1.0 + 0.05 * i, "horizon_s": 120},
+                    rid=f"{tag}-{i}", timeout=300)
+                for i in range(N_SOAK_CLIENTS)])
+            assert all(r["ok"] for r in replies), replies
+        finally:
+            for c in clients:
+                await c.__aexit__(None, None, None)
+            await server.stop()
+    _assert_stitched_correlation(tracer, tmp_path, tag)
+
+
+def _assert_stitched_correlation(tracer, tmp_path, tag):
+    """Split the in-process soak's ring into the client-side and
+    server-side timelines (stand-ins for the two processes' trace
+    files), stitch them with tools/trace_stats.py, and prove one id
+    correlates client → batcher → fused dispatch → reply."""
+    events = tracer.events()
+    client_evs = [e for e in events
+                  if str(e.get("name", "")).startswith("client.")]
+    server_evs = [e for e in events
+                  if not str(e.get("name", "")).startswith("client.")]
+    cpath = tmp_path / f"{tag}-client.json"
+    spath = tmp_path / f"{tag}-server.json"
+    merged_path = tmp_path / f"{tag}-all.json"
+    tracer.export(str(cpath), "client", events=client_evs)
+    tracer.export(str(spath), "server", events=server_evs)
+
+    out = subprocess.run(
+        [sys.executable, str(TRACE_STATS), str(cpath), str(spath),
+         "--stitch", str(merged_path)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "stitched 2 file(s)" in out.stdout
+    assert "trace_id" in out.stdout  # the correlation table printed
+
+    merged = json.loads(merged_path.read_text())
+    errors, evs = trace_stats.validate(merged)
+    assert not errors, errors
+    groups = trace_stats.trace_groups(evs)
+
+    # one trace id per logical request, learned from the client side
+    rid_tid = {e["args"]["id"]: e["args"]["trace_id"]
+               for e in client_evs if e["name"] == "client.publish"}
+    assert len(rid_tid) == N_SOAK_CLIENTS
+    assert len(set(rid_tid.values())) == N_SOAK_CLIENTS
+    for rid, tid in rid_tid.items():
+        group = groups[tid]
+        names = {e["name"] for e in group}
+        # the whole path under ONE id: client publish → batcher
+        # admission → the fused dispatch (claimed via its trace_ids
+        # list) → the client-side reply
+        assert {"client.publish", "batcher.admit",
+                "batcher.dispatch", "client.reply"} <= names, (rid, names)
+        # and it spans both stitched "processes"
+        assert len({e["pid"] for e in group}) >= 2, (rid, group)
+
+
+class TestSoakTraceCorrelation:
+    def test_local_transport(self, tmp_path):
+        _run(_soak("local://soak-trace", tmp_path, "local"))
+
+    @pytest.mark.netport
+    def test_tcp_transport(self, tmp_path):
+        async def main():
+            async with TcpFanoutBroker(port=0) as broker:
+                await _soak(f"tcp://127.0.0.1:{broker.port}",
+                            tmp_path, "tcp")
+        _run(main())
+
+    def test_amqp_transport(self, tmp_path, fake_aio_pika):  # noqa: F811
+        _run(_soak("amqp://fake-host:5672/", tmp_path, "amqp"))
+
+
+# ---------------------------------------------------------------------------
+# pvsim --backend=jax end to end: live readiness + cost gauges mid-run
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.netport
+def test_pvsim_jax_obs_endpoint_live(tmp_path, monkeypatch):
+    """`pvsim --backend=jax --obs-port 0`: /readyz flips to 200 once the
+    first block lands, /metrics serves the device.cost.* gauges
+    mid-run, and the socket is gone after the run.  The probe runs from
+    inside the per-block gauge publish (the obs endpoint answers on its
+    own thread), so the scrape is deterministically mid-run."""
+    from tmhpvsim_tpu.apps import pvsim as app
+    from tmhpvsim_tpu.obs import live as live_mod
+
+    captured = {}
+    orig_cls = live_mod.ObsServer
+
+    class Capturing(orig_cls):
+        def start_threaded(self):
+            super().start_threaded()
+            captured["srv"] = self
+            return self
+
+    monkeypatch.setattr(live_mod, "ObsServer", Capturing)
+
+    results = {}
+    real_publish = obs_cost.publish_gauges
+
+    def probing_publish(registry, doc, prefix="device.cost."):
+        real_publish(registry, doc, prefix)
+        if "metrics" in results or "srv" not in captured:
+            return
+        port = captured["srv"].port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=10) as resp:
+            results["ready"] = json.loads(resp.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            results["metrics"] = resp.read().decode()
+
+    monkeypatch.setattr(obs_cost, "publish_gauges", probing_publish)
+    try:
+        app.pvsim_jax(str(tmp_path / "out.csv"), duration_s=300,
+                      n_chains=4, seed=7, start="2019-09-05 10:00:00",
+                      block_s=60, output="reduce", block_impl="scan",
+                      obs_port=0)
+    finally:
+        obs_trace.enable_propagation(False)  # app enables; tests restore
+    assert "srv" in captured, "obs server was never started"
+    assert results.get("ready", {}).get("warm") is True, results
+    assert results["ready"]["blocks"] >= 1
+    assert "tmhpvsim_device_cost_north_star_frac" in results["metrics"]
+    assert "tmhpvsim_device_cost_site_s_per_s" in results["metrics"]
+    # after the run, the listener is down
+    srv = captured["srv"]
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# tools: stitcher, cost_report, bench_trend cost column
+# ---------------------------------------------------------------------------
+
+
+class TestTraceStatsStitch:
+    def _docs(self):
+        client = {"traceEvents": [
+            {"ph": "X", "name": "serve.request", "cat": "serve",
+             "ts": 10, "dur": 500, "pid": 41, "tid": 1,
+             "args": {"trace_id": "t-aaa"}},
+            {"ph": "X", "name": "serve.request", "cat": "serve",
+             "ts": 20, "dur": 400, "pid": 41, "tid": 1,
+             "args": {"trace_id": "t-bbb"}},
+        ]}
+        server = {"traceEvents": [
+            {"ph": "i", "name": "batcher.admit", "cat": "serve",
+             "ts": 60, "pid": 41, "tid": 2,
+             "args": {"trace_id": "t-aaa"}},
+            {"ph": "X", "name": "batcher.dispatch", "cat": "serve",
+             "ts": 100, "dur": 300, "pid": 41, "tid": 2,
+             "args": {"trace_ids": ["t-aaa", "t-bbb"]}},
+        ]}
+        return client, server
+
+    def test_stitch_remaps_colliding_pids(self):
+        client, server = self._docs()
+        merged = trace_stats.stitch([
+            ("client.json", client["traceEvents"]),
+            ("server.json", server["traceEvents"])])
+        errors, evs = trace_stats.validate(merged)
+        assert not errors, errors
+        # same os pid 41 in both files -> two distinct tracks, labelled
+        pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+        assert len(pids) == 2
+        labels = {e["args"]["name"] for e in evs
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+        assert labels == {"client.json:41", "server.json:41"}
+
+    def test_trace_groups_expand_dispatch_trace_ids(self):
+        client, server = self._docs()
+        merged = trace_stats.stitch([
+            ("c", client["traceEvents"]), ("s", server["traceEvents"])])
+        groups = trace_stats.trace_groups(merged)
+        assert set(groups) == {"t-aaa", "t-bbb"}
+        # the one fused dispatch span is claimed by BOTH traces
+        assert len(groups["t-aaa"]) == 3
+        assert len(groups["t-bbb"]) == 2
+        for tid in groups:
+            assert any(e["name"] == "batcher.dispatch"
+                       for e in groups[tid])
+
+    def test_cli_stitch_round_trip(self, tmp_path):
+        client, server = self._docs()
+        c, s = tmp_path / "c.json", tmp_path / "s.json"
+        c.write_text(json.dumps(client))
+        s.write_text(json.dumps(server))
+        out_path = tmp_path / "all.json"
+        out = subprocess.run(
+            [sys.executable, str(TRACE_STATS), str(c), str(s),
+             "--stitch", str(out_path)],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "2 trace id(s)" in out.stdout
+        assert "t-aaa" in out.stdout
+        # the stitched file itself revalidates through the same tool
+        again = subprocess.run(
+            [sys.executable, str(TRACE_STATS), "-q", str(out_path)],
+            capture_output=True, text=True)
+        assert again.returncode == 0, again.stderr
+
+    def test_stitch_refused_on_invalid_input(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"traceEvents": [{"ph": "X"}]}))
+        out = subprocess.run(
+            [sys.executable, str(TRACE_STATS), str(bad),
+             "--stitch", str(tmp_path / "all.json")],
+            capture_output=True, text=True)
+        assert out.returncode == 1
+        assert not (tmp_path / "all.json").exists()
+
+
+class TestCostReportTool:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_valid_docs_print_and_pass(self, tmp_path):
+        cost = obs_cost.cost_doc(site_s_per_s=1.2e9,
+                                 block_impl="scan2",
+                                 compute_dtype="bf16",
+                                 kernel_impl="table",
+                                 device_kind="TPU v5 lite")
+        rep = self._write(tmp_path, "rep.json",
+                          {"schema_version": 10, "cost": cost})
+        head = self._write(tmp_path, "head.json", {
+            "variants": {"scan2": {"rate": 1.2e9, "cost": cost}},
+            "run_report": {"cost": cost}})
+        out = subprocess.run(
+            [sys.executable, str(COST_REPORT), rep, head],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "scan2/bf16/table" in out.stdout
+        assert "north-star" in out.stdout
+        assert "variants.scan2.cost" in out.stdout
+
+    def test_pre_v10_doc_passes_unless_required(self, tmp_path):
+        old = self._write(tmp_path, "old.json", {"schema_version": 7})
+        ok = subprocess.run([sys.executable, str(COST_REPORT), old],
+                            capture_output=True, text=True)
+        assert ok.returncode == 0
+        req = subprocess.run(
+            [sys.executable, str(COST_REPORT), old, "--require"],
+            capture_output=True, text=True)
+        assert req.returncode == 1
+
+    def test_malformed_cost_fails(self, tmp_path):
+        bad_cost = obs_cost.cost_doc(site_s_per_s=1e6)
+        del bad_cost["model"]
+        bad = self._write(tmp_path, "bad.json", {"cost": bad_cost})
+        out = subprocess.run([sys.executable, str(COST_REPORT), bad],
+                             capture_output=True, text=True)
+        assert out.returncode == 1
+        assert "INVALID" in out.stdout
+
+
+class TestBenchTrendCostColumn:
+    def _artifact(self, tmp_path, name, rate, steady):
+        cost = obs_cost.cost_doc(site_s_per_s=rate,
+                                 device_kind="TPU v5 lite")
+        doc = {"best": "scan", "rate": rate,
+               "variants": {"scan": {"rate": rate, "cost": cost}},
+               "run_report": {"schema_version": 10, "cost": cost,
+                              "timing": {"steady_block_s": steady}}}
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_cost_column_and_gate_suffix(self, tmp_path):
+        a = self._artifact(tmp_path, "BENCH_r01.json", 1.2e9, 0.5)
+        b = self._artifact(tmp_path, "BENCH_r02.json", 1.25e9, 0.49)
+        out = subprocess.run([sys.executable, str(BENCH_TREND), a, b],
+                             capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        header = out.stdout.splitlines()[0]
+        assert "cost" in header.split()
+        assert "0.183" in out.stdout or "0.182" in out.stdout
+        assert "north_star_frac=" in out.stdout
+        assert "%" in out.stdout  # the vpu roofline rides along
+
+    def test_pre_v10_rows_show_dash(self, tmp_path):
+        doc = {"best": "scan", "rate": 1e9,
+               "run_report": {"schema_version": 9,
+                              "timing": {"steady_block_s": 0.5}}}
+        p = tmp_path / "BENCH_r01.json"
+        p.write_text(json.dumps(doc))
+        out = subprocess.run([sys.executable, str(BENCH_TREND), str(p)],
+                             capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        row = [ln for ln in out.stdout.splitlines()
+               if "BENCH_r01" in ln][0]
+        assert " - " in row  # no cost section -> dash, not a crash
+
+
+# ---------------------------------------------------------------------------
+# acceptance: stamped-path steady-block overhead at 65536 chains (slow
+# lane via conftest._SLOW_LANE)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_stamp_overhead_65536_chains():
+    """With the ops plane ON (propagation enabled, a trace context
+    bound, per-block cost gauges published) the 65536-chain CPU
+    engine's steady block walls must stay within 1% of the all-off
+    path.  min-of-blocks on both arms filters scheduler noise."""
+    from tmhpvsim_tpu.engine import Simulation
+
+    def steady_min(stamped: bool) -> float:
+        reg = MetricsRegistry(enabled=stamped)
+        tracer = Tracer() if stamped else None
+        cfg = SimConfig(
+            start="2019-09-05 10:00:00", duration_s=4 * 60,
+            n_chains=65536, seed=7, block_s=60, dtype="float32",
+            block_impl="wide", output="reduce")
+        ctx = (obs_trace.use_propagation(True) if stamped
+               else contextlib.nullcontext())
+        with use_registry(reg), ctx, obs_trace.trace_scope(
+                obs_trace.new_trace_id() if stamped else None):
+            sim = Simulation(cfg)
+
+            def on_block(bi, state, acc):
+                if not stamped:
+                    return
+                tracer.instant("block", "engine", block=bi)
+                rate = sim.timer.rate()
+                if rate:
+                    obs_cost.publish_gauges(reg, obs_cost.cost_doc(
+                        site_s_per_s=rate, block_impl="wide",
+                        device_kind="cpu"))
+
+            sim.run_reduced(on_block=on_block)
+        return min(sim.timer.block_times)
+
+    steady_min(True)  # warm the jit + persistent cache
+    plain = steady_min(False)
+    stamped = steady_min(True)
+    assert stamped <= plain * 1.01, (
+        f"stamped-path block overhead {stamped / plain - 1:.2%} exceeds "
+        f"1% (stamped {stamped:.4f} s vs plain {plain:.4f} s)")

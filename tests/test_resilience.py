@@ -2,7 +2,7 @@
 retry/fallback/budget semantics of ResiliencePolicy.call, the half-open
 CircuitBreaker lifecycle with its ``resilience.*`` metrics, WARN
 rate-limiting, the reconnect_policy defaults every transport loop uses,
-and the deprecation shim left behind at runtime/retry.py.
+and the removal of the old runtime/retry.py shim.
 """
 
 import asyncio
@@ -314,18 +314,12 @@ class TestReconnectPolicy:
 
 
 # ---------------------------------------------------------------------------
-# runtime/retry.py deprecation shim
+# runtime/retry.py shim is gone (deprecated PR 8, removed PR 11)
 # ---------------------------------------------------------------------------
 
 
 class TestRetryShim:
-    def test_import_warns_and_reexports(self):
+    def test_shim_removed(self):
         sys.modules.pop("tmhpvsim_tpu.runtime.retry", None)
-        with pytest.warns(DeprecationWarning,
-                          match="runtime.retry is deprecated"):
-            shim = importlib.import_module("tmhpvsim_tpu.runtime.retry")
-        from tmhpvsim_tpu.runtime import resilience
-
-        assert shim.asyncretry is resilience.asyncretry
-        assert shim.forever is resilience.forever
-        assert shim.propagate is resilience.propagate
+        with pytest.raises(ImportError):
+            importlib.import_module("tmhpvsim_tpu.runtime.retry")

@@ -151,7 +151,8 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
                         duration_s=None, start=None,
                         backend: str = "asyncio",
                         trace: Optional[str] = None,
-                        compile_cache: Optional[str] = None) -> None:
+                        compile_cache: Optional[str] = None,
+                        obs_port: Optional[int] = None) -> None:
     """App orchestrator (metersim.py:64-77): producer + publisher tasks.
     ``backend='jax'`` swaps the per-second numpy producer for the
     device-batched one; the transport/publisher side is identical.
@@ -159,8 +160,27 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
     ``trace`` names a Chrome-trace JSON (obs/trace.py): publish spans
     land in the ring, the full ring is exported there on exit, and an
     unhandled exception dumps the last-30-s flight slice to
-    ``trace + '.crash.json'`` before re-raising."""
+    ``trace + '.crash.json'`` before re-raising.
+
+    ``obs_port`` (``--obs-port``) binds the live ops plane (obs/live.py:
+    ``/metrics``, ``/healthz``, ``/readyz``, ``/flight``) and turns on
+    cross-process trace propagation — every published value's meta gains
+    ``trace_id``/``span_id`` for downstream correlation."""
+    from tmhpvsim_tpu.obs import trace as obs_trace
+    from tmhpvsim_tpu.obs.live import maybe_obs_server
+
     tracer = Tracer() if trace else None
+    if obs_port is not None:
+        obs_trace.enable_propagation(True)
+    async with maybe_obs_server(obs_port, tracer=tracer):
+        await _metersim_run(amqp_url, exchange, realtime, seed,
+                            duration_s, start, backend, trace,
+                            compile_cache, tracer)
+
+
+async def _metersim_run(amqp_url, exchange, realtime, seed, duration_s,
+                        start, backend, trace, compile_cache,
+                        tracer) -> None:
     queue: asyncio.Queue = asyncio.Queue()
     if backend == "jax":
         # persistent XLA cache: the block producer's jit deserialises

@@ -140,6 +140,8 @@ async def read_transport(funnel: SynchronizingFunnel, url, exchange,
     """Meter consumer with forever-reconnect (pvsim.py:43-70); the
     jittered-backoff policy replaces the reference's fixed 5 s sleep."""
 
+    from tmhpvsim_tpu.obs import trace as obs_trace
+
     async def run():
         async with make_transport(url, exchange) as transport:
             async for time, value, meta in transport.subscribe(
@@ -148,13 +150,17 @@ async def read_transport(funnel: SynchronizingFunnel, url, exchange,
                     counter["meter"] = counter.get("meter", 0) + 1
                 if stream is not None:
                     stream.on_consume(time, meta)
-                if tracer:
-                    tracer.instant("consume", "stream",
-                                   seq=(meta or {}).get("seq"))
-                    with tracer.span("funnel.put", "stream"):
+                # bind the producer's propagated trace (no-op when the
+                # ops plane is off) so consume/join events stitch onto
+                # the publisher's timeline by trace_id
+                with obs_trace.extracted(meta):
+                    if tracer:
+                        tracer.instant("consume", "stream",
+                                       seq=(meta or {}).get("seq"))
+                        with tracer.span("funnel.put", "stream"):
+                            await funnel.put(time, meter=value)
+                    else:
                         await funnel.put(time, meter=value)
-                else:
-                    await funnel.put(time, meter=value)
 
     await reconnect_policy(name="pvsim.read_transport").call(run)
 
@@ -203,7 +209,8 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
                      duration_s=None, start=None,
                      trace: Optional[str] = None,
                      metrics_path: Optional[str] = None,
-                     run_report_path: Optional[str] = None) -> None:
+                     run_report_path: Optional[str] = None,
+                     obs_port: Optional[int] = None) -> None:
     """App orchestrator (pvsim.py:86-101).
 
     Streaming observability (obs/): ``trace`` records the consume →
@@ -214,13 +221,30 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
     whose ``streaming`` section carries the publish→join / join→csv
     latency quantiles and funnel/retry/broker counters.  The tracer is
     a local instance (not the process default) so two app mains sharing
-    one process — the e2e tests — cannot race on a global swap."""
+    one process — the e2e tests — cannot race on a global swap.
+
+    ``obs_port`` (``--obs-port``) binds the live ops plane (obs/live.py)
+    and turns on cross-process trace propagation (obs/trace.py)."""
+    from tmhpvsim_tpu.obs import trace as obs_trace
+    from tmhpvsim_tpu.obs.live import maybe_obs_server
+
+    if obs_port is not None:
+        obs_trace.enable_propagation(True)
+    tracer0 = Tracer() if trace else None
+    async with maybe_obs_server(obs_port, tracer=tracer0):
+        await _pvsim_stream_run(file, amqp_url, exchange, realtime, seed,
+                                duration_s, start, trace, metrics_path,
+                                run_report_path, tracer0)
+
+
+async def _pvsim_stream_run(file, amqp_url, exchange, realtime, seed,
+                            duration_s, start, trace, metrics_path,
+                            run_report_path, tracer) -> None:
     reg = obs_metrics.get_registry()
     sink = None
     if metrics_path:
         sink = obs_metrics.make_sink(metrics_path)
         reg.add_sink(sink)
-    tracer = Tracer() if trace else None
     # per-record latency accounting only when some observability output
     # was asked for: with none of --trace/--metrics/--run-report the
     # funnel keeps the RAW queue and the hot path pays exactly one
@@ -407,7 +431,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               output_overlap: str = "auto",
               checkpoint_keep: int = 3,
               checkpoint_async: str = "off",
-              preempt_grace_s: float = 0.0) -> None:
+              preempt_grace_s: float = 0.0,
+              obs_port: Optional[int] = None) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -472,6 +497,13 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     trace from ``profile_dir`` merges next to it in Perfetto as a
     separate process row.  A crashing run dumps the last-30-s flight
     slice to ``trace + '.crash.json'`` first.
+
+    ``obs_port`` (``--obs-port``) binds the live ops plane (obs/live.py)
+    on a daemon thread — ``/metrics`` serves this run's registry (cost
+    gauges update at block granularity mid-run), ``/readyz`` flips to
+    200 once the first block has completed (AOT warm-up + compile done),
+    ``/flight`` snapshots the tracer ring.  Unset, no socket is bound
+    and no per-message stamps are added anywhere.
     """
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import read_manifest
@@ -481,6 +513,17 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     if metrics_path:
         registry.add_sink(obs_metrics.make_sink(metrics_path))
     tracer = Tracer() if trace else None
+    obs_server = None
+    ready_state = {"warm": False, "blocks": 0}
+    if obs_port is not None:
+        from tmhpvsim_tpu.obs import trace as obs_trace
+        from tmhpvsim_tpu.obs.live import ObsServer
+
+        obs_trace.enable_propagation(True)
+        obs_server = ObsServer(
+            obs_port, registry=registry, tracer=tracer,
+            ready=lambda: (ready_state["warm"], dict(ready_state)))
+        obs_server.start_threaded()  # bind errors surface here, pre-run
     # the Simulation binds the process-default registry at construction,
     # so the per-run registry must be installed around the whole run
     with obs_metrics.use_registry(registry):
@@ -500,6 +543,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 checkpoint_keep=checkpoint_keep,
                 checkpoint_async=checkpoint_async,
                 preempt_grace_s=preempt_grace_s,
+                ready_state=ready_state,
             )
         except (Exception, KeyboardInterrupt):
             if tracer:
@@ -507,6 +551,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                     tracer.dump_flight(trace + ".crash.json")
             raise
         finally:
+            if obs_server is not None:
+                obs_server.close_threaded()
             registry.flush(event="end")
             registry.close()
             if tracer:
@@ -526,6 +572,17 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     if ex is not None:  # adds cache_dir to the counter section
         rep.executor = ex
     rep.headline = {"site_seconds_per_s": summary["site_seconds_per_s"]}
+    if summary.get("site_seconds_per_s"):
+        from tmhpvsim_tpu.obs import cost as obs_cost
+
+        plan = sim.plan
+        rep.cost = obs_cost.cost_doc(
+            site_s_per_s=summary["site_seconds_per_s"],
+            block_impl=plan.block_impl,
+            compute_dtype=getattr(plan, "compute_dtype", None),
+            kernel_impl=getattr(plan, "kernel_impl", None),
+            device_kind=jax.devices()[0].device_kind,
+        )
     if getattr(sim, "sentinel", None) is not None:
         rep.telemetry = sim.sentinel.report()
     if hasattr(sim, "fleet_summary"):
@@ -572,9 +629,14 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    output_overlap: str = "auto",
                    checkpoint_keep: int = 3,
                    checkpoint_async: str = "off",
-                   preempt_grace_s: float = 0.0):
+                   preempt_grace_s: float = 0.0,
+                   ready_state: Optional[dict] = None):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
-    the wrapper can assemble the run report from its config/plan/timer."""
+    the wrapper can assemble the run report from its config/plan/timer.
+
+    ``ready_state`` is the wrapper's live-ops readiness dict: the first
+    completed block flips ``warm`` (AOT warm-up + compile done) and
+    every block bumps ``blocks`` — what ``/readyz`` reports mid-run."""
     import contextlib
     import os
     from zoneinfo import ZoneInfo
@@ -582,6 +644,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
     from tmhpvsim_tpu.config import SimConfig
     from tmhpvsim_tpu.engine import Simulation, checkpoint as ckpt
     from tmhpvsim_tpu.engine.simulation import write_csv
+    from tmhpvsim_tpu.obs import cost as obs_cost
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import BlockTimer, device_trace
     from tmhpvsim_tpu.parallel.distributed import initialize_from_env
@@ -681,6 +744,28 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         getattr(plan, "compute_dtype", "f32"),
         getattr(plan, "kernel_impl", "exact"),
     )
+
+    # Live-ops cost attribution (obs/cost.py): per-block device.cost.*
+    # gauges published BEFORE the block flush so /metrics and JSONL
+    # sinks show achieved FLOPs / roofline fraction at block
+    # granularity mid-run.  Also flips the wrapper's readiness state:
+    # the first completed block means AOT warm-up + compile are done.
+    device_kind = jax.devices()[0].device_kind
+
+    def _block_obs(timer, bi):
+        if ready_state is not None:
+            ready_state["warm"] = True
+            ready_state["blocks"] = bi + 1
+        rate = timer.rate()
+        if not rate:
+            return
+        obs_cost.publish_gauges(reg, obs_cost.cost_doc(
+            site_s_per_s=rate,
+            block_impl=plan.block_impl,
+            compute_dtype=getattr(plan, "compute_dtype", None),
+            kernel_impl=getattr(plan, "kernel_impl", None),
+            device_kind=device_kind))
+
     if checkpoint and plan.slab_chains < cfg.n_chains:
         # a slabbed run has no single resumable state pytree; checkpointed
         # runs execute unslabbed (the plan's other knobs still apply)
@@ -743,6 +828,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             timer.tick()
             if tracer:
                 tracer.instant("block", "engine", block=bi)
+            _block_obs(timer, bi)
             reg.flush(event="block")
             # state_block gate: under a fused multi-block dispatch
             # (blocks_per_dispatch > 1) sim.state only advances at
@@ -852,6 +938,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             timer.tick()
             if tracer:
                 tracer.instant("block", "engine", block=bi)
+            _block_obs(timer, bi)
             reg.flush(event="block")
             if realtime:
                 yield from _paced(blk)

@@ -73,6 +73,18 @@ def _chaos_options(f):
     return f
 
 
+def _obs_port_option(f):
+    f = click.option(
+        "--obs-port", "obs_port", type=int, default=None, metavar="PORT",
+        help="Bind the live ops plane on 127.0.0.1:PORT (0 picks a free "
+             "one): /metrics (OpenMetrics), /healthz, /readyz, /flight "
+             "— and turn on cross-process trace propagation "
+             "(trace_id/span_id riding every message's out-of-band "
+             "meta).  Unset: no socket is bound and no stamps are "
+             "added anywhere (obs/live.py)")(f)
+    return f
+
+
 def _activate_chaos(chaos, chaos_seed) -> None:
     """Arm fault injection from --chaos, else from $TMHPVSIM_CHAOS."""
     from tmhpvsim_tpu.runtime import faults
@@ -183,9 +195,10 @@ def fanoutbroker(host, port, max_backlog, verbose):
                    "it).  Unset: $TMHPVSIM_COMPILE_CACHE, else "
                    "~/.cache/tmhpvsim_tpu/xla; 'off' disables "
                    "(engine/compilecache.py)")
+@_obs_port_option
 @_chaos_options
 def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
-             trace, backend, compile_cache, chaos, chaos_seed):
+             trace, backend, compile_cache, obs_port, chaos, chaos_seed):
     """1 Hz electricity-demand producer (reference metersim.py:79-95)."""
     from tmhpvsim_tpu.apps.metersim import metersim_main
 
@@ -195,7 +208,8 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
         raise click.UsageError("--compile-cache requires --backend=jax")
     asyncrun(metersim_main(amqp_url, exchange, realtime, seed, duration_s,
                            _parse_start(start), backend=backend,
-                           trace=trace, compile_cache=compile_cache))
+                           trace=trace, compile_cache=compile_cache,
+                           obs_port=obs_port))
 
 
 @click.command()
@@ -352,6 +366,7 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "crash up to N times: the restarted run resumes from "
                    "--checkpoint and recompiles nothing under the "
                    "persistent compile cache (runtime/supervise.py)")
+@_obs_port_option
 @_chaos_options
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, trace, backend, n_chains, chain, sharded, checkpoint,
@@ -360,7 +375,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           analytics, metrics_path, run_report_path, compile_cache,
           blocks_per_dispatch, compute_dtype, kernel_impl, output_overlap,
           checkpoint_keep, checkpoint_async, preempt_grace,
-          supervise, chaos, chaos_seed):
+          supervise, obs_port, chaos, chaos_seed):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     _maybe_supervise("pvsim", supervise,
@@ -453,7 +468,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   output_overlap=output_overlap,
                   checkpoint_keep=checkpoint_keep,
                   checkpoint_async=checkpoint_async,
-                  preempt_grace_s=preempt_grace)
+                  preempt_grace_s=preempt_grace,
+                  obs_port=obs_port)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main
@@ -461,7 +477,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
     asyncrun(pvsim_main(file, amqp_url, exchange, realtime, seed, duration_s,
                         _parse_start(start), trace=trace,
                         metrics_path=metrics_path,
-                        run_report_path=run_report_path))
+                        run_report_path=run_report_path,
+                        obs_port=obs_port))
 
 
 @click.command()
@@ -544,11 +561,12 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                    "nothing fresh.  Unset: $TMHPVSIM_COMPILE_CACHE, else "
                    "~/.cache/tmhpvsim_tpu/xla; 'off' disables "
                    "(engine/compilecache.py)")
+@_obs_port_option
 @_chaos_options
 def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
           block_s, block_impl, tune, window_ms, max_batch, batch_sizes,
           queue_limit, timeout_s, drain_timeout_s, supervise, trace,
-          metrics_path, run_report_path, compile_cache, chaos,
+          metrics_path, run_report_path, compile_cache, obs_port, chaos,
           chaos_seed):
     """Long-lived scenario server: a warm simulation answering "what-if"
     queries over the broker (serve/).  Each request perturbs bounded
@@ -581,7 +599,8 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
         timeout_s=timeout_s, drain_timeout_s=drain_timeout_s)
     asyncrun(serve_main(cfg, compile_cache=compile_cache, trace=trace,
                         metrics_path=metrics_path,
-                        run_report_path=run_report_path))
+                        run_report_path=run_report_path,
+                        obs_port=obs_port))
 
 
 @click.group()

@@ -22,7 +22,15 @@ Host-side modules (plus one device-side fold):
   leading-block means against the float64 golden models
   (``DriftSentinel``, ``DriftError``);
 * :mod:`~tmhpvsim_tpu.obs.trace` — the asyncio-task-aware streaming
-  event tracer + flight recorder (Chrome-trace JSON export).
+  event tracer + flight recorder (Chrome-trace JSON export), plus the
+  cross-process trace-context propagation layer (trace_id/span_id over
+  broker message meta);
+* :mod:`~tmhpvsim_tpu.obs.live` — the live ops plane: the embeddable
+  ``--obs-port`` HTTP endpoint (``/metrics`` OpenMetrics, ``/healthz``,
+  ``/readyz``, ``/flight``);
+* :mod:`~tmhpvsim_tpu.obs.cost` — the static per-plan device cost model
+  behind the ``device.cost.*`` gauges and the RunReport v10 ``cost``
+  section (achieved FLOPs, roofline fraction, north-star fraction).
 """
 
 from tmhpvsim_tpu.obs.metrics import (  # noqa: F401
@@ -55,6 +63,8 @@ from tmhpvsim_tpu.obs.trace import (  # noqa: F401
     set_tracer,
     use_tracer,
 )
+from tmhpvsim_tpu.obs.live import ObsServer  # noqa: F401
+from tmhpvsim_tpu.obs import cost  # noqa: F401
 
 
 def __getattr__(name):

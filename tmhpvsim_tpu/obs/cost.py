@@ -1,0 +1,207 @@
+"""Static per-plan device cost model → live ``device.cost.*`` gauges.
+
+The roofline argument for this workload (ROADMAP item 3: ~390
+flops/site-second on the scan path, achieved GFLOP/s far below VPU
+peak, 0.183 north-star fraction) has so far been computed by hand from
+one bench artifact.  This module makes the pricing automatic and live:
+
+* :func:`model_cost` — a *static* table of flops/bytes per simulated
+  site-second for each ``block_impl`` × ``compute_dtype`` ×
+  ``kernel_impl`` plan cell, anchored to the round-5 XLA
+  ``cost_analysis`` of the hot per-block jit (``bench.py
+  _hot_jit_cost``) on the scan/f32/exact path and scaled by documented
+  per-axis factors.  Static means it prices a plan *without a device*:
+  the CPU tier-1 suite and the live ops plane both get real numbers.
+* :func:`cost_doc` — the static model joined with a *measured*
+  site-seconds/s rate (and, when a device ran, the measured XLA
+  flops/bytes) into the RunReport v10 ``cost`` section: achieved
+  GFLOP/s / GB/s, roofline fractions against the chip's peaks, and the
+  north-star fraction.
+* :func:`publish_gauges` — the same numbers as ``device.cost.*`` gauges
+  on a :class:`~.metrics.MetricsRegistry`, refreshed at block
+  granularity by the engine's ``on_block`` hooks so a live ``/metrics``
+  scrape (obs/live.py) prices the run mid-flight.
+
+``NORTH_STAR`` and ``PEAKS`` moved here from bench.py (bench imports
+them back) so the one definition serves bench artifacts, live gauges
+and report validation alike.
+
+Static-model provenance (``model: static-v1``): the base point is the
+round-5 partial battery's ``cost_analysis`` on scan/threefry/f32/exact —
+~390 flops and ~96 HBM bytes per site-second.  Axis factors are
+estimates, not measurements, and are labelled as such in the doc:
+
+* ``block_impl``: scan2 fuses the accumulator fold into the same scan
+  (slightly fewer carry round-trips); wide trades flops for layout;
+  split re-materialises between stages (more HBM traffic).
+* ``compute_dtype=bf16``: flop *count* is unchanged (the graph is the
+  same arithmetic) but activation traffic roughly halves; f32 carries
+  and reductions keep the bytes factor above 0.5.
+* ``kernel_impl=table``: the transcendental-heavy solar/pv polynomial
+  chains collapse into LUT gather + lerp (flops well under half) at the
+  price of LUT traffic.
+
+When a run measured the real thing (``cost_analysis`` flops/bytes per
+block), :func:`cost_doc` prefers the measurement for the achieved rates
+and keeps the static prediction alongside — the gap between the two is
+itself a model-quality signal the trend tooling can watch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: the ROADMAP's north star: 100k users × 1 simulated year / 1 min wall
+#: on 8 chips, in simulated site-seconds per wall-second per chip
+NORTH_STAR = 100_000 * 365.25 * 86400 / 60.0 / 8.0
+
+#: per-chip peak rates for device kinds we have numbers for (VPU f32
+#: GFLOP/s is an estimate for v5e — marked so artifacts say so)
+PEAKS = {
+    "TPU v5 lite": {"hbm_gbs": 819.0, "vpu_f32_gops": 6100.0,
+                    "vpu_is_estimate": True},
+}
+
+#: static model version tag embedded in every doc this module emits
+MODEL = "static-v1"
+
+#: round-5 anchor: XLA cost_analysis of the hot block jit on the
+#: scan/f32/exact path, normalised per simulated site-second
+BASE_FLOPS_PER_SITE_S = 390.0
+BASE_BYTES_PER_SITE_S = 96.0
+
+#: per-axis (flops_factor, bytes_factor) multipliers on the anchor
+_BLOCK_IMPL_FACTORS = {
+    "scan": (1.0, 1.0),
+    "scan2": (0.98, 0.97),
+    "wide": (1.05, 1.08),
+    "fused": (1.0, 1.0),
+    "split": (1.02, 1.12),
+}
+_DTYPE_FACTORS = {
+    "f32": (1.0, 1.0),
+    "bf16": (1.0, 0.55),
+}
+_KERNEL_FACTORS = {
+    "exact": (1.0, 1.0),
+    "table": (0.45, 1.15),
+}
+
+
+def _resolve(value: Optional[str], default: str) -> str:
+    return default if value in (None, "", "auto") else str(value)
+
+
+def model_cost(block_impl: Optional[str] = None,
+               compute_dtype: Optional[str] = None,
+               kernel_impl: Optional[str] = None) -> dict:
+    """Static flops/bytes per site-second for one plan cell.  Unknown
+    axis values price as the default cell (factor 1.0) rather than
+    raising — a future plan axis must not break old pricing."""
+    bi = _resolve(block_impl, "scan")
+    dt = _resolve(compute_dtype, "f32")
+    ki = _resolve(kernel_impl, "exact")
+    f1, b1 = _BLOCK_IMPL_FACTORS.get(bi, (1.0, 1.0))
+    f2, b2 = _DTYPE_FACTORS.get(dt, (1.0, 1.0))
+    f3, b3 = _KERNEL_FACTORS.get(ki, (1.0, 1.0))
+    return {
+        "model": MODEL,
+        "block_impl": bi,
+        "compute_dtype": dt,
+        "kernel_impl": ki,
+        "flops_per_site_s": round(BASE_FLOPS_PER_SITE_S * f1 * f2 * f3, 2),
+        "bytes_per_site_s": round(BASE_BYTES_PER_SITE_S * b1 * b2 * b3, 2),
+    }
+
+
+def cost_doc(*, site_s_per_s: Optional[float],
+             block_impl: Optional[str] = None,
+             compute_dtype: Optional[str] = None,
+             kernel_impl: Optional[str] = None,
+             device_kind: Optional[str] = None,
+             measured_flops_per_site_s: Optional[float] = None,
+             measured_bytes_per_site_s: Optional[float] = None) -> dict:
+    """The RunReport v10 ``cost`` section: static model × measured rate
+    (→ achieved GFLOP/s, GB/s, north-star fraction), plus roofline
+    fractions when the device kind has published peaks.  Measured XLA
+    per-site costs, when provided, take precedence over the static
+    prediction for the achieved rates; the prediction stays in the doc
+    either way."""
+    doc = model_cost(block_impl, compute_dtype, kernel_impl)
+    flops_ss = (measured_flops_per_site_s
+                if measured_flops_per_site_s else doc["flops_per_site_s"])
+    bytes_ss = (measured_bytes_per_site_s
+                if measured_bytes_per_site_s else doc["bytes_per_site_s"])
+    if measured_flops_per_site_s:
+        doc["measured_flops_per_site_s"] = round(
+            float(measured_flops_per_site_s), 2)
+    if measured_bytes_per_site_s:
+        doc["measured_bytes_per_site_s"] = round(
+            float(measured_bytes_per_site_s), 2)
+    doc["basis"] = "measured" if measured_flops_per_site_s else "model"
+    if site_s_per_s:
+        rate = float(site_s_per_s)
+        doc["site_s_per_s"] = round(rate, 1)
+        doc["achieved_gflops"] = round(flops_ss * rate / 1e9, 3)
+        doc["achieved_gbs"] = round(bytes_ss * rate / 1e9, 3)
+        doc["north_star_frac"] = round(rate / NORTH_STAR, 4)
+        peaks = PEAKS.get(device_kind or "")
+        if peaks:
+            doc["device_kind"] = device_kind
+            doc["roofline_frac_vpu"] = round(
+                doc["achieved_gflops"] / peaks["vpu_f32_gops"], 5)
+            doc["roofline_frac_hbm"] = round(
+                doc["achieved_gbs"] / peaks["hbm_gbs"], 5)
+            doc["peaks"] = dict(peaks)
+    return doc
+
+
+#: the gauge keys publish_gauges mirrors out of a cost doc (numeric
+#: scalars only — strings don't gauge)
+GAUGE_KEYS = (
+    "flops_per_site_s", "bytes_per_site_s", "site_s_per_s",
+    "achieved_gflops", "achieved_gbs",
+    "roofline_frac_vpu", "roofline_frac_hbm", "north_star_frac",
+)
+
+
+def publish_gauges(registry, doc: dict, prefix: str = "device.cost.") -> None:
+    """Mirror a cost doc's numeric fields as ``device.cost.*`` gauges —
+    what a live ``/metrics`` scrape and the report's gauge-derived
+    fallback section read."""
+    for key in GAUGE_KEYS:
+        v = doc.get(key)
+        if isinstance(v, (int, float)):
+            registry.gauge(prefix + key).set(float(v))
+
+
+def validate_cost(doc) -> list:
+    """Schema errors (empty when valid) for a v10 ``cost`` section —
+    shared by obs/report.py and tools/cost_report.py."""
+    errors = []
+    if not isinstance(doc, dict):
+        return [f"cost: expected dict, got {type(doc).__name__}"]
+    for key in ("model", "block_impl", "compute_dtype", "kernel_impl"):
+        if not isinstance(doc.get(key), str):
+            errors.append(f"cost.{key}: expected str, got "
+                          f"{type(doc.get(key)).__name__}")
+    for key in ("flops_per_site_s", "bytes_per_site_s"):
+        if not isinstance(doc.get(key), (int, float)):
+            errors.append(f"cost.{key}: expected number, got "
+                          f"{type(doc.get(key)).__name__}")
+    for key in ("site_s_per_s", "achieved_gflops", "achieved_gbs",
+                "north_star_frac", "roofline_frac_vpu",
+                "roofline_frac_hbm", "measured_flops_per_site_s",
+                "measured_bytes_per_site_s"):
+        if key in doc and not isinstance(doc[key], (int, float)):
+            errors.append(f"cost.{key}: expected number, got "
+                          f"{type(doc[key]).__name__}")
+    if "basis" in doc and doc["basis"] not in ("model", "measured"):
+        errors.append(f"cost.basis: expected 'model'|'measured', got "
+                      f"{doc['basis']!r}")
+    if "peaks" in doc and not isinstance(doc["peaks"], dict):
+        errors.append("cost.peaks: expected dict")
+    frac = doc.get("north_star_frac")
+    if isinstance(frac, (int, float)) and frac < 0:
+        errors.append(f"cost.north_star_frac: negative ({frac})")
+    return errors

@@ -1,0 +1,226 @@
+"""Live ops plane: an embeddable HTTP endpoint for scrape-time telemetry.
+
+Everything observability built so far is end-of-run (RunReport) or
+file-shaped (JSONL/Prometheus sinks, trace exports).  This module is the
+*live* side: a tiny asyncio HTTP/1.1 server (``--obs-port``, off by
+default) that ``pvsim``, ``pvsim serve`` and ``metersim`` embed, serving
+
+* ``GET /metrics`` — the run's :class:`~..obs.metrics.MetricsRegistry`
+  in OpenMetrics 1.0 text exposition (device telemetry / fleet gauges
+  update at block granularity mid-run, so a scrape sees the live run);
+* ``GET /healthz`` — liveness: 200 whenever the event loop turns;
+* ``GET /readyz`` — readiness wired to real state via an injectable
+  callable (serve: AOT warm-up done AND not draining AND circuit breaker
+  not open); 503 + JSON detail otherwise, so the PR-8 breaker and the
+  drain path are load-balancer-visible;
+* ``GET /flight`` — the flight-recorder window of the run's tracer as a
+  Chrome-trace JSON document, on demand (404 when tracing is off).
+
+No third-party HTTP stack: raw ``asyncio.start_server`` with a minimal
+GET-only parser and ``Connection: close`` semantics — scrapers
+(Prometheus, curl, load balancers) all speak this.  Two lifecycles:
+
+* ``await start()`` / ``await stop()`` inside the asyncio apps
+  (pvsim_main, metersim_main, serve_main);
+* ``start_threaded()`` / ``close_threaded()`` for the synchronous
+  device path (``pvsim --backend=jax``): a daemon thread runs a private
+  event loop; ``start_threaded`` returns only once the socket is bound
+  (or raises the bind error in the caller).
+
+Port 0 binds an ephemeral port; the resolved one is in ``.port`` (the
+same pattern as ``runtime/tcpbroker.py``).  The default path is inert:
+no ``--obs-port``, no object constructed, no socket bound.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import threading
+from typing import Callable, Optional
+
+from .metrics import (MetricsRegistry, OPENMETRICS_CONTENT_TYPE,
+                      get_registry)
+from .trace import Tracer
+
+logger = logging.getLogger(__name__)
+
+_REASONS = {200: "OK", 404: "Not Found", 405: "Method Not Allowed",
+            503: "Service Unavailable"}
+
+#: ready callable contract: () -> (ok, detail-dict)
+ReadyFn = Callable[[], tuple]
+
+
+def ready_always() -> tuple:
+    """Default readiness: ready as soon as the socket answers (apps with
+    no warm-up/drain machinery: metersim, asyncio pvsim)."""
+    return True, {}
+
+
+class ObsServer:
+    """The embeddable ops endpoint; see module docstring.
+
+    ``registry`` defaults to the process-default registry *at request
+    time* when not pinned, so apps that install a per-run registry after
+    constructing the server still expose the right one.  ``ready`` is
+    the injectable readiness probe; ``tracer`` (optional) backs
+    ``/flight``.
+    """
+
+    def __init__(self, port: int, host: str = "127.0.0.1", *,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None,
+                 ready: Optional[ReadyFn] = None,
+                 prefix: str = "tmhpvsim"):
+        self.host = host
+        self.port = int(port)
+        self.prefix = prefix
+        self._registry = registry
+        self.tracer = tracer
+        self.ready = ready or ready_always
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._thread: Optional[threading.Thread] = None
+        self._thread_loop: Optional[asyncio.AbstractEventLoop] = None
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else get_registry()
+
+    # -- asyncio lifecycle -----------------------------------------------
+
+    async def start(self) -> "ObsServer":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("obs endpoint on http://%s:%d (/metrics /healthz "
+                    "/readyz /flight)", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.close()
+        await self._server.wait_closed()
+        self._server = None
+
+    # -- threaded lifecycle (synchronous device path) ----------------------
+
+    def start_threaded(self) -> "ObsServer":
+        """Run the endpoint on a daemon thread with a private event loop;
+        returns once the socket is bound (bind errors raise here, in the
+        caller, not on the thread)."""
+        bound = threading.Event()
+        boot_err: list = []
+
+        def _run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._thread_loop = loop
+            try:
+                loop.run_until_complete(self.start())
+            except Exception as e:  # surface the bind error to the caller
+                boot_err.append(e)
+                bound.set()
+                loop.close()
+                return
+            bound.set()
+            try:
+                loop.run_forever()
+                loop.run_until_complete(self.stop())
+            finally:
+                loop.close()
+
+        self._thread = threading.Thread(
+            target=_run, name="obs-live", daemon=True)
+        self._thread.start()
+        bound.wait(timeout=10.0)
+        if boot_err:
+            self._thread = None
+            self._thread_loop = None
+            raise boot_err[0]
+        return self
+
+    def close_threaded(self) -> None:
+        loop, thread = self._thread_loop, self._thread
+        self._thread_loop = self._thread = None
+        if loop is not None:
+            loop.call_soon_threadsafe(loop.stop)
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # -- request handling --------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            parts = request.decode("latin-1", "replace").split()
+            method = parts[0] if parts else ""
+            path = (parts[1] if len(parts) > 1 else "/").split("?", 1)[0]
+            # drain headers (Connection: close — nothing in them matters)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if method != "GET":
+                status, ctype, body = 405, "text/plain; charset=utf-8", \
+                    b"method not allowed\n"
+            else:
+                status, ctype, body = self._route(path)
+            head = (f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    f"Connection: close\r\n\r\n")
+            writer.write(head.encode("ascii") + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass  # a rude scraper must never hurt the run it observes
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+                await writer.wait_closed()
+
+    def _route(self, path: str) -> tuple:
+        reg = self.registry
+        reg.counter("obs.live.requests").inc()
+        if path == "/metrics":
+            text = reg.openmetrics_text(prefix=self.prefix)
+            return 200, OPENMETRICS_CONTENT_TYPE, text.encode("utf-8")
+        if path == "/healthz":
+            return 200, "text/plain; charset=utf-8", b"ok\n"
+        if path == "/readyz":
+            try:
+                ok, detail = self.ready()
+            except Exception as e:  # a broken probe reads as not-ready
+                ok, detail = False, {"error": repr(e)}
+            body = json.dumps({"ready": bool(ok), **(detail or {})},
+                              sort_keys=True).encode("utf-8") + b"\n"
+            return (200 if ok else 503), \
+                "application/json; charset=utf-8", body
+        if path == "/flight":
+            if self.tracer is None or not self.tracer.enabled:
+                return 404, "text/plain; charset=utf-8", \
+                    b"tracing off (run with --trace)\n"
+            doc = self.tracer.flight_doc()
+            return 200, "application/json; charset=utf-8", \
+                json.dumps(doc).encode("utf-8")
+        return 404, "text/plain; charset=utf-8", b"not found\n"
+
+
+@contextlib.asynccontextmanager
+async def maybe_obs_server(port: Optional[int], **kw):
+    """``async with maybe_obs_server(args.obs_port, ...) as obs:`` — the
+    app-side guard: None port yields None and binds nothing."""
+    if port is None:
+        yield None
+        return
+    obs = ObsServer(port, **kw)
+    await obs.start()
+    try:
+        yield obs
+    finally:
+        await obs.stop()

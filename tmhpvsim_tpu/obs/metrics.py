@@ -35,6 +35,11 @@ from typing import Iterable, Optional
 
 logger = logging.getLogger(__name__)
 
+#: content type an OpenMetrics 1.0 scraper negotiates for (what
+#: ``obs/live.py`` answers ``/metrics`` with)
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
 #: histogram bucket upper bounds (seconds-flavoured log-ish grid; the
 #: +Inf bucket is implicit).  Wide enough for µs-scale host hooks and
 #: minute-scale compile times alike.
@@ -137,28 +142,53 @@ def quantile_from_snapshot(snap: Optional[dict], q: float) -> Optional[float]:
     worth of latency.  None when the histogram is empty/absent — an
     empty/zero-count/bucketless snapshot is a valid "nothing observed"
     answer, never an exception (report assembly calls this on whatever
-    the run left behind)."""
+    the run left behind).
+
+    Deterministic edge rules (pinned by tests/test_liveops.py):
+
+    * when every observation landed in one bucket the grid carries no
+      interior geometry, so the estimate interpolates the observed span
+      directly — ``min + q * (max - min)`` — falling back to that
+      bucket's upper bound when min/max were lost (snapshots rebuilt
+      from sparse JSON);
+    * a quantile landing exactly on a cumulative bucket boundary
+      (``q * count == cum``) returns that bucket's upper bound, never an
+      interpolation between neighbours;
+    * beyond the last finite bucket the answer is the observed ``max``.
+    """
     if not snap or not snap.get("count"):
         return None
     count = snap["count"]
     target = q * count
-    lo_bound, lo_cum = 0.0, 0
-    value = None
+    smin, smax = snap.get("min"), snap.get("max")
     # `or ()`: snapshots rebuilt from JSON may carry buckets=null
-    for bound, cum in (snap.get("buckets") or ()):
-        if cum >= target:
-            frac = (target - lo_cum) / max(1, cum - lo_cum)
-            value = lo_bound + frac * (bound - lo_bound)
-            break
-        lo_bound, lo_cum = bound, cum
+    buckets = [(b, c) for b, c in (snap.get("buckets") or ())]
+    occupied = [i for i, (b, c) in enumerate(buckets)
+                if c > (buckets[i - 1][1] if i else 0)]
+    value = None
+    if len(occupied) == 1 and buckets[occupied[0]][1] == count:
+        if smin is not None and smax is not None:
+            return smin + q * (smax - smin)
+        value = buckets[occupied[0]][0]
+    else:
+        lo_bound, lo_cum = 0.0, 0
+        for bound, cum in buckets:
+            if cum >= target:
+                if cum == target:
+                    value = bound
+                else:
+                    frac = (target - lo_cum) / (cum - lo_cum)
+                    value = lo_bound + frac * (bound - lo_bound)
+                break
+            lo_bound, lo_cum = bound, cum
     if value is None:  # beyond the last finite bucket (+Inf territory)
-        value = snap.get("max")
+        value = smax
     if value is None:
         return None
-    if snap.get("min") is not None:
-        value = max(value, snap["min"])
-    if snap.get("max") is not None:
-        value = min(value, snap["max"])
+    if smin is not None:
+        value = max(value, smin)
+    if smax is not None:
+        value = min(value, smax)
     return value
 
 
@@ -272,6 +302,36 @@ class MetricsRegistry:
                 lines.append(f"{pname}_sum {_prom_num(m.sum)}")
                 lines.append(f"{pname}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def openmetrics_text(self, prefix: str = "tmhpvsim") -> str:
+        """The registry in OpenMetrics 1.0 text exposition format (what
+        ``obs/live.py`` serves at ``/metrics``).  Differs from
+        :meth:`prometheus_text` exactly where the specs diverge: counter
+        samples carry the ``_total`` suffix and the exposition ends with
+        the mandatory ``# EOF`` terminator."""
+        lines = []
+        for name, m in sorted(self._metrics.items()):
+            pname = _prom_name(f"{prefix}_{name}" if prefix else name)
+            if isinstance(m, Counter):
+                lines += [f"# TYPE {pname} counter",
+                          f"{pname}_total {_prom_num(m.value)}"]
+            elif isinstance(m, Gauge):
+                lines += [f"# TYPE {pname} gauge",
+                          f"{pname} {_prom_num(m.value)}"]
+            else:
+                lines.append(f"# TYPE {pname} histogram")
+                running = 0
+                for bound, n in zip(m.bounds, m.bucket_counts):
+                    running += n
+                    lines.append(
+                        f'{pname}_bucket{{le="{_prom_num(bound)}"}} '
+                        f"{running}"
+                    )
+                lines.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{pname}_sum {_prom_num(m.sum)}")
+                lines.append(f"{pname}_count {m.count}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
     def add_sink(self, sink) -> None:
         self._sinks.append(sink)

@@ -122,6 +122,19 @@ class BlockTimer:
             )
         return dt
 
+    def rate(self) -> float:
+        """Current site-s/s throughput, quiet — same preference order as
+        :meth:`summary` (steady blocks, else the compile-inclusive
+        first block) but safe to call once per block without logging.
+        0.0 before the first tick."""
+        steady = self.block_times
+        total = sum(steady)
+        if total:
+            return self.n_chains * self.block_s * len(steady) / total
+        if self._first_dt:
+            return self.n_chains * self.block_s / self._first_dt
+        return 0.0
+
     def summary(self) -> dict:
         """Timing split compile-vs-steady.
 

@@ -64,9 +64,16 @@ logger = logging.getLogger(__name__)
 #: ``async_queue_depth``) and ``preempt_snapshots`` (SIGTERM-grace /
 #: chaos-preempt final snapshots).  All additive — v8 readers of the
 #: section's original four keys are unaffected.
+#: v10: adds the optional ``cost`` section (per-dispatch device cost
+#: attribution, obs/cost.py ``cost_doc``: static flops/bytes-per-
+#: site-second pricing of the resolved plan cell × the measured
+#: site-s/s rate → achieved GFLOP/s / GB/s, roofline fractions against
+#: the chip's published peaks, north-star fraction; ``basis`` records
+#: whether the per-site costs were measured via XLA cost_analysis or
+#: priced by the static model).
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 9
+REPORT_SCHEMA_VERSION = 10
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -98,6 +105,7 @@ _TOP_SCHEMA = {
     "resilience": (False, _OPT_DICT),
     "precision": (False, _OPT_DICT),
     "probe": (False, _OPT_DICT),
+    "cost": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -163,6 +171,12 @@ def validate_report(doc) -> dict:
     _check_fields(doc["device"], _DEVICE_SCHEMA, "device")
     if isinstance(doc.get("timing"), dict):
         _check_fields(doc["timing"], _TIMING_SCHEMA, "timing")
+    if isinstance(doc.get("cost"), dict):
+        from tmhpvsim_tpu.obs.cost import validate_cost
+
+        errors = validate_cost(doc["cost"])
+        if errors:
+            raise ValueError("run report cost: " + "; ".join(errors))
     try:
         json.dumps(doc)
     except (TypeError, ValueError) as e:
@@ -467,6 +481,10 @@ class RunReport:
         #: backend-probe section (schema v8): bench.py probe attempt /
         #: timeout accounting under runtime.resilience.ResiliencePolicy
         self.probe: Optional[dict] = None
+        #: device cost-attribution section (schema v10): set from
+        #: ``obs.cost.cost_doc`` by every path that measures a site-s/s
+        #: rate (apps/pvsim.py jax wrapper, bench.py, serve shutdown)
+        self.cost: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -569,6 +587,7 @@ class RunReport:
             "resilience": self.resilience,
             "precision": self.precision,
             "probe": self.probe,
+            "cost": self.cost,
         }
         return validate_report(out) if validate else out
 

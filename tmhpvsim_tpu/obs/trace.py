@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import contextvars
 import json
 import os
 import threading
@@ -47,6 +48,129 @@ TRACE_RING_CAPACITY = 65_536
 
 #: seconds of history a flight dump keeps by default
 FLIGHT_WINDOW_S = 30.0
+
+
+# -- cross-process trace-context propagation ---------------------------
+#
+# W3C-traceparent-style ids carried in broker ``Message.meta`` and the
+# serve request/reply schema: ``trace_id`` (32 hex chars, one per
+# logical request) and ``span_id`` (16 hex chars, one per hop).  The
+# layer is OFF by default — ``stamp``/``extract`` are no-ops until an
+# app turns it on (``--obs-port`` does), so the default wire format is
+# byte-identical to pre-propagation builds.  The bound context rides a
+# ``contextvars.ContextVar``, so it follows asyncio tasks (set before
+# ``create_task`` → inherited by the task) and is restored on scope
+# exit; spans/instants recorded while a context is bound carry the
+# trace_id in their args, which is what ``tools/trace_stats.py
+# --stitch`` groups the multi-process timeline by.
+
+_propagate = False
+_context: contextvars.ContextVar = contextvars.ContextVar(
+    "tmhpvsim_trace_context", default=None)
+
+
+def enable_propagation(on: bool = True) -> None:
+    """Turn trace-context stamping/extraction on (or back off)."""
+    global _propagate
+    _propagate = bool(on)
+
+
+def propagation_enabled() -> bool:
+    return _propagate
+
+
+@contextlib.contextmanager
+def use_propagation(on: bool = True):
+    """Scoped :func:`enable_propagation` (tests)."""
+    global _propagate
+    prev = _propagate
+    _propagate = bool(on)
+    try:
+        yield
+    finally:
+        _propagate = prev
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current_trace() -> Optional[tuple]:
+    """The bound ``(trace_id, span_id)``, or None."""
+    return _context.get()
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id: Optional[str], span_id: Optional[str] = None):
+    """Bind ``(trace_id, span_id)`` as the current trace context for the
+    scope.  ``trace_id=None`` binds nothing (callers can pass a maybe-id
+    straight through)."""
+    if trace_id is None:
+        yield None
+        return
+    ctx = (trace_id, span_id or new_span_id())
+    token = _context.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _context.reset(token)
+
+
+def stamp(meta: Optional[dict]) -> Optional[dict]:
+    """Return ``meta`` with ``trace_id``/``span_id`` added (a fresh dict;
+    the input is never mutated).  Continues the bound trace when one is
+    set, else mints a new trace.  When propagation is off, returns
+    ``meta`` unchanged — the transports call this unconditionally and
+    the off path must not alter the wire format."""
+    if not _propagate:
+        return meta
+    ctx = _context.get()
+    out = dict(meta) if meta else {}
+    out.setdefault("trace_id", ctx[0] if ctx else new_trace_id())
+    out.setdefault("span_id", new_span_id())
+    return out
+
+
+def extract(meta: Optional[dict]) -> Optional[tuple]:
+    """``(trace_id, span_id)`` carried by a message's meta, or None (off,
+    absent, or malformed — a foreign publisher's meta never raises)."""
+    if not _propagate or not isinstance(meta, dict):
+        return None
+    tid = meta.get("trace_id")
+    if not isinstance(tid, str) or not tid:
+        return None
+    sid = meta.get("span_id")
+    return (tid, sid if isinstance(sid, str) and sid else None)
+
+
+@contextlib.contextmanager
+def extracted(meta: Optional[dict]):
+    """Bind the trace context carried by ``meta`` for the scope (the
+    consume-side counterpart of :func:`stamp`); binds nothing when the
+    meta carries no context."""
+    ctx = extract(meta)
+    if ctx is None:
+        yield None
+        return
+    token = _context.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _context.reset(token)
+
+
+def _with_trace_id(args: dict) -> dict:
+    """Merge the bound trace_id into span/instant args (recording side of
+    propagation: this is what lets the stitcher claim an event)."""
+    if _propagate:
+        ctx = _context.get()
+        if ctx is not None and "trace_id" not in args:
+            return {**args, "trace_id": ctx[0]}
+    return args
 
 
 def _task_or_thread() -> str:
@@ -77,6 +201,7 @@ class _Span:
 
     def __enter__(self):
         self._t0 = self._tracer.now_us()
+        self._args = _with_trace_id(self._args)
         return self
 
     def __exit__(self, *exc):
@@ -124,6 +249,7 @@ class Tracer:
             return
         ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
               "ts": self.now_us(), "tid": _task_or_thread()}
+        args = _with_trace_id(args)
         if args:
             ev["args"] = args
         self._events.append(ev)
@@ -137,11 +263,11 @@ class Tracer:
 
     # -- export ----------------------------------------------------------
 
-    def export(self, path: str, process_name: str = "tmhpvsim",
-               events: Optional[list] = None) -> dict:
-        """Write the ring (or ``events``) as a Chrome-trace JSON; returns
-        the document.  Atomic tmp+rename: a killed process never leaves a
-        torn trace for the salvage tooling to choke on."""
+    def render(self, events: Optional[list] = None,
+               process_name: str = "tmhpvsim") -> dict:
+        """The ring (or ``events``) as a Chrome-trace document dict —
+        what :meth:`export` writes and what ``obs/live.py`` serves at
+        ``/flight``."""
         evs = self.events() if events is None else events
         pid = os.getpid()
         # string track labels -> small int tids + "thread_name" metadata,
@@ -157,7 +283,23 @@ class Tracer:
         for label, tid in tids.items():
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": label}})
-        doc = {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+        return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+    def flight_doc(self, last_s: float = FLIGHT_WINDOW_S) -> dict:
+        """The last ``last_s`` seconds of the ring as a trace document
+        (no file written).  A span that *started* before the window but
+        overlaps it is kept (that long span is usually the story)."""
+        cut = self.now_us() - int(last_s * 1e6)
+        evs = [e for e in self.events()
+               if e["ts"] + e.get("dur", 0) >= cut]
+        return self.render(events=evs)
+
+    def export(self, path: str, process_name: str = "tmhpvsim",
+               events: Optional[list] = None) -> dict:
+        """Write the ring (or ``events``) as a Chrome-trace JSON; returns
+        the document.  Atomic tmp+rename: a killed process never leaves a
+        torn trace for the salvage tooling to choke on."""
+        doc = self.render(events=events, process_name=process_name)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -170,9 +312,7 @@ class Tracer:
     def dump_flight(self, path: str,
                     last_s: float = FLIGHT_WINDOW_S) -> dict:
         """Export only the last ``last_s`` seconds of the ring — the
-        crash/watchdog artifact.  A span that *started* before the
-        window but overlaps it is kept (that long span is usually the
-        story)."""
+        crash/watchdog artifact (see :meth:`flight_doc`)."""
         cut = self.now_us() - int(last_s * 1e6)
         evs = [e for e in self.events()
                if e["ts"] + e.get("dur", 0) >= cut]

@@ -34,6 +34,7 @@ import logging
 import weakref
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
+from tmhpvsim_tpu.obs import trace as obs_trace
 from tmhpvsim_tpu.runtime import faults
 
 logger = logging.getLogger(__name__)
@@ -189,6 +190,9 @@ class LocalTransport:
 
     async def publish(self, value: float, time: _dt.datetime,
                       meta: Optional[dict] = None) -> None:
+        # no-op unless trace propagation is on (--obs-port / tests); a
+        # dup-faulted resend keeps the SAME ids — it is the same message
+        meta = obs_trace.stamp(meta)
         act = None
         if faults.ACTIVE is not None:
             act = await faults.afire("broker.publish")
@@ -273,6 +277,7 @@ class AmqpTransport:
         # meta rides in AMQP headers, NOT the body: the reference
         # consumer json.loads()es the body as a bare float and must keep
         # working against a stamping producer
+        meta = obs_trace.stamp(meta)
         act = None
         if faults.ACTIVE is not None:
             act = await faults.afire("broker.publish")

@@ -1,7 +1,8 @@
 """Unified resilience policy: retries, backoff, deadlines, breakers.
 
-This module is the successor of ``runtime/retry.py`` (now a deprecated
-re-export shim).  It keeps the reference's ``asyncretry`` decorator
+This module is the successor of ``runtime/retry.py`` (which shimmed to
+here with a DeprecationWarning for one release and has since been
+removed).  It keeps the reference's ``asyncretry`` decorator
 semantics bit-for-bit (``forever`` sentinel, ``propagate`` fallback,
 ``CancelledError`` always fatal, per-qualname ``retry.*`` counters, the
 exhaustion WARN) and layers the pieces the streaming/serving stack

@@ -298,9 +298,14 @@ class TcpTransport:
 
     async def publish(self, value: float, time: _dt.datetime,
                       meta: Optional[dict] = None) -> None:
+        from tmhpvsim_tpu.obs import trace as obs_trace
         from tmhpvsim_tpu.runtime import faults
         from tmhpvsim_tpu.runtime.broker import _pub_counter
 
+        # no-op unless trace propagation is on; the "m" key only appears
+        # on the wire when there is meta to carry, so the off path stays
+        # byte-identical to pre-propagation frames
+        meta = obs_trace.stamp(meta)
         act = None
         if faults.ACTIVE is not None:
             act = await faults.afire("broker.publish")

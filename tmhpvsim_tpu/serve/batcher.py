@@ -30,6 +30,7 @@ import logging
 from typing import Callable, List, Optional, Sequence
 
 from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs import trace as obs_trace
 from tmhpvsim_tpu.runtime import faults
 from tmhpvsim_tpu.runtime.resilience import CircuitBreaker
 from tmhpvsim_tpu.serve.schema import Request, RequestError
@@ -105,6 +106,9 @@ class MicroBatcher:
             raise RequestError(
                 "busy", f"pending queue full "
                 f"({self._queue.maxsize} requests)") from None
+        tracer = obs_trace.get_tracer()
+        if tracer:  # queue-wait starts here; trace_id rides the context
+            tracer.instant("batcher.admit", "serve", rid=request.id)
         return pending.future
 
     async def stop(self, drain: bool = True,
@@ -188,12 +192,22 @@ class MicroBatcher:
         self._g_occupancy.set(len(batch))
         self._c_batches.inc()
         requests = [p.request for p in batch]
+        tracer = obs_trace.get_tracer()
+        span = contextlib.nullcontext()
+        if tracer:
+            # one fused dispatch serves many traces: the span carries
+            # ALL of their ids so the stitcher can claim it for each
+            tids = [r.trace_id for r in requests if r.trace_id]
+            span = tracer.span("batcher.dispatch", "serve",
+                               batch=len(batch),
+                               **({"trace_ids": tids} if tids else {}))
         t0 = loop.time()
         try:
-            if faults.ACTIVE is not None:
-                await faults.afire("serve.dispatch")
-            results = await loop.run_in_executor(
-                self._pool, self._dispatch, requests)
+            with span:
+                if faults.ACTIVE is not None:
+                    await faults.afire("serve.dispatch")
+                results = await loop.run_in_executor(
+                    self._pool, self._dispatch, requests)
         except Exception as err:
             if self.breaker is not None:
                 self.breaker.record_failure()

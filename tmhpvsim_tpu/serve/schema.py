@@ -101,12 +101,21 @@ class Scenario:
 
 @dataclasses.dataclass(frozen=True)
 class Request:
-    """One validated scenario request."""
+    """One validated scenario request.
+
+    ``trace_id``/``span_id`` are the optional W3C-traceparent-style
+    propagation ids (obs/trace.py): stamped by the client's transport
+    when the live ops plane is on, echoed in the reply so one id
+    correlates client → broker → batcher → dispatch → reply.  Absent ids
+    parse as None — tracing is never a validity condition.
+    """
 
     id: str
     reply_to: str
     mode: str
     scenario: Scenario
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
 
 def _check_float(name: str, v, lo: float, hi: float) -> float:
@@ -183,13 +192,17 @@ def parse_request(meta, *, max_horizon_s: int) -> Request:
         raise RequestError(
             "invalid", f"mode {mode!r} not one of {', '.join(MODES)}")
     unknown = sorted(set(meta) - {"op", "id", "reply_to", "mode",
-                                  "scenario"})
+                                  "scenario", "trace_id", "span_id"})
     if unknown:
         raise RequestError(
             "invalid", f"unknown request field(s) {', '.join(unknown)}")
     scenario = parse_scenario(meta.get("scenario"),
                               max_horizon_s=max_horizon_s)
-    return Request(id=rid, reply_to=reply_to, mode=mode, scenario=scenario)
+    tid, sid = meta.get("trace_id"), meta.get("span_id")
+    return Request(
+        id=rid, reply_to=reply_to, mode=mode, scenario=scenario,
+        trace_id=tid if isinstance(tid, str) and tid else None,
+        span_id=sid if isinstance(sid, str) and sid else None)
 
 
 def request_meta(rid: str, reply_to: str, mode: str = "reduce",
@@ -203,18 +216,25 @@ def request_meta(rid: str, reply_to: str, mode: str = "reduce",
 
 
 def ok_meta(rid: str, mode: str, result: dict,
-            timings: Optional[dict] = None) -> dict:
+            timings: Optional[dict] = None,
+            trace_id: Optional[str] = None) -> dict:
     meta = {"op": OP_REPLY, "id": rid, "ok": True, "mode": mode,
             "result": result}
     if timings:
         meta["t"] = timings
+    if trace_id:  # echo the request's trace so the reply joins its trace
+        meta["trace_id"] = trace_id
     return meta
 
 
-def error_meta(rid: Optional[str], code: str, message: str) -> dict:
+def error_meta(rid: Optional[str], code: str, message: str,
+               trace_id: Optional[str] = None) -> dict:
     assert code in ERROR_CODES, code
-    return {"op": OP_REPLY, "id": rid, "ok": False,
+    meta = {"op": OP_REPLY, "id": rid, "ok": False,
             "error": {"code": code, "message": message}}
+    if trace_id:
+        meta["trace_id"] = trace_id
+    return meta
 
 
 def pick_bucket(n: int, buckets: Sequence[int]) -> int:

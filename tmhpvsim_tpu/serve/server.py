@@ -45,6 +45,7 @@ import numpy as np
 from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.obs import analytics as flt
 from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs import trace as obs_trace
 from tmhpvsim_tpu.obs.trace import Tracer
 from tmhpvsim_tpu.runtime.broker import make_transport
 from tmhpvsim_tpu.runtime.resilience import (CircuitBreaker,
@@ -253,6 +254,21 @@ class ScenarioServer:
     def draining(self) -> bool:
         return self._draining
 
+    def readiness(self) -> tuple:
+        """``(ok, detail)`` for the live ops plane's ``/readyz``: ready
+        iff the warm engine is built (AOT warm-up done), the server is
+        not draining, and the dispatch circuit breaker is closed — an
+        open OR half-open breaker reads not-ready until its probe batch
+        actually succeeds, so a load balancer only routes to workers
+        whose device path is proven."""
+        warm = self.engine is not None
+        breaker = self.batcher.breaker if self.batcher is not None \
+            else None
+        bstate = breaker.state if breaker is not None else "closed"
+        ok = warm and not self._draining and bstate == "closed"
+        return ok, {"warm": warm, "draining": self._draining,
+                    "breaker": bstate}
+
     async def start(self) -> None:
         """Build the warm engine (compiles — possibly from the warm
         cache), open the request subscription, start the batcher."""
@@ -376,6 +392,14 @@ class ScenarioServer:
         if not isinstance(meta, dict) or \
                 meta.get("op") != schema.OP_REQUEST:
             return
+        # bind the request's propagated trace context (no-op when the
+        # live ops plane is off): the scope covers the instants below
+        # AND the tasks created inside it — contextvars follow
+        # create_task, so _respond/_publish_reply inherit the ids
+        with obs_trace.extracted(meta):
+            self._handle_traced(meta)
+
+    def _handle_traced(self, meta: dict) -> None:
         self._c_requests.inc()
         loop = asyncio.get_running_loop()
         t_recv = loop.time()
@@ -397,7 +421,9 @@ class ScenarioServer:
                 raise RequestError(
                     "duplicate", f"request id {req.id!r} already seen")
         except RequestError as err:
-            self._reject(reply_to, rid, err)
+            tid = meta.get("trace_id")
+            self._reject(reply_to, rid, err,
+                         trace_id=tid if isinstance(tid, str) else None)
             return
         self._inflight_ids.add(req.id)
         self._g_inflight.set(len(self._inflight_ids))
@@ -406,13 +432,15 @@ class ScenarioServer:
         task.add_done_callback(self._tasks.discard)
 
     def _reject(self, reply_to: Optional[str], rid: Optional[str],
-                err: RequestError) -> None:
+                err: RequestError,
+                trace_id: Optional[str] = None) -> None:
         self._c_rejected.inc()
         logger.warning("scenario request rejected (%s): %s",
                        err.code, err)
         if reply_to:  # no reply address -> counted, nothing to say
             task = asyncio.create_task(self._publish_reply(
-                reply_to, schema.error_meta(rid, err.code, str(err))))
+                reply_to, schema.error_meta(rid, err.code, str(err),
+                                            trace_id=trace_id)))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
 
@@ -427,22 +455,25 @@ class ScenarioServer:
                 self._c_timeouts.inc()
                 await self._publish_reply(req.reply_to, schema.error_meta(
                     req.id, "timeout",
-                    f"no result within {self.cfg.timeout_s:g} s"))
+                    f"no result within {self.cfg.timeout_s:g} s",
+                    trace_id=req.trace_id))
                 return
             except RequestError as err:
                 self._c_rejected.inc()
                 await self._publish_reply(req.reply_to, schema.error_meta(
-                    req.id, err.code, str(err)))
+                    req.id, err.code, str(err), trace_id=req.trace_id))
                 return
             except Exception as err:  # engine bug: reply, do not wedge
                 logger.exception("scenario request %s failed", req.id)
                 await self._publish_reply(req.reply_to, schema.error_meta(
-                    req.id, "internal", f"{type(err).__name__}: {err}"))
+                    req.id, "internal", f"{type(err).__name__}: {err}",
+                    trace_id=req.trace_id))
                 return
             latency = loop.time() - t_recv
             await self._publish_reply(req.reply_to, schema.ok_meta(
                 req.id, req.mode, result,
-                timings={**info, "reply_latency_s": latency}))
+                timings={**info, "reply_latency_s": latency},
+                trace_id=req.trace_id))
             self._c_replies.inc()
             self._h_reply.observe(latency)
             if self.tracer:
@@ -566,14 +597,27 @@ class ScenarioClient:
         fut = loop.create_future()
         self._pending[rid] = fut
         meta = schema.request_meta(rid, self.reply_to, mode, scenario)
+        # one trace per logical request: mint here (when propagation is
+        # on) so the publish instant, the transport stamp and the reply
+        # all share the id
+        tid = obs_trace.new_trace_id() \
+            if obs_trace.propagation_enabled() else None
         try:
-            if self._policy is not None:
-                await self._policy.call(
-                    self._req_tx.publish, 0.0, _now(), meta=meta,
-                    name="ScenarioClient.request")
-            else:
-                await self._req_tx.publish(0.0, _now(), meta=meta)
-            return await asyncio.wait_for(fut, timeout)
+            with obs_trace.trace_scope(tid):
+                tracer = obs_trace.get_tracer()
+                if tracer:
+                    tracer.instant("client.publish", "serve", id=rid)
+                if self._policy is not None:
+                    await self._policy.call(
+                        self._req_tx.publish, 0.0, _now(), meta=meta,
+                        name="ScenarioClient.request")
+                else:
+                    await self._req_tx.publish(0.0, _now(), meta=meta)
+                reply = await asyncio.wait_for(fut, timeout)
+                if tracer:
+                    tracer.instant("client.reply", "serve", id=rid,
+                                   ok=bool(reply.get("ok")))
+                return reply
         finally:
             self._pending.pop(rid, None)
 
@@ -591,11 +635,15 @@ async def serve_main(cfg: ServeConfig, *,
                      trace: Optional[str] = None,
                      metrics_path: Optional[str] = None,
                      run_report_path: Optional[str] = None,
+                     obs_port: Optional[int] = None,
                      install_signals: bool = True) -> None:
     """App orchestrator behind ``pvsim serve``: per-run registry +
     compile cache + flight recorder + run report, around one
-    :class:`ScenarioServer` lifetime."""
-    from tmhpvsim_tpu.engine import compilecache
+    :class:`ScenarioServer` lifetime.  ``obs_port`` (``--obs-port``)
+    additionally binds the live ops plane (obs/live.py) — bound BEFORE
+    the warm-up compile so ``/readyz`` answers 503 while warming — and
+    turns on cross-process trace propagation."""
+    from tmhpvsim_tpu.obs.live import maybe_obs_server
 
     registry = obs_metrics.MetricsRegistry()
     sink = None
@@ -604,6 +652,20 @@ async def serve_main(cfg: ServeConfig, *,
         registry.add_sink(sink)
     tracer = Tracer() if trace else None
     server = ScenarioServer(cfg, registry=registry, tracer=tracer)
+    if obs_port is not None:
+        obs_trace.enable_propagation(True)
+    async with maybe_obs_server(obs_port, registry=registry,
+                                tracer=tracer, ready=server.readiness):
+        await _serve_main_inner(cfg, server, registry, sink, tracer,
+                                compile_cache, trace, run_report_path,
+                                install_signals)
+
+
+async def _serve_main_inner(cfg, server, registry, sink, tracer,
+                            compile_cache, trace, run_report_path,
+                            install_signals) -> None:
+    from tmhpvsim_tpu.engine import compilecache
+
     with obs_metrics.use_registry(registry):
         if compile_cache is not None:
             compilecache.configure(compile_cache)

@@ -34,8 +34,12 @@ and kernel implementation; pre-v8 docs read as f32/exact) plus a
 ``prec`` column pricing the precision levers — the best
 speedup-vs-exact/f32 from the row's own ``precision`` section when its
 sweep timed both, else the row's throughput vs the best same-platform
-exact/f32 row.  ``--json`` emits the rows + gate verdict as one JSON
-document for machine consumers.
+exact/f32 row.  The v10 ``cost`` section adds a ``cost`` column — the
+row's north-star fraction (and, parenthesised, its VPU roofline
+fraction when the chip's peaks are known) — and the regression-gate
+verdict reports the newest round's roofline fraction alongside the
+steady-wall comparison.  ``--json`` emits the rows + gate verdict as
+one JSON document for machine consumers.
 
 No third-party imports: runs anywhere the repo checks out.
 """
@@ -141,6 +145,31 @@ def _precision_axes(doc) -> tuple:
     return cdt, kimpl, speed
 
 
+def _cost_fields(doc) -> tuple:
+    """(north_star_frac, roofline_frac_vpu) from a v10 ``cost`` section
+    — the bare RunReport's, the headline's embedded run_report's, or
+    the winning variant's.  Pre-v10 documents read as (None, None)."""
+    sec = None
+    if doc.get("kind") == REPORT_KIND:
+        sec = doc.get("cost")
+    else:
+        rep = doc.get("run_report")
+        if isinstance(rep, dict):
+            sec = rep.get("cost")
+        if not isinstance(sec, dict):
+            variants = doc.get("variants")
+            if isinstance(variants, dict):
+                best = variants.get(doc.get("headline_variant"))
+                if isinstance(best, dict):
+                    sec = best.get("cost")
+    if not isinstance(sec, dict):
+        return None, None
+    nsf = sec.get("north_star_frac")
+    vpu = sec.get("roofline_frac_vpu")
+    return (float(nsf) if isinstance(nsf, (int, float)) else None,
+            float(vpu) if isinstance(vpu, (int, float)) else None)
+
+
 def _levels(cfg) -> tuple:
     """(telemetry, analytics) levels from a config echo; pre-PR-3/PR-6
     documents predate the fields and read as 'off'."""
@@ -156,7 +185,8 @@ def normalize(path: str) -> dict:
            "compile_s": None, "steady_block_s": None,
            "telemetry": None, "analytics": None, "serve": None,
            "compute_dtype": None, "kernel_impl": None,
-           "precision_speedup": None, "failed": True}
+           "precision_speedup": None, "north_star_frac": None,
+           "roofline_frac_vpu": None, "failed": True}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -181,6 +211,7 @@ def normalize(path: str) -> dict:
         headline = doc.get("headline") or {}
         tel, ana = _levels(doc.get("config"))
         cdt, kimpl, prec_speed = _precision_axes(doc)
+        nsf, vpu = _cost_fields(doc)
         row.update(
             failed=False,
             platform=(doc.get("device") or {}).get("platform"),
@@ -191,6 +222,7 @@ def normalize(path: str) -> dict:
             serve=_serve_ratio(doc),
             compute_dtype=cdt, kernel_impl=kimpl,
             precision_speedup=prec_speed,
+            north_star_frac=nsf, roofline_frac_vpu=vpu,
         )
         return row
 
@@ -201,6 +233,7 @@ def normalize(path: str) -> dict:
         tel, ana = _levels(rep.get("config")
                            if isinstance(rep, dict) else None)
         cdt, kimpl, prec_speed = _precision_axes(doc)
+        nsf, vpu = _cost_fields(doc)
         row.update(
             failed=False,
             platform=doc.get("platform"),
@@ -211,6 +244,7 @@ def normalize(path: str) -> dict:
             serve=_serve_ratio(doc),
             compute_dtype=cdt, kernel_impl=kimpl,
             precision_speedup=prec_speed,
+            north_star_frac=nsf, roofline_frac_vpu=vpu,
         )
         return row
 
@@ -283,10 +317,23 @@ def annotate_precision(rows: list) -> None:
             r["precision_speedup"] = round(r["value"] / b, 2)
 
 
+def _fmt_cost(r) -> str:
+    """The ``cost`` cell: north-star fraction, with the VPU roofline
+    fraction parenthesised when the chip's peaks were known."""
+    nsf = r.get("north_star_frac")
+    if nsf is None:
+        return "-"
+    vpu = r.get("roofline_frac_vpu")
+    cell = f"{nsf:.3f}"
+    if vpu is not None:
+        cell += f"({vpu * 100:.1f}%vpu)"
+    return cell
+
+
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
-            "cdt", "kimpl", "prec", "note")
+            "cdt", "kimpl", "prec", "cost", "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
@@ -300,6 +347,7 @@ def print_table(rows: list) -> None:
             "-" if srv is None else f"{srv:.2f}x",
             r.get("compute_dtype") or "-", r.get("kernel_impl") or "-",
             "-" if prec is None else f"{prec:.2f}x",
+            _fmt_cost(r),
             r.get("note", ""),
         ))
     widths = [max(len(str(line[i])) for line in table)
@@ -311,10 +359,25 @@ def print_table(rows: list) -> None:
             print("  ".join("-" * w for w in widths))
 
 
+def _cost_suffix(r) -> str:
+    """Roofline report appended to the gate verdict (v10 cost rows):
+    the newest round's north-star + VPU roofline fractions ride next to
+    the steady-wall comparison so a wall regression and a roofline drop
+    are read together."""
+    nsf, vpu = r.get("north_star_frac"), r.get("roofline_frac_vpu")
+    if nsf is None:
+        return ""
+    out = f"; north_star_frac={nsf:.3f}"
+    if vpu is not None:
+        out += f", roofline_vpu={vpu * 100:.2f}%"
+    return out
+
+
 def check_regression(rows: list, max_regress_pct: float):
     """(ok, message): newest valid round vs the best prior same-platform
     round — steady block wall when both recorded one, throughput
-    otherwise."""
+    otherwise.  Rows with a v10 cost section get their roofline
+    fractions reported alongside the verdict."""
     valid = [r for r in rows if not r["failed"]]
     if len(valid) < 2:
         return True, "no prior round to compare against; gate passes"
@@ -334,13 +397,14 @@ def check_regression(rows: list, max_regress_pct: float):
                 f"steady_block_s={newest['steady_block_s']:.4g} vs best "
                 f"prior {best['name']}={best['steady_block_s']:.4g} "
                 f"(+{(newest['steady_block_s'] / best['steady_block_s'] - 1) * 100:.1f}% "
-                f"> {max_regress_pct:g}% allowed)"
+                f"> {max_regress_pct:g}% allowed)" + _cost_suffix(newest)
             )
         return True, (
             f"steady gate ok: {newest['name']} "
             f"steady_block_s={newest['steady_block_s']:.4g} within "
             f"{max_regress_pct:g}% of best prior "
             f"{best['name']}={best['steady_block_s']:.4g}"
+            + _cost_suffix(newest)
         )
     value_prior = [r for r in prior if r["value"] is not None]
     if newest["value"] is not None and value_prior:
@@ -352,12 +416,13 @@ def check_regression(rows: list, max_regress_pct: float):
                 f"value={newest['value']:.4g} vs best prior "
                 f"{best['name']}={best['value']:.4g} "
                 f"(-{(1 - newest['value'] / best['value']) * 100:.1f}% "
-                f"> {max_regress_pct:g}% allowed)"
+                f"> {max_regress_pct:g}% allowed)" + _cost_suffix(newest)
             )
         return True, (
             f"throughput gate ok: {newest['name']} "
             f"value={newest['value']:.4g} within {max_regress_pct:g}% of "
             f"best prior {best['name']}={best['value']:.4g}"
+            + _cost_suffix(newest)
         )
     return True, "newest round records no comparable metric; gate passes"
 

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the v10 ``cost`` section of RunReport /
+bench artifacts.
+
+Reads one or more JSON files — bare RunReports, bench headline docs
+(with an embedded ``run_report`` and per-variant ``cost`` docs), or
+driver wrappers (``{"parsed": ...}``) — finds every cost doc inside,
+runs :func:`tmhpvsim_tpu.obs.cost.validate_cost` over each, and prints
+one human line per doc::
+
+    HEADLINE_r05.json scan2/bf16/table  1.2e9 site-s/s  achieved 561.6
+    GFLOP/s (9.2% vpu) / 79.2 GB/s (9.7% hbm)  north-star 0.183  [model]
+
+Exit code: 0 when every cost doc found validates (including files with
+none — the tool is wired NON-fatally into the bench battery, where
+pre-v10 artifacts are the norm), 1 when any doc fails validation, 2 on
+unreadable input.  ``--json`` emits the findings as one machine-readable
+document instead.
+
+Stdlib + tmhpvsim_tpu only — runs anywhere the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tmhpvsim_tpu.obs.cost import validate_cost  # noqa: E402
+
+
+def find_cost_docs(doc, where: str = "$") -> list:
+    """Every ``cost`` section in a document: ``(json_path, doc)`` pairs.
+
+    Looks in the places the repo's artifact shapes put them — a bare
+    RunReport's top-level ``cost``, a headline's ``run_report.cost``,
+    each variant's ``cost``, and a driver wrapper's ``parsed`` payload.
+    """
+    found = []
+    if not isinstance(doc, dict):
+        return found
+    if "parsed" in doc and "cmd" in doc:
+        return find_cost_docs(doc.get("parsed"), where + ".parsed")
+    if isinstance(doc.get("cost"), dict):
+        found.append((where + ".cost", doc["cost"]))
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and isinstance(rep.get("cost"), dict):
+        found.append((where + ".run_report.cost", rep["cost"]))
+    variants = doc.get("variants")
+    if isinstance(variants, dict):
+        for name, v in sorted(variants.items()):
+            if isinstance(v, dict) and isinstance(v.get("cost"), dict):
+                found.append((f"{where}.variants.{name}.cost", v["cost"]))
+    return found
+
+
+def render(cost: dict) -> str:
+    """One human line for a valid cost doc."""
+    cell = "/".join((cost.get("block_impl", "?"),
+                     cost.get("compute_dtype", "?"),
+                     cost.get("kernel_impl", "?")))
+    parts = [cell]
+    rate = cost.get("site_s_per_s")
+    if rate is not None:
+        parts.append(f"{rate:.3g} site-s/s")
+    gf, gb = cost.get("achieved_gflops"), cost.get("achieved_gbs")
+    if gf is not None:
+        vpu = cost.get("roofline_frac_vpu")
+        hbm = cost.get("roofline_frac_hbm")
+        fl = f"achieved {gf:g} GFLOP/s"
+        if vpu is not None:
+            fl += f" ({vpu * 100:.1f}% vpu)"
+        fl += f" / {gb:g} GB/s"
+        if hbm is not None:
+            fl += f" ({hbm * 100:.1f}% hbm)"
+        parts.append(fl)
+    nsf = cost.get("north_star_frac")
+    if nsf is not None:
+        parts.append(f"north-star {nsf:.3f}")
+    parts.append(f"[{cost.get('basis', 'model')}]")
+    return "  ".join(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print v10 cost sections")
+    ap.add_argument("files", nargs="+",
+                    help="RunReport / bench artifact JSON files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as one JSON document")
+    ap.add_argument("--require", action="store_true",
+                    help="also fail (exit 1) when a file contains NO "
+                         "cost doc at all (default: pre-v10 artifacts "
+                         "pass silently)")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    findings = []
+    for path in args.files:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{name}: unreadable: {e}", file=sys.stderr)
+            rc = max(rc, 2)
+            continue
+        docs = find_cost_docs(doc)
+        if not docs:
+            findings.append({"file": name, "path": None, "ok": True,
+                             "note": "no cost section (pre-v10)"})
+            if args.require:
+                print(f"{name}: no cost section", file=sys.stderr)
+                rc = max(rc, 1)
+            continue
+        for where, cost in docs:
+            errors = validate_cost(cost)
+            finding = {"file": name, "path": where,
+                       "ok": not errors, "cost": cost}
+            if errors:
+                finding["errors"] = errors
+                rc = max(rc, 1)
+                if not args.json:
+                    print(f"{name} {where}: INVALID: "
+                          + "; ".join(errors))
+            elif not args.json:
+                print(f"{name} {where.removeprefix('$.')}: "
+                      + render(cost))
+            findings.append(finding)
+    if args.json:
+        print(json.dumps({"ok": rc == 0, "findings": findings},
+                         indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

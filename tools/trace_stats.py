@@ -19,6 +19,17 @@ durations plus instant-event counts:
 Exit code 0 when every file validates, nonzero otherwise — which is how
 ``run_tpu_round5b.sh`` and the tier-1 round-trip test consume it.
 
+``--stitch OUT.json`` additionally merges every input file into ONE
+timeline: each (file, pid) pair gets its own process track (labelled
+``file:pid`` via a ``process_name`` metadata event, so client / broker /
+server processes stay visually distinct in Perfetto), and events are
+grouped by the cross-process trace ids obs/trace.py stamps —
+``args.trace_id`` on client/server spans, plus every entry of the
+``args.trace_ids`` list a fused batcher dispatch carries.  A per-trace
+table then shows how many events and processes each request touched and
+its end-to-end wall span, which is how the serve soak test proves one id
+correlates client → broker → queue-wait → dispatch → reply.
+
 No third-party imports: runs anywhere the repo checks out.
 """
 
@@ -114,6 +125,88 @@ def _print_summary(name: str, events: list) -> None:
               .rstrip())
 
 
+def stitch(named_events: list) -> list:
+    """Merge ``(name, events)`` pairs into one event list.
+
+    Every (source file, original pid) pair is remapped to a fresh
+    sequential pid so processes from different files never share a
+    track, with a ``process_name`` metadata event labelling each track
+    ``name:original_pid``.  Events without a pid inherit their file's
+    first track.  Input events are not mutated.
+    """
+    merged: list = []
+    next_pid = 1
+    for name, events in named_events:
+        remap: dict = {}
+
+        def _track(orig) -> int:
+            nonlocal next_pid
+            if orig not in remap:
+                remap[orig] = next_pid
+                merged.append({"ph": "M", "name": "process_name",
+                               "pid": next_pid,
+                               "args": {"name": f"{name}:{orig}"}})
+                next_pid += 1
+            return remap[orig]
+
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            out = dict(ev)
+            out["pid"] = _track(ev.get("pid") if
+                                isinstance(ev.get("pid"), int) else None)
+            merged.append(out)
+    merged.sort(key=lambda ev: (ev.get("ph") != "M",
+                                ev.get("ts") or 0))
+    return merged
+
+
+def trace_groups(events: list) -> dict:
+    """Events per propagated trace id: ``{trace_id: [event, ...]}``.
+
+    An event belongs to every id it references — its ``args.trace_id``
+    plus each entry of ``args.trace_ids`` (a fused batcher dispatch
+    serves many traces, so its one span appears in every group).
+    """
+    groups: dict = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") == "M":
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict):
+            continue
+        ids = []
+        if isinstance(args.get("trace_id"), str):
+            ids.append(args["trace_id"])
+        if isinstance(args.get("trace_ids"), list):
+            ids.extend(t for t in args["trace_ids"]
+                       if isinstance(t, str))
+        for tid in dict.fromkeys(ids):
+            groups.setdefault(tid, []).append(ev)
+    return groups
+
+
+def _print_trace_table(groups: dict) -> None:
+    header = ("trace_id", "events", "procs", "span_ms", "names")
+    table = [header]
+    for tid in sorted(groups):
+        evs = sorted(groups[tid], key=lambda ev: ev.get("ts") or 0)
+        start = min(ev.get("ts", 0) for ev in evs)
+        end = max(ev.get("ts", 0) + (ev.get("dur") or 0) for ev in evs)
+        procs = {ev.get("pid") for ev in evs}
+        names = ",".join(dict.fromkeys(
+            str(ev.get("name", "?")) for ev in evs))
+        if len(names) > 48:
+            names = names[:45] + "..."
+        table.append((tid, str(len(evs)), str(len(procs)),
+                      f"{(end - start) / 1e3:.3f}", names))
+    widths = [max(len(line[i]) for line in table)
+              for i in range(len(header))]
+    for line in table:
+        print("  " + "  ".join(c.ljust(w) for c, w in zip(line, widths))
+              .rstrip())
+
+
 def check_file(path: str, quiet: bool = False) -> bool:
     """Validate + summarise one trace file; True when it passes."""
     name = os.path.basename(path)
@@ -149,11 +242,29 @@ def main(argv=None) -> int:
     ap.add_argument("files", nargs="+", help="trace files to check")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the summary table (errors still print)")
+    ap.add_argument("--stitch", metavar="OUT.json",
+                    help="merge all inputs into one timeline at "
+                         "OUT.json (one process track per file:pid) "
+                         "and print the per-trace-id correlation table")
     args = ap.parse_args(argv)
 
     ok = True
     for path in args.files:
         ok = check_file(path, quiet=args.quiet) and ok
+    if ok and args.stitch:
+        named = []
+        for path in args.files:
+            with open(path) as f:
+                _, events = validate(json.load(f))
+            named.append((os.path.basename(path), events))
+        merged = stitch(named)
+        with open(args.stitch, "w") as f:
+            json.dump({"traceEvents": merged}, f)
+        groups = trace_groups(merged)
+        print(f"stitched {len(named)} file(s) -> {args.stitch}: "
+              f"{len(merged)} events, {len(groups)} trace id(s)")
+        if groups and not args.quiet:
+            _print_trace_table(groups)
     return 0 if ok else 1
 
 
