@@ -299,7 +299,9 @@ def _config_cost(plan, rate, device_kind) -> dict | None:
         return obs_cost.cost_doc(
             site_s_per_s=rate, block_impl=p.get("block_impl"),
             compute_dtype=p.get("compute_dtype"),
-            kernel_impl=p.get("kernel_impl"), device_kind=device_kind)
+            kernel_impl=p.get("kernel_impl"),
+            rng_batch=p.get("rng_batch"),
+            geom_stride=p.get("geom_stride"), device_kind=device_kind)
     except Exception as e:
         print(f"# cost doc failed: {e}", file=sys.stderr)
         return None
@@ -468,6 +470,28 @@ VARIANT_CFGS = {
                         kernel_impl="table"),
     "scan2-bf16-table": dict(prng_impl="threefry2x32", block_impl="scan2",
                              compute_dtype="bf16", kernel_impl="table"),
+    # scan-restructuring levers, also priced on the scan2 path.
+    # rng_batch='block' hoists every per-minute noise draw into whole-
+    # block counter-mode tensors before the scan — bit-identical by
+    # construction (same fold_in keying, asserted in tests), so no
+    # sentinel is owed.  geom_stride=60 is an approximation lever
+    # (strided geometry + lerp, models/solar.py:STRIDE_MAX_ABS_ERR):
+    # like bf16 it must never run unwatched, so its variants carry
+    # telemetry='light' and the published rates pay the drift sentinel's
+    # cost — the honest number.
+    "scan2-rngblock": dict(prng_impl="threefry2x32", block_impl="scan2",
+                           rng_batch="block"),
+    "scan2-stride60": dict(prng_impl="threefry2x32", block_impl="scan2",
+                           geom_stride=60, telemetry="light"),
+    "scan2-rngblock-stride60": dict(
+        prng_impl="threefry2x32", block_impl="scan2",
+        rng_batch="block", geom_stride=60, telemetry="light"),
+    # the full stack: both scan-restructuring levers on top of the PR-9
+    # precision levers — the best-case composite rate
+    "scan2-rngblock-stride60-bf16-table": dict(
+        prng_impl="threefry2x32", block_impl="scan2",
+        rng_batch="block", geom_stride=60,
+        compute_dtype="bf16", kernel_impl="table", telemetry="light"),
     "scan-rbg": dict(prng_impl="rbg", block_impl="auto", _probe=True),
 }
 
@@ -543,15 +567,17 @@ def _plan_doc(plan) -> dict:
             "slab_chains": plan.slab_chains, "source": plan.source,
             "blocks_per_dispatch": plan.blocks_per_dispatch,
             "compute_dtype": getattr(plan, "compute_dtype", "f32"),
-            "kernel_impl": getattr(plan, "kernel_impl", "exact")}
+            "kernel_impl": getattr(plan, "kernel_impl", "exact"),
+            "rng_batch": getattr(plan, "rng_batch", "scan"),
+            "geom_stride": getattr(plan, "geom_stride", 1)}
 
 
 def _precision_doc(variants: dict) -> dict | None:
     """The v8 ``precision`` report section for one variant sweep: each
-    fully-timed variant's rate keyed by its (compute_dtype, kernel_impl)
-    axes, priced as a speedup against the best exact/f32 variant in the
-    SAME sweep (same platform, same process, same chain count — the only
-    comparison that isolates the precision lever)."""
+    fully-timed variant's rate keyed by its (compute_dtype, kernel_impl,
+    rng_batch, geom_stride) axes, priced as a speedup against the best
+    all-defaults variant in the SAME sweep (same platform, same process,
+    same chain count — the only comparison that isolates the lever)."""
     rows = {}
     base = None
     for name, v in variants.items():
@@ -560,9 +586,12 @@ def _precision_doc(variants: dict) -> dict | None:
         plan = v.get("plan") or {}
         cdt = plan.get("compute_dtype", "f32")
         kimpl = plan.get("kernel_impl", "exact")
+        rb = plan.get("rng_batch", "scan")
+        gs = plan.get("geom_stride", 1)
         rows[name] = {"compute_dtype": cdt, "kernel_impl": kimpl,
+                      "rng_batch": rb, "geom_stride": gs,
                       "rate": v["rate"]}
-        if cdt == "f32" and kimpl == "exact":
+        if cdt == "f32" and kimpl == "exact" and rb == "scan" and gs == 1:
             base = max(base or 0.0, v["rate"])
     if not rows:
         return None
@@ -608,6 +637,8 @@ def _headline_doc(variants: dict, platform: str, **extra) -> dict:
                 block_impl=vplan.get("block_impl") or v.get("impl"),
                 compute_dtype=vplan.get("compute_dtype"),
                 kernel_impl=vplan.get("kernel_impl"),
+                rng_batch=vplan.get("rng_batch"),
+                geom_stride=vplan.get("geom_stride"),
                 device_kind=extra.get("device_kind"), **measured)
         except Exception as e:  # pricing must never cost the headline
             print(f"# cost doc failed for {name}: {e}", file=sys.stderr)

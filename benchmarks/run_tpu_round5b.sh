@@ -136,6 +136,12 @@ run_json () {  # run_json <dest.json> <label> <args...>
   fi
 }
 
+# the headline sweep's variant matrix (bench.VARIANT_CFGS) now carries
+# the scan-restructuring levers — scan2-rngblock (whole-block RNG
+# pre-generation, bit-identical), scan2-stride60 (strided geometry +
+# lerp, sentinel-watched), their combination, and the full stack on top
+# of bf16/table — all priced per variant by obs/cost.py and folded into
+# the doc's v11 precision section the report loops below validate
 run_json benchmarks/HEADLINE_r05.json  headline2
 # --repro is now a distribution mode: six fresh-process compiles, one
 # seed per trial, summary with min/median/max + CoV (the compile-lottery
@@ -179,7 +185,10 @@ run_json benchmarks/SWEEP_r05.jsonl    sweep     --sweep
 run_json benchmarks/BENCH_config3.json  config3  --config 3
 # perf-trend gate (non-fatal here: the battery's job is to collect
 # evidence; rc=1 in the log flags a >10% steady-state regression vs the
-# best prior same-platform round for the human doing the round writeup)
+# best prior same-platform round for the human doing the round writeup).
+# On a checkout where only partial artifacts landed (a wedged battery)
+# the gate prints "no prior same-platform round" and exits 0 — a
+# partial round must not flag a regression it has no evidence for.
 echo "--- bench_trend start $(date -u +%FT%TZ)" >> "$LOG"
 python tools/bench_trend.py >> "$LOG" 2>&1 \
   || echo "--- bench_trend: REGRESSION OR ERROR rc=$?" >> "$LOG"
@@ -228,8 +237,12 @@ done
 # the compute_dtype/kernel_impl axes, their sweep pricing, the
 # resilience-wrapped backend-probe accounting) must carry a WELL-FORMED
 # one; default-precision docs just note the absence.  The headline doc
-# is included explicitly: it is where bench.py prices the levers.
-for bench_doc in benchmarks/HEADLINE_*.json benchmarks/REPRO_*.jsonl \
+# is included explicitly: it is where bench.py prices the levers —
+# including the v11 rng_batch/geom_stride variants — and a wedged
+# take's .partial headline gets the same check (its landed variants
+# are the round's only precision evidence).
+for bench_doc in benchmarks/HEADLINE_*.json benchmarks/HEADLINE_*.json.partial \
+                 benchmarks/REPRO_*.jsonl \
                  benchmarks/SERVE_*.json benchmarks/BENCH_*.json; do
   [ -f "$bench_doc" ] || continue
   echo "--- precision_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"

@@ -151,6 +151,12 @@ _SLOW_LANE = {
     "test_fused_dispatch_no_slower_65536_chains",
     # live-ops acceptance: trace-stamped vs off arms at 65536 chains
     "test_trace_stamp_overhead_65536_chains",
+    # scan-restructuring heavy geometries: the fast lane keeps the
+    # shared-site bit-identity / field-scale siblings at the same shape
+    "test_site_grid_identical_to_ulps",
+    "test_sharded_identical",
+    "test_mega_dispatch_identical",
+    "test_site_grid_stride60_field_scale",
 }
 
 

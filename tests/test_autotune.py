@@ -60,6 +60,8 @@ def fake_prober(monkeypatch):
     monkeypatch.setattr(autotune, "probe_plan", fake)
     monkeypatch.setattr(autotune, "CANDIDATE_COMPUTE_DTYPES", ("f32",))
     monkeypatch.setattr(autotune, "CANDIDATE_KERNEL_IMPLS", ("exact",))
+    monkeypatch.setattr(autotune, "CANDIDATE_RNG_BATCHES", ("scan",))
+    monkeypatch.setattr(autotune, "CANDIDATE_GEOM_STRIDES", (1,))
     return fake
 
 
@@ -273,6 +275,47 @@ class TestMeshPlan:
         assert n == 0 and plan.source == "static"
 
 
+class TestScanRestructureAxes:
+    """rng_batch / geom_stride join the sentinel-gated stage-2 grid."""
+
+    def test_stage2_grid_includes_new_axes(self):
+        cfg = small_config(tune="auto")
+        winner = autotune.static_plan(cfg)
+        variants = autotune._precision_variants(cfg, winner)
+        combos = {(v.rng_batch, v.geom_stride) for v in variants}
+        assert ("block", 1) in combos
+        assert ("scan", 60) in combos
+        assert ("block", 60) in combos
+
+    def test_pinned_axes_collapse_stage2(self):
+        cfg = small_config(tune="auto", rng_batch="block", geom_stride=60)
+        winner = autotune.static_plan(cfg)
+        assert winner.rng_batch == "block" and winner.geom_stride == 60
+        for v in autotune._precision_variants(cfg, winner):
+            assert v.rng_batch == "block" and v.geom_stride == 60
+
+    def test_static_plan_resolves_auto_to_defaults(self):
+        plan = autotune.static_plan(small_config())
+        assert plan.rng_batch == "scan" and plan.geom_stride == 1
+
+    def test_cached_plan_missing_axes_means_defaults(self, tmp_cache,
+                                                     fake_prober):
+        # a pre-v11 cache entry has no rng_batch/geom_stride keys: it
+        # must load unchanged as the in-scan / stride-1 defaults
+        cfg = small_config(tune="auto")
+        autotune.resolve_plan(cfg)
+        with open(tmp_cache) as f:
+            cache = json.load(f)
+        (key, entry), = cache.items()
+        entry["plan"].pop("rng_batch", None)
+        entry["plan"].pop("geom_stride", None)
+        with open(tmp_cache, "w") as f:
+            json.dump({key: entry}, f)
+        plan, n = probes_during(lambda: autotune.resolve_plan(cfg))
+        assert n == 0  # still a cache hit
+        assert plan.rng_batch == "scan" and plan.geom_stride == 1
+
+
 @pytest.mark.slow
 def test_real_probe_beats_or_matches_static(tmp_path, monkeypatch):
     """Acceptance: on CPU at 256 chains x 1080 s, tune='auto' picks a plan
@@ -284,6 +327,10 @@ def test_real_probe_beats_or_matches_static(tmp_path, monkeypatch):
                        str(tmp_path / "autotune.json"))
     monkeypatch.setattr(autotune, "CANDIDATE_UNROLLS", (1, 8))
     monkeypatch.setattr(autotune, "CANDIDATE_SLAB_CHAINS", (None,))
+    # the scan-restructuring axes have their own stage-2 coverage; keep
+    # this acceptance at the structural grid it was written for
+    monkeypatch.setattr(autotune, "CANDIDATE_RNG_BATCHES", ("scan",))
+    monkeypatch.setattr(autotune, "CANDIDATE_GEOM_STRIDES", (1,))
     cfg = SimConfig(start="2019-09-05 00:00:00", duration_s=1080 * 3,
                     n_chains=256, seed=0, block_s=1080, dtype="float32",
                     tune="auto")

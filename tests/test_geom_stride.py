@@ -1,0 +1,215 @@
+"""geom_stride (Plan.geom_stride): strided solar geometry + 1 Hz lerp.
+
+Accuracy strategy mirrors tests/test_solar.py: no pvlib — the oracle is
+the repo's own per-second chain evaluated in numpy float64, against
+which the stride-60 lerp must stay inside the published per-field
+bounds (models/solar.py STRIDE_MAX_ABS_ERR) over solstice/equinox days
+at equatorial, mid-latitude and polar sites.  End-to-end, a strided run
+must hold the field-scale 1e-5 reduce-stats contract vs stride=1, and
+``geom_stride=1`` must lower to byte-identical HLO (the lever is
+structurally absent at the default, not branched around).
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import Site, SimConfig, SiteGrid
+from tmhpvsim_tpu.engine import Simulation
+from tmhpvsim_tpu.models import solar
+
+# day starts (UTC) hitting both solstices and an equinox
+DAYS = [(2025, 3, 20), (2025, 6, 21), (2025, 12, 21)]
+
+# (name, latitude, longitude): the three geometry regimes — equatorial
+# fast azimuth swing, mid-latitude reference, polar low-sun/midnight-sun
+SITES = [
+    ("equatorial", 0.0, 11.6),
+    ("mid-latitude", 48.12, 11.6),
+    ("polar", 70.0, 20.0),
+]
+
+
+def epoch(*args):
+    return dt.datetime(*args, tzinfo=dt.timezone.utc).timestamp()
+
+
+def day_grid(date_args):
+    # the engine ships the true calendar day-of-year; the exact value
+    # only keys the Spencer/turbidity terms and both paths get the SAME
+    # one, so a constant UTC day index is fine for the oracle comparison
+    t0 = epoch(*date_args)
+    t = t0 + np.arange(0.0, 86400.0)
+    d = dt.datetime(*date_args, tzinfo=dt.timezone.utc).timetuple().tm_yday
+    return t, np.full_like(t, float(d))
+
+
+def site(lat, lon):
+    return Site(latitude=lat, longitude=lon, altitude=34.0,
+                surface_tilt=30.0, surface_azimuth=180.0)
+
+
+class TestOracleBounds:
+    @pytest.mark.parametrize("day", DAYS, ids=[f"{m:02d}-{d:02d}"
+                                               for _, m, d in DAYS])
+    @pytest.mark.parametrize("name,lat,lon", SITES,
+                             ids=[s[0] for s in SITES])
+    def test_stride60_inside_published_bounds(self, name, lat, lon, day):
+        t, doy = day_grid(day)
+        s = site(lat, lon)
+        oracle = solar.block_geometry(t, doy, s, xp=np)
+        strided = solar.strided_block_geometry(t, doy, s, 60, xp=np)
+        daytime = oracle["cos_zenith"] >= 0.01
+        if not daytime.any():  # polar winter: nothing the bound covers
+            pytest.skip("polar night — no daytime seconds")
+        for field, bound in solar.STRIDE_MAX_ABS_ERR.items():
+            err = np.abs(strided[field] - oracle[field])[daytime].max()
+            assert err <= bound, (field, err, bound)
+
+    def test_stride30_tighter_than_stride60(self):
+        t, doy = day_grid((2025, 6, 21))
+        s = site(48.12, 11.6)
+        oracle = solar.block_geometry(t, doy, s, xp=np)
+        s30 = solar.strided_block_geometry(t, doy, s, 30, xp=np)
+        daytime = oracle["cos_zenith"] >= 0.01
+        for field, bound in solar.STRIDE_MAX_ABS_ERR.items():
+            err = np.abs(s30[field] - oracle[field])[daytime].max()
+            assert err <= bound, (field, err, bound)
+
+    def test_stride1_is_block_geometry(self):
+        t, doy = day_grid((2025, 3, 20))
+        s = site(48.12, 11.6)
+        a = solar.block_geometry(t, doy, s, xp=np)
+        b = solar.strided_block_geometry(t, doy, s, 1, xp=np)
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]),
+                                          np.asarray(b[k]), err_msg=k)
+
+    def test_azimuth_held_not_lerped(self):
+        # azimuth wraps at 2pi: it must be the left sample, never a lerp
+        t, doy = day_grid((2025, 6, 21))
+        s = site(0.0, 11.6)  # equatorial: fastest azimuth swing
+        strided = solar.strided_block_geometry(t, doy, s, 60, xp=np)
+        samples = solar.block_geometry(
+            np.concatenate([t[::60], t[-1:] + 1.0]),
+            np.concatenate([doy[::60], doy[-1:]]), s, xp=np)
+        np.testing.assert_array_equal(
+            strided["azimuth"], samples["azimuth"][np.arange(86400) // 60])
+
+    def test_bad_stride_rejected(self):
+        t, doy = day_grid((2025, 3, 20))
+        s = site(48.12, 11.6)
+        with pytest.raises(ValueError, match="geom_stride"):
+            solar.strided_block_geometry(t, doy, s, 45, xp=np)
+        with pytest.raises(ValueError, match="multiple"):
+            solar.strided_block_geometry(t[:90], doy[:90], s, 60, xp=np)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: reduce-stats contract, both geometry paths
+# ---------------------------------------------------------------------------
+
+def cfg(**kw):
+    # 2 daylight blocks (08:00-12:48) keep the default lane fast; the
+    # slow lane (site grid here, the full year below) re-runs the
+    # contract at scale
+    base = dict(
+        start="2019-09-05 08:00:00",
+        duration_s=2 * 8640,
+        n_chains=4,
+        seed=7,
+        block_s=8640,
+        dtype="float32",
+        block_impl="scan2",
+        output="reduce",
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def grid():
+    return SiteGrid(
+        latitude=(0.0, 48.12, 52.5, 70.0),
+        longitude=(11.6, 11.6, 13.4, 20.0),
+        altitude=(10.0, 520.0, 34.0, 5.0),
+        surface_tilt=(10.0, 30.0, 35.0, 60.0),
+        surface_azimuth=(180.0, 180.0, 175.0, 180.0),
+    )
+
+
+def assert_field_scale_close(a: dict, b: dict, rtol=1e-5):
+    """Every statistic within ``rtol`` of the run's field scale — the
+    contract is relative to the magnitude of the quantity (mean |pv| or
+    the stat's own scale), not elementwise."""
+    assert set(a) == set(b)
+    for k in a:
+        x, y = np.asarray(a[k], np.float64), np.asarray(b[k], np.float64)
+        scale = max(np.abs(x).max(), np.abs(y).max(), 1.0)
+        assert np.abs(x - y).max() <= rtol * scale, (
+            k, np.abs(x - y).max(), scale)
+
+
+class TestEngineContract:
+    @pytest.mark.parametrize("impl", ["wide", "scan", "scan2"])
+    def test_shared_site_stride60_field_scale(self, impl):
+        base = Simulation(cfg(block_impl=impl)).run_reduced()
+        fast = Simulation(cfg(block_impl=impl,
+                              geom_stride=60)).run_reduced()
+        assert_field_scale_close(base, fast)
+
+    @pytest.mark.parametrize("impl", ["wide", "scan", "scan2"])
+    def test_site_grid_stride60_field_scale(self, impl):
+        base = Simulation(cfg(block_impl=impl,
+                              site_grid=grid())).run_reduced()
+        fast = Simulation(cfg(block_impl=impl, site_grid=grid(),
+                              geom_stride=60)).run_reduced()
+        assert_field_scale_close(base, fast)
+
+    def test_composes_with_rng_block(self):
+        base = Simulation(cfg()).run_reduced()
+        fast = Simulation(cfg(geom_stride=60,
+                              rng_batch="block")).run_reduced()
+        assert_field_scale_close(base, fast)
+
+    def test_bad_value_rejected(self):
+        with pytest.raises(ValueError, match="geom_stride"):
+            Simulation(cfg(geom_stride=45))
+
+    def test_plan_carries_resolved_axis(self):
+        assert Simulation(cfg()).plan.geom_stride == 1
+        assert Simulation(cfg(geom_stride=60)).plan.geom_stride == 60
+
+    def test_precision_doc_carries_axis(self):
+        doc = Simulation(cfg(geom_stride=60)).precision_doc()
+        assert doc is not None and doc["geom_stride"] == 60
+
+
+@pytest.mark.slow
+class TestFullYearContract:
+    def test_stride60_field_scale_over_a_year(self):
+        """The acceptance contract: a full simulated year of strided
+        geometry stays within field-scale 1e-5 of the per-second run on
+        every reduce statistic (errors are bounded per second and
+        uncorrelated across stride windows, so the year-long
+        accumulation is where a systematic bias would surface)."""
+        year = dict(duration_s=365 * 86400, n_chains=2, block_s=86400)
+        base = Simulation(cfg(**year)).run_reduced()
+        fast = Simulation(cfg(geom_stride=60, **year)).run_reduced()
+        assert_field_scale_close(base, fast)
+
+
+class TestDefaultHLOIdentity:
+    @pytest.mark.parametrize("impl", ["scan", "scan2"])
+    def test_stride1_lowers_byte_identical_to_default(self, impl):
+        default = Simulation(cfg(block_impl=impl, n_chains=4,
+                                 site_grid=grid()))
+        explicit = Simulation(cfg(block_impl=impl, n_chains=4,
+                                  site_grid=grid(), geom_stride=1))
+        state = default.init_state()
+        acc = default.init_reduce_acc()
+        inputs, _ = default.host_inputs(0)
+        jit = f"_{impl}_acc_jit"
+        a = getattr(default, jit).lower(state, inputs, acc).as_text()
+        b = getattr(explicit, jit).lower(state, inputs, acc).as_text()
+        assert a == b

@@ -1,0 +1,246 @@
+"""rng_batch='block' (Plan.rng_batch): whole-block RNG pre-generation.
+
+The lever hoists every per-minute second-noise draw out of the scan
+body into batched counter-mode tensors generated before the scan.  The
+keying is IDENTICAL to the in-scan path (``fold_in(key, minute)`` per
+minute group, models/clearsky_index.py), so the contract is bit
+identity — not statistical closeness — on every block implementation,
+under sharding, under mega-dispatch, and across a checkpoint resume.
+The default plan must also lower to byte-identical HLO: the lever is
+structurally absent when off, not branched around.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig, SiteGrid
+from tmhpvsim_tpu.engine import Simulation, checkpoint as ckpt
+from tmhpvsim_tpu.models import clearsky_index as ci
+from tmhpvsim_tpu.parallel import ShardedSimulation
+
+IMPLS = ["wide", "scan", "scan2"]
+
+
+def cfg(**kw):
+    # 2 small blocks: enough for the merge/resume/mega-dispatch paths
+    # while keeping the default lane fast; the slow lane re-runs the
+    # heavy geometries (site grid, sharded) at the same shape
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=3600,
+        n_chains=4,
+        seed=7,
+        block_s=1800,
+        dtype="float32",
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def grid(n=4):
+    # equatorial, mid-latitude x2 and polar sites: exercises every
+    # geometry regime the per-chain device path sees
+    return SiteGrid(
+        latitude=(0.0, 48.12, 52.5, 70.0),
+        longitude=(11.6, 11.6, 13.4, 20.0),
+        altitude=(10.0, 520.0, 34.0, 5.0),
+        surface_tilt=(10.0, 30.0, 35.0, 60.0),
+        surface_azimuth=(180.0, 180.0, 175.0, 180.0),
+    )
+
+
+def assert_stats_identical(a: dict, b: dict):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# bit identity: block vs scan on every impl, shared site and site grid
+# ---------------------------------------------------------------------------
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_reduce_stats_identical(self, impl):
+        base = Simulation(cfg(block_impl=impl)).run_reduced()
+        hoist = Simulation(cfg(block_impl=impl,
+                               rng_batch="block")).run_reduced()
+        assert_stats_identical(base, hoist)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_site_grid_identical_to_ulps(self, impl):
+        """Site-grid runs evaluate the transcendental geometry chain
+        INSIDE the jitted step, and the hoist changes the program around
+        it (xs grows the stream rows), so XLA's instruction selection
+        (fusion / FMA contraction) over that chain may differ by a few
+        f32 ULPs — the same measured caveat as sharded-vs-single layout
+        changes (test_parallel.py).  The RNG streams themselves stay bit
+        identical (``test_block_draws_match_in_scan_draws``); the
+        whole-run statistics must agree to a handful of ULPs."""
+        base = Simulation(cfg(block_impl=impl,
+                              site_grid=grid())).run_reduced()
+        hoist = Simulation(cfg(block_impl=impl, site_grid=grid(),
+                               rng_batch="block")).run_reduced()
+        assert set(base) == set(hoist)
+        for k in base:
+            x = np.asarray(base[k])
+            y = np.asarray(hoist[k])
+            if np.issubdtype(x.dtype, np.integer):
+                np.testing.assert_array_equal(x, y, err_msg=k)
+            else:
+                np.testing.assert_allclose(x, y, rtol=1e-6, atol=1e-3,
+                                           err_msg=k)
+
+    def test_block_draws_match_in_scan_draws(self):
+        # the public hoist wrapper must reproduce the in-scan draws
+        # exactly — the unit-level statement of the keying contract
+        key = jax.random.key(3, impl="threefry2x32")
+        t = np.arange(123_456_060, 123_456_060 + 3600, dtype=np.int64)
+        u1, z1 = ci.block_draws(key, t)
+        u2, z2 = ci._minute_grouped_draws(key, t, np.float32)
+        np.testing.assert_array_equal(np.asarray(u1), np.asarray(u2))
+        np.testing.assert_array_equal(np.asarray(z1), np.asarray(z2))
+
+    def test_sharded_identical(self):
+        base = ShardedSimulation(cfg(block_impl="scan2",
+                                     n_chains=8)).run_reduced()
+        hoist = ShardedSimulation(cfg(block_impl="scan2", n_chains=8,
+                                      rng_batch="block")).run_reduced()
+        assert_stats_identical(base, hoist)
+
+    @pytest.mark.parametrize("impl", ["scan", "scan2"])
+    def test_mega_dispatch_identical(self, impl):
+        # pre-generation happens per inner block inside the mega scan
+        # body, so K-block dispatches stay bit-identical too (and HBM
+        # stays bounded at one block's streams)
+        base = Simulation(cfg(block_impl=impl,
+                              blocks_per_dispatch=2)).run_reduced()
+        hoist = Simulation(cfg(block_impl=impl, blocks_per_dispatch=2,
+                               rng_batch="block")).run_reduced()
+        assert_stats_identical(base, hoist)
+
+    def test_checkpoint_resume_identical(self, tmp_path):
+        """Stop after block 0 under rng_batch='scan', resume under
+        rng_batch='block': the finished run must match an uninterrupted
+        in-scan run bit for bit — the hoist changes no key material, so
+        it can even be toggled across a restart."""
+        straight = Simulation(cfg(block_impl="scan2")).run_reduced()
+
+        path = str(tmp_path / "r.npz")
+        a = Simulation(cfg(block_impl="scan2"))
+
+        class Stop(Exception):
+            pass
+
+        def save_then_crash(bi, state, acc):
+            ckpt.save(path, {"state": state, "acc": acc}, bi + 1, a.config)
+            raise Stop
+
+        with pytest.raises(Stop):
+            a.run_reduced(on_block=save_then_crash)
+
+        b = Simulation(cfg(block_impl="scan2", rng_batch="block"))
+        tree, nb = ckpt.load(path, b.config)
+        assert nb == 1
+        resumed = b.run_reduced(state=tree["state"], acc=tree["acc"],
+                                start_block=nb)
+        assert_stats_identical(resumed, straight)
+
+
+# ---------------------------------------------------------------------------
+# defaults: the lever off must be structurally absent, not branched away
+# ---------------------------------------------------------------------------
+
+class TestDefaultHLOIdentity:
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_explicit_scan_lowers_byte_identical_to_default(self, impl):
+        default = Simulation(cfg(block_impl=impl, n_chains=4))
+        explicit = Simulation(cfg(block_impl=impl, n_chains=4,
+                                  rng_batch="scan", geom_stride=1))
+        state = default.init_state()
+        acc = default.init_reduce_acc()
+        inputs, _ = default.host_inputs(0)
+        if impl == "wide":
+            a = default._block_jit.lower(state, inputs).as_text()
+            b = explicit._block_jit.lower(state, inputs).as_text()
+        else:
+            jit = f"_{impl}_acc_jit"
+            a = getattr(default, jit).lower(state, inputs, acc).as_text()
+            b = getattr(explicit, jit).lower(state, inputs, acc).as_text()
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# plan plumbing
+# ---------------------------------------------------------------------------
+
+class TestPlanPlumbing:
+    def test_plan_carries_resolved_axis(self):
+        assert Simulation(cfg()).plan.rng_batch == "scan"
+        sim = Simulation(cfg(rng_batch="block"))
+        assert sim.plan.rng_batch == "block"
+
+    def test_bad_value_rejected(self):
+        with pytest.raises(ValueError, match="rng_batch"):
+            Simulation(cfg(rng_batch="hoist"))
+
+    def test_precision_doc_carries_axis(self):
+        sim = Simulation(cfg(rng_batch="block"))
+        doc = sim.precision_doc()
+        assert doc is not None and doc["rng_batch"] == "block"
+        assert Simulation(cfg()).precision_doc() is None
+
+
+# ---------------------------------------------------------------------------
+# acceptance (slow lane): at least one lever beats baseline scan2 at the
+# headline chain count, and neither regresses
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_scan_restructure_speedup_65536_chains():
+    """At the headline chain count on CPU, rng_batch='block' or
+    geom_stride=60 must run STRICTLY faster than the baseline scan2
+    arm, and whichever doesn't win must not regress (25% slack for
+    timer noise on the shared host — same budget as the fused-dispatch
+    acceptance in test_executor.py).  All arms are timed on their
+    second, compile-free run."""
+    import time
+
+    def timed_second_run(**kw):
+        sim = Simulation(cfg(output="reduce", block_impl="scan2",
+                             n_chains=65536, duration_s=1800,
+                             block_s=600, **kw))
+        sim.run_reduced()              # compile + first dispatch
+        t0 = time.perf_counter()
+        sim.run_reduced()
+        return time.perf_counter() - t0
+
+    base = timed_second_run()
+    rngblock = timed_second_run(rng_batch="block")
+    stride60 = timed_second_run(geom_stride=60)
+    assert rngblock < base or stride60 < base, (base, rngblock, stride60)
+    assert rngblock <= base * 1.25, (rngblock, base)
+    assert stride60 <= base * 1.25, (stride60, base)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the rbg 76x trap must warn at build time (raise under strict)
+# ---------------------------------------------------------------------------
+
+class TestRbgTrap:
+    def test_rbg_warns_at_build(self):
+        with pytest.warns(RuntimeWarning, match="76x"):
+            Simulation(cfg(prng_impl="rbg"))
+
+    def test_rbg_raises_under_strict(self):
+        with pytest.raises(ValueError, match="rbg"):
+            Simulation(cfg(prng_impl="rbg", telemetry="light",
+                           telemetry_strict=True))
+
+    def test_threefry_is_silent(self, recwarn):
+        Simulation(cfg())
+        assert not [w for w in recwarn.list
+                    if issubclass(w.category, RuntimeWarning)
+                    and "76x" in str(w.message)]

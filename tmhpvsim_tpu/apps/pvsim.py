@@ -428,6 +428,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               blocks_per_dispatch: int = 0,
               compute_dtype: str = "auto",
               kernel_impl: str = "auto",
+              rng_batch: str = "auto",
+              geom_stride: int = 0,
               output_overlap: str = "auto",
               checkpoint_keep: int = 3,
               checkpoint_async: str = "off",
@@ -486,7 +488,14 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
     ('auto'|'exact'|'table') select the mixed-precision compute path and
     the tabulated transcendental kernels (models/tables.py); bf16
     auto-escalates ``telemetry='off'`` to 'light' so the drift sentinel
-    watches the run.  ``output_overlap`` ('auto'|'off') double-buffers
+    watches the run.  ``rng_batch`` ('auto'|'scan'|'block') hoists the
+    scan body's per-minute noise draws into whole-block counter-mode
+    tensors generated before the scan (bit-identical by construction —
+    same ``fold_in`` keying); ``geom_stride`` (0=auto|1|30|60)
+    evaluates solar geometry every s seconds and lerps the trig-free
+    quantities back to 1 Hz (error bound published in
+    models/solar.py:STRIDE_MAX_ABS_ERR).  ``output_overlap``
+    ('auto'|'off') double-buffers
     the trace/ensemble host gather against the next block's dispatch;
     checkpointed runs force it off (the checkpoint writer gates on
     ``state_block``, which pipelining breaks by design).
@@ -539,6 +548,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 trace=trace, tracer=tracer, compile_cache=compile_cache,
                 blocks_per_dispatch=blocks_per_dispatch,
                 compute_dtype=compute_dtype, kernel_impl=kernel_impl,
+                rng_batch=rng_batch, geom_stride=geom_stride,
                 output_overlap=output_overlap,
                 checkpoint_keep=checkpoint_keep,
                 checkpoint_async=checkpoint_async,
@@ -581,6 +591,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             block_impl=plan.block_impl,
             compute_dtype=getattr(plan, "compute_dtype", None),
             kernel_impl=getattr(plan, "kernel_impl", None),
+            rng_batch=getattr(plan, "rng_batch", None),
+            geom_stride=getattr(plan, "geom_stride", None),
             device_kind=jax.devices()[0].device_kind,
         )
     if getattr(sim, "sentinel", None) is not None:
@@ -626,6 +638,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    blocks_per_dispatch: int = 0,
                    compute_dtype: str = "auto",
                    kernel_impl: str = "auto",
+                   rng_batch: str = "auto",
+                   geom_stride: int = 0,
                    output_overlap: str = "auto",
                    checkpoint_keep: int = 3,
                    checkpoint_async: str = "off",
@@ -722,6 +736,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         blocks_per_dispatch=blocks_per_dispatch,
         compute_dtype=compute_dtype,
         kernel_impl=kernel_impl,
+        rng_batch=rng_batch,
+        geom_stride=geom_stride,
         output_overlap=output_overlap,
         checkpoint_keep=checkpoint_keep,
         checkpoint_async=checkpoint_async,
@@ -738,11 +754,13 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
     logger.info(
         "plan [%s]: block_impl=%s scan_unroll=%d stats_fusion=%s "
         "slab_chains=%d blocks_per_dispatch=%d compute_dtype=%s "
-        "kernel_impl=%s", plan.source,
+        "kernel_impl=%s rng_batch=%s geom_stride=%d", plan.source,
         plan.block_impl, plan.scan_unroll, plan.stats_fusion,
         plan.slab_chains, plan.blocks_per_dispatch,
         getattr(plan, "compute_dtype", "f32"),
         getattr(plan, "kernel_impl", "exact"),
+        getattr(plan, "rng_batch", "scan"),
+        getattr(plan, "geom_stride", 1),
     )
 
     # Live-ops cost attribution (obs/cost.py): per-block device.cost.*
@@ -764,6 +782,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
             block_impl=plan.block_impl,
             compute_dtype=getattr(plan, "compute_dtype", None),
             kernel_impl=getattr(plan, "kernel_impl", None),
+            rng_batch=getattr(plan, "rng_batch", None),
+            geom_stride=getattr(plan, "geom_stride", None),
             device_kind=device_kind))
 
     if checkpoint and plan.slab_chains < cfg.n_chains:

@@ -332,6 +332,25 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "table = minimax polynomials + day-of-year LUT, "
                    "validated to published ULP bounds "
                    "(config.SimConfig.kernel_impl, models/tables.py)")
+@click.option("--rng-batch", "rng_batch",
+              type=click.Choice(["auto", "scan", "block"]),
+              default="auto",
+              help="Second-noise RNG generation (jax backend): scan = "
+                   "draw per minute inside the scan body; block = hoist "
+                   "every draw into whole-block counter-mode tensors "
+                   "generated before the scan — bit-identical by "
+                   "construction (same fold_in keying), asserted in "
+                   "tests; auto lets the autotuner probe "
+                   "(config.SimConfig.rng_batch)")
+@click.option("--geom-stride", "geom_stride",
+              type=click.Choice(["0", "1", "30", "60"]),
+              default="0",
+              help="Solar-geometry stride seconds (jax backend): evaluate "
+                   "the transcendental geometry chain every S seconds and "
+                   "lerp trig-free quantities back to 1 Hz; error bound "
+                   "published in models/solar.py:STRIDE_MAX_ABS_ERR; "
+                   "1 = byte-identical HLO, 0 = auto "
+                   "(config.SimConfig.geom_stride)")
 @click.option("--output-overlap", "output_overlap",
               type=click.Choice(["auto", "off"]),
               default="auto",
@@ -373,7 +392,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           block_s, site_grid_spec, sites_csv, profile_dir, output,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
           analytics, metrics_path, run_report_path, compile_cache,
-          blocks_per_dispatch, compute_dtype, kernel_impl, output_overlap,
+          blocks_per_dispatch, compute_dtype, kernel_impl, rng_batch,
+          geom_stride, output_overlap,
           checkpoint_keep, checkpoint_async, preempt_grace,
           supervise, obs_port, chaos, chaos_seed):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
@@ -410,6 +430,10 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--compute-dtype requires --backend=jax")
     if kernel_impl != "auto" and backend != "jax":
         raise click.UsageError("--kernel-impl requires --backend=jax")
+    if rng_batch != "auto" and backend != "jax":
+        raise click.UsageError("--rng-batch requires --backend=jax")
+    if geom_stride != "0" and backend != "jax":
+        raise click.UsageError("--geom-stride requires --backend=jax")
     if output_overlap != "auto" and backend != "jax":
         raise click.UsageError("--output-overlap requires --backend=jax")
     if checkpoint_keep != 3 and backend != "jax":
@@ -465,6 +489,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   trace=trace, compile_cache=compile_cache,
                   blocks_per_dispatch=blocks_per_dispatch,
                   compute_dtype=compute_dtype, kernel_impl=kernel_impl,
+                  rng_batch=rng_batch, geom_stride=int(geom_stride),
                   output_overlap=output_overlap,
                   checkpoint_keep=checkpoint_keep,
                   checkpoint_async=checkpoint_async,

@@ -280,6 +280,29 @@ class Plan:
     #: max-ULP bounds and to 1e-5 on end-of-run reduce stats).  Same
     #: sentinel gate as ``compute_dtype`` under the autotuner.
     kernel_impl: str = "exact"
+    #: resolved RNG batching strategy for the scan-family block steps:
+    #: 'scan' (the historical behaviour — byte-identical HLO: the flat
+    #: scan pre-draws per-block streams, scan2 hashes one minute tile
+    #: per outer step, wide hashes inside the producer) | 'block' (ALL
+    #: of a block's second-noise draws are generated as one batched
+    #: counter-mode tensor BEFORE the scan — same ``fold_in``
+    #: global-minute keying, so every value is bit-identical to 'scan'
+    #: (tested in tests/test_rng_batch.py) and the scan body reduces to
+    #: a gather; the mega-dispatch path pre-generates per inner block
+    #: inside the outer scan body to bound HBM at one block's streams).
+    #: Same sentinel gate as ``compute_dtype`` under the autotuner.
+    rng_batch: str = "scan"
+    #: resolved solar-geometry evaluation stride in seconds: 1 (the
+    #: historical per-second evaluation — byte-identical HLO) | 30 | 60
+    #: (the PSA solar-position/geometry chain is evaluated on a
+    #: stride-s grid and the trig-free fields — cos_zenith, cos_aoi,
+    #: clear-sky irradiance terms — are linearly interpolated to 1 Hz;
+    #: error vs the per-second float64 oracle is bounded by
+    #: models/solar.py STRIDE_MAX_ABS_ERR and the end-of-run reduce
+    #: stats hold the field-scale 1e-5 contract, tests/test_geom_stride
+    #: .py).  Same sentinel gate as ``compute_dtype`` under the
+    #: autotuner.
+    geom_stride: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -433,6 +456,33 @@ class SimConfig:
     #: against the f64 golden to published max-ULP bounds and to 1e-5 on
     #: end-of-run reduce stats (tests/test_precision.py).
     kernel_impl: str = "auto"
+
+    #: RNG batching strategy for the scan-family block steps.  'auto'
+    #: resolves to 'scan' (the historical behaviour, byte-identical
+    #: HLO) unless the autotuner's sentinel-gated probe selects
+    #: 'block'; 'scan'/'block' pin it.  'block' hoists ALL of a
+    #: block's second-noise draws (csi u/z and the meter stream) into
+    #: batched counter-mode tensors generated before the scan — the
+    #: per-second body becomes a pure gather.  Keying is the same
+    #: ``fold_in`` global-minute scheme, so the simulation is
+    #: BIT-identical to 'scan' on every impl, sharded and
+    #: mega-dispatched included (tests/test_rng_batch.py); the choice
+    #: is purely a loop-structure/perf decision (ROADMAP item 3: batch
+    #: random generation outside the sequential loop).
+    rng_batch: str = "auto"
+
+    #: solar-geometry evaluation stride in seconds.  0 = auto: resolves
+    #: to 1 (per-second evaluation, byte-identical HLO) unless the
+    #: autotuner's sentinel-gated probe selects a coarser stride.
+    #: Explicit 1/30/60 pin it: the PSA solar-position solve changes by
+    #: <0.01° between adjacent seconds, so geometry is evaluated every
+    #: ``geom_stride`` seconds and the trig-free fields are linearly
+    #: interpolated to 1 Hz (models/solar.py ``strided_geometry``;
+    #: published float64-oracle bound STRIDE_MAX_ABS_ERR, field-scale
+    #: 1e-5 reduce-stats contract over a simulated year —
+    #: tests/test_geom_stride.py).  ``block_s`` must be a multiple of
+    #: the stride (it already is: both divide 60).
+    geom_stride: int = 0
 
     #: double-buffered host output for the trace/blocks loop
     #: (engine/simulation.py ``_iter_blocks``): 'auto' overlaps device

@@ -66,6 +66,14 @@ CANDIDATE_BLOCKS_PER_DISPATCH = (1, 4)
 #: strict-telemetry gate run (exact/f32 is never silently replaced).
 CANDIDATE_COMPUTE_DTYPES = ("f32", "bf16")
 CANDIDATE_KERNEL_IMPLS = ("exact", "table")
+#: scan-restructuring axes (config.Plan ``rng_batch`` / ``geom_stride``),
+#: probed in the same sentinel-gated stage 2 as the precision axes:
+#: whole-block RNG pre-generation is bit-identical by construction but
+#: still rides the gate (a candidate that cannot complete the gate run
+#: must not win); strided geometry is an approximation and the gate is
+#: its runtime drift check on top of the published static bound.
+CANDIDATE_RNG_BATCHES = ("scan", "block")
+CANDIDATE_GEOM_STRIDES = (1, 60)
 
 #: chains/blocks of the sentinel gate run (small: it pays a compile)
 SENTINEL_GATE_CHAINS = 4096
@@ -156,6 +164,28 @@ def _resolve_kernel_impl(config: SimConfig) -> str:
     )
 
 
+def _resolve_rng_batch(config: SimConfig) -> str:
+    rb = getattr(config, "rng_batch", "auto")
+    if rb == "auto":
+        return "scan"  # the tuner's staged probe may still pick 'block'
+    if rb in ("scan", "block"):
+        return rb
+    raise ValueError(
+        f"rng_batch must be 'auto', 'scan' or 'block', got {rb!r}"
+    )
+
+
+def _resolve_geom_stride(config: SimConfig) -> int:
+    gs = int(getattr(config, "geom_stride", 0))
+    if gs == 0:
+        return 1  # auto: the tuner's staged probe may still pick coarser
+    if gs in (1, 30, 60):
+        return gs
+    raise ValueError(
+        f"geom_stride must be 0 (auto), 1, 30 or 60, got {gs!r}"
+    )
+
+
 def _escalate_telemetry(level: str, compute_dtype: str) -> str:
     """bf16 must never run unwatched: an 'off' telemetry request
     escalates to 'light' whenever the mixed-precision path is active, so
@@ -184,6 +214,8 @@ def static_plan(config: SimConfig) -> Plan:
         blocks_per_dispatch=max(1, config.blocks_per_dispatch),
         compute_dtype=cdt,
         kernel_impl=_resolve_kernel_impl(config),
+        rng_batch=_resolve_rng_batch(config),
+        geom_stride=_resolve_geom_stride(config),
     )
 
 
@@ -320,12 +352,15 @@ def candidate_plans(config: SimConfig, slabs: bool = True) -> list:
     # winner only (probe_grid), not as a 4x product blow-up here
     cdt = _resolve_compute_dtype(config)
     ki = _resolve_kernel_impl(config)
+    rb = _resolve_rng_batch(config)
+    gs = _resolve_geom_stride(config)
     telemetry = _escalate_telemetry(_resolve_telemetry(config), cdt)
     return [
         Plan(block_impl=impl, scan_unroll=u, stats_fusion=fusion,
              slab_chains=slab, source="probe", telemetry=telemetry,
              analytics=analytics, blocks_per_dispatch=kd,
-             compute_dtype=cdt, kernel_impl=ki)
+             compute_dtype=cdt, kernel_impl=ki,
+             rng_batch=rb, geom_stride=gs)
         for impl in impls
         for u in CANDIDATE_UNROLLS
         for slab in slab_sizes
@@ -342,6 +377,8 @@ def _candidate_record(plan: Plan) -> dict:
         "blocks_per_dispatch": plan.blocks_per_dispatch,
         "compute_dtype": plan.compute_dtype,
         "kernel_impl": plan.kernel_impl,
+        "rng_batch": plan.rng_batch,
+        "geom_stride": plan.geom_stride,
     }
 
 
@@ -395,22 +432,37 @@ def _sentinel_gate(config: SimConfig, plan: Plan) -> bool:
 
 def _precision_variants(config: SimConfig, winner: Plan) -> list:
     """Stage-2 candidates: the structural winner with each non-default
-    precision combination the config leaves to the tuner ('auto' axes
-    only — an explicit pin is respected like a pinned block_impl)."""
+    combination of the sentinel-gated axes — precision
+    (``compute_dtype``/``kernel_impl``) and scan restructuring
+    (``rng_batch``/``geom_stride``) — that the config leaves to the
+    tuner ('auto' axes only — an explicit pin is respected like a
+    pinned block_impl)."""
     cdts = (CANDIDATE_COMPUTE_DTYPES
             if getattr(config, "compute_dtype", "auto") == "auto"
             else (winner.compute_dtype,))
     kis = (CANDIDATE_KERNEL_IMPLS
            if getattr(config, "kernel_impl", "auto") == "auto"
            else (winner.kernel_impl,))
+    rbs = (CANDIDATE_RNG_BATCHES
+           if getattr(config, "rng_batch", "auto") == "auto"
+           else (winner.rng_batch,))
+    gss = (CANDIDATE_GEOM_STRIDES
+           if int(getattr(config, "geom_stride", 0)) == 0
+           else (winner.geom_stride,))
+    base = (winner.compute_dtype, winner.kernel_impl,
+            winner.rng_batch, winner.geom_stride)
     out = []
     for cdt in cdts:
         for ki in kis:
-            if (cdt, ki) == (winner.compute_dtype, winner.kernel_impl):
-                continue
-            out.append(dataclasses.replace(
-                winner, compute_dtype=cdt, kernel_impl=ki,
-                telemetry=_escalate_telemetry(winner.telemetry, cdt)))
+            for rb in rbs:
+                for gs in gss:
+                    if (cdt, ki, rb, gs) == base:
+                        continue
+                    out.append(dataclasses.replace(
+                        winner, compute_dtype=cdt, kernel_impl=ki,
+                        rng_batch=rb, geom_stride=gs,
+                        telemetry=_escalate_telemetry(winner.telemetry,
+                                                      cdt)))
     return out
 
 
@@ -529,13 +581,19 @@ def _plan_from_entry(entry: dict) -> Plan:
         # meaning the historical exact/f32 path
         compute_dtype=str(p.get("compute_dtype", "f32")),
         kernel_impl=str(p.get("kernel_impl", "exact")),
+        # entries persisted before the scan-restructuring axes existed
+        # keep meaning the historical per-minute-hash / per-second path
+        rng_batch=str(p.get("rng_batch", "scan")),
+        geom_stride=int(p.get("geom_stride", 1)),
     )
     if plan.block_impl not in ("wide", "scan", "scan2") or \
             plan.stats_fusion not in ("fused", "split") or \
             plan.scan_unroll < 1 or plan.slab_chains < 1 or \
             plan.blocks_per_dispatch < 1 or \
             plan.compute_dtype not in ("f32", "bf16") or \
-            plan.kernel_impl not in ("exact", "table"):
+            plan.kernel_impl not in ("exact", "table") or \
+            plan.rng_batch not in ("scan", "block") or \
+            plan.geom_stride not in (1, 30, 60):
         raise ValueError(f"malformed cached plan {p!r}")
     return plan
 
@@ -555,6 +613,8 @@ def _store_plan(path: str, key: str, plan: Plan, candidates: list) -> None:
                 "blocks_per_dispatch": plan.blocks_per_dispatch,
                 "compute_dtype": plan.compute_dtype,
                 "kernel_impl": plan.kernel_impl,
+                "rng_batch": plan.rng_batch,
+                "geom_stride": plan.geom_stride,
             },
             "candidates": candidates,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -568,6 +628,8 @@ def _store_plan(path: str, key: str, plan: Plan, candidates: list) -> None:
                               1) == plan.blocks_per_dispatch
                     and c.get("compute_dtype", "f32") == plan.compute_dtype
                     and c.get("kernel_impl", "exact") == plan.kernel_impl
+                    and c.get("rng_batch", "scan") == plan.rng_batch
+                    and c.get("geom_stride", 1) == plan.geom_stride
                     and c.get("compile_s") is not None):
                 entry["compile_s"] = c["compile_s"]
                 break
@@ -638,6 +700,12 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
                 if getattr(config, "kernel_impl", "auto") != "auto":
                     plan = dataclasses.replace(
                         plan, kernel_impl=_resolve_kernel_impl(config))
+                if getattr(config, "rng_batch", "auto") != "auto":
+                    plan = dataclasses.replace(
+                        plan, rng_batch=_resolve_rng_batch(config))
+                if int(getattr(config, "geom_stride", 0)) != 0:
+                    plan = dataclasses.replace(
+                        plan, geom_stride=_resolve_geom_stride(config))
                 # telemetry escalation must see the FINAL compute_dtype
                 # (a cached bf16 winner escalates an 'off' request too)
                 return dataclasses.replace(
@@ -673,11 +741,14 @@ def broadcast_plan(plan: Plan) -> Plan:
     fusions = ("split", "fused")
     dtypes = ("f32", "bf16")
     kimpls = ("exact", "table")
+    rbs = ("scan", "block")
     enc = np.asarray([
         impls.index(plan.block_impl), plan.scan_unroll,
         plan.slab_chains, fusions.index(plan.stats_fusion),
         plan.blocks_per_dispatch,
         dtypes.index(plan.compute_dtype), kimpls.index(plan.kernel_impl),
+        rbs.index(getattr(plan, "rng_batch", "scan")),
+        int(getattr(plan, "geom_stride", 1)),
     ], dtype=np.int32)
     out = np.asarray(multihost_utils.broadcast_one_to_all(enc))
     source = plan.source if jax.process_index() == 0 else "broadcast"
@@ -695,6 +766,8 @@ def broadcast_plan(plan: Plan) -> Plan:
         blocks_per_dispatch=int(out[4]),
         compute_dtype=dtypes[int(out[5])],
         kernel_impl=kimpls[int(out[6])],
+        rng_batch=rbs[int(out[7])],
+        geom_stride=int(out[8]),
     )
 
 

@@ -241,6 +241,40 @@ class Simulation:
         self._kernels = (_tables.table_kernels(jnp)
                          if getattr(self.plan, "kernel_impl",
                                     "exact") == "table" else None)
+        #: whole-block RNG pre-generation (Plan.rng_batch): 'block'
+        #: hoists every second-noise draw out of the scan body into
+        #: batched counter-mode tensors generated before the scan —
+        #: same fold_in keying, bit-identical values
+        #: (tests/test_rng_batch.py); 'scan' leaves every block impl's
+        #: historical graph byte-identical.  getattr: plans rebuilt
+        #: from pre-v11 autotune cache entries predate the field.
+        self._rng_batch = getattr(self.plan, "rng_batch", "scan")
+        #: strided solar geometry (Plan.geom_stride): evaluate the
+        #: transcendental chain every s seconds and lerp the trig-free
+        #: fields to 1 Hz (solar.STRIDE_LERP_FIELDS, published bound
+        #: solar.STRIDE_MAX_ABS_ERR); 1 is byte-identical HLO.
+        self._geom_stride = int(getattr(self.plan, "geom_stride", 1))
+        if self._geom_stride > 1 and config.block_s % self._geom_stride:
+            raise ValueError(
+                f"geom_stride {self._geom_stride} must divide "
+                f"block_s {config.block_s}")
+        # rbg trap (benchmarks/PERF_ANALYSIS.md §7a): rbg/unsafe_rbg
+        # keys serialize the vmapped per-chain draws on current TPU
+        # backends — a measured ~76x block-step regression vs threefry.
+        # Warn loudly at build time; refuse under the strict gate.
+        if config.prng_impl in ("rbg", "unsafe_rbg"):
+            _msg = (
+                f"prng_impl={config.prng_impl!r}: rbg keys serialize the "
+                "vmapped per-chain draws on current TPU backends (~76x "
+                "slower block steps than threefry2x32, "
+                "benchmarks/PERF_ANALYSIS.md §7a); use threefry2x32 "
+                "unless you are measuring the trap itself"
+            )
+            if getattr(config, "telemetry_strict", False):
+                raise ValueError(_msg)
+            import warnings
+
+            warnings.warn(_msg, RuntimeWarning, stacklevel=2)
         #: double-buffered trace output (_iter_blocks): overlap the host
         #: gather of block N with device dispatch of block N+1
         ov = getattr(config, "output_overlap", "auto")
@@ -584,10 +618,18 @@ class Simulation:
             # whose integer-day semantics feed the Spencer term/LUT and
             # must survive exactly) so the physics chain's type promotion
             # stays in the compute dtype instead of silently widening.
-            geom64 = solar.block_geometry(
-                blk.epoch.astype(np.float64), blk.doy.astype(np.float64),
-                cfg.site, xp=np,
-            )
+            # geom_stride>1 swaps in the stride-sampled + lerped float64
+            # evaluation (solar.strided_block_geometry) — a pure
+            # host-time lever here: the shipped dict has the same shapes
+            # and dtypes, so the device graph is untouched.
+            ep64 = blk.epoch.astype(np.float64)
+            doy64 = blk.doy.astype(np.float64)
+            if self._geom_stride > 1:
+                geom64 = solar.strided_block_geometry(
+                    ep64, doy64, cfg.site, self._geom_stride, xp=np,
+                )
+            else:
+                geom64 = solar.block_geometry(ep64, doy64, cfg.site, xp=np)
             inputs["geom"] = {
                 k: (np.asarray(v, self.dtype if k == "doy"
                                else self._compute_dtype)
@@ -603,6 +645,29 @@ class Simulation:
                 "sec_of_day": np.asarray(blk.epoch % 86400, self.dtype),
                 "doy": np.asarray(blk.doy, self.dtype),
             }
+            if self._geom_stride > 1:
+                # stride-sampled split time (T//s + 1 rows) for the
+                # device-side sample-outside-the-scan evaluation, plus
+                # the per-second (sample index, fraction) lerp features.
+                # The endpoint row is the exact next second after the
+                # block (epoch arithmetic is exact in int64); its doy is
+                # clamped to the block's last second — see
+                # solar.strided_block_geometry on why that seam is
+                # inside the published bounds.
+                s = self._geom_stride
+                ep_s = np.concatenate([blk.epoch[::s], blk.epoch[-1:] + 1])
+                doy_s = np.concatenate([blk.doy[::s], blk.doy[-1:]])
+                inputs["time_split_s"] = {
+                    "day2000": np.asarray(ep_s // 86400 - 10957,
+                                          self.dtype),
+                    "sec_of_day": np.asarray(ep_s % 86400, self.dtype),
+                    "doy": np.asarray(doy_s, self.dtype),
+                }
+                pos = np.arange(cfg.block_s)
+                inputs["gs"] = {
+                    "i": np.asarray(pos // s, np.int32),
+                    "f": np.asarray((pos % s) / s, self._compute_dtype),
+                }
         return inputs, blk.epoch
 
     # ------------------------------------------------------------------
@@ -681,31 +746,46 @@ class Simulation:
         mlo = inputs["mlo"]
         dtype = self.dtype
         shared_geom = inputs.get("geom")
+        strided = shared_geom is None and self._geom_stride > 1
         if shared_geom is None:
             ts = inputs["time_split"]
             turbidity = jnp.asarray(
                 cfg.site_grid.linke_turbidity_monthly, dtype
             )
+            if strided:
+                tss = inputs["time_split_s"]
+                gi, gf = inputs["gs"]["i"], inputs["gs"]["f"]
 
-        def one_chain(chain):
+        def one_chain(chain, pre):
             if shared_geom is not None:
                 geom = shared_geom
             else:
                 site = chain["site"]
+                td = tss if strided else ts
                 geom = solar.device_geometry(
-                    ts["day2000"], ts["sec_of_day"], ts["doy"],
+                    td["day2000"], td["sec_of_day"], td["doy"],
                     site["latitude"], site["longitude"], site["altitude"],
                     site["surface_tilt"], site["surface_azimuth"],
                     site["albedo"], turbidity, xp=jnp,
                     kernels=self._kernels,
                 )
                 geom = self._narrow_geom(geom)
+                if strided:
+                    # sample-grid evaluation above, lerp back to 1 Hz;
+                    # doy stays the exact per-second value and the site
+                    # scalars ride through (already compute-dtype)
+                    g = solar.interp_sampled(geom, gi, gf, xp=jnp)
+                    g["doy"] = jnp.asarray(ts["doy"])
+                    g["surface_tilt"] = geom["surface_tilt"]
+                    g["albedo"] = geom["albedo"]
+                    geom = g
             arrays, mvals, cc_carry = self._windows_one_chain(chain, inputs)
             carry, csi, _covered = ci.csi_scan_block(
                 chain["k_scan"], arrays, mvals, mlo,
                 chain["carry"], block_idx, cfg.options, dtype,
                 unroll=self._unroll,
                 cloudy_pair=chain["cloudy_pair"],
+                draws=None if pre is None else (pre["u"], pre["z"]),
             )
             if self._mixed:
                 csi = csi.astype(self._compute_dtype)
@@ -719,12 +799,26 @@ class Simulation:
                 ac = ac.astype(dtype)
             # one hash per global minute + counter-mode 60-draws: see
             # ci.csi_scan_block on why (threefry cost dominates the block)
-            meter = ci.meter_block(
+            meter = (pre["meter"] if pre is not None else ci.meter_block(
                 chain["k_meter"], block_idx["t"], cfg.meter_max_w, dtype
-            )
+            ))
             return dict(chain, carry=carry, cc_carry=cc_carry), meter, ac
 
-        return jax.vmap(one_chain)(state)
+        pre = None
+        if self._rng_batch == "block":
+            # whole-block hoist (Plan.rng_batch='block'): the identical
+            # minute-grouped counter draws, batched across chains BEFORE
+            # the per-chain vmap — bit-identical values
+            # (tests/test_rng_batch.py).  pre=None (the default) has no
+            # pytree leaves, so the 'scan' graph stays byte-identical.
+            t = block_idx["t"]
+            u_all, z_all = jax.vmap(
+                lambda k: ci.block_draws(k, t, dtype))(state["k_scan"])
+            meter_all = jax.vmap(
+                lambda k: ci.meter_block(k, t, cfg.meter_max_w, dtype)
+            )(state["k_meter"])
+            pre = {"u": u_all, "z": z_all, "meter": meter_all}
+        return jax.vmap(one_chain)(state, pre)
 
     def _block_stats(self, meter, pv, t):
         """Per-chain statistics of one block from the *materialised* meter
@@ -865,7 +959,11 @@ class Simulation:
         (rc', meter, ac)`` runs one second of the full pipeline on
         (n_chains,) vectors.  ``predraw=False`` omits the u/z/meter
         streams from xs — the nested 'scan2' formulation draws them
-        per-minute inside its outer scan instead.  ``with_extras=True``
+        per-minute inside its outer scan instead (unless
+        ``rng_batch='block'``, which flips the scan2 callers back to
+        predraw so the whole block's streams are pre-generated and the
+        outer body is a pure gather — see ``_scan2_outer``).
+        ``with_extras=True``
         (telemetry paths only) appends a fourth return to ``step``: the
         intermediates the TelemetryAcc folds ({'csi', 'covered'}); the
         default step is byte-for-byte the untouched off path."""
@@ -899,13 +997,36 @@ class Simulation:
                 state["k_meter"], g0, n_groups, cfg.meter_max_w, dtype
             )
 
+        geom_samp = None
         if shared_geom is None:
             ts = inputs["time_split"]
             site = state["site"]
             turbidity = jnp.asarray(
                 cfg.site_grid.linke_turbidity_monthly, dtype
             )
-            geom_xs = {k: ts[k] for k in ("day2000", "sec_of_day", "doy")}
+            if self._geom_stride > 1:
+                # geom_stride device path: evaluate the transcendental
+                # chain ONCE per stride window for every chain — a
+                # (n_samples, n_chains) batch OUTSIDE the scan — and
+                # reduce the per-second scan work to a two-gather lerp
+                # (solar.interp_sampled).  xs then carries only the
+                # exact per-second doy plus the (sample index, fraction)
+                # lerp features shipped by host_inputs.
+                tss = inputs["time_split_s"]
+                geom_samp = solar.device_geometry(
+                    tss["day2000"][:, None], tss["sec_of_day"][:, None],
+                    tss["doy"][:, None],
+                    site["latitude"], site["longitude"], site["altitude"],
+                    site["surface_tilt"], site["surface_azimuth"],
+                    site["albedo"], turbidity, xp=jnp,
+                    kernels=self._kernels,
+                )
+                geom_samp = self._narrow_geom(geom_samp)
+                geom_xs = {"doy": ts["doy"], "gi": inputs["gs"]["i"],
+                           "gf": inputs["gs"]["f"]}
+            else:
+                geom_xs = {k: ts[k]
+                           for k in ("day2000", "sec_of_day", "doy")}
             geom_const = None
         else:
             # (block_s,) features ride the scan as xs rows; python-float
@@ -930,15 +1051,23 @@ class Simulation:
                 tables, x, rc, opts, dtype
             )
             if shared_geom is None:
-                g = solar.device_geometry(
-                    x["geom"]["day2000"], x["geom"]["sec_of_day"],
-                    x["geom"]["doy"],
-                    site["latitude"], site["longitude"], site["altitude"],
-                    site["surface_tilt"], site["surface_azimuth"],
-                    site["albedo"], turbidity, xp=jnp,
-                    kernels=self._kernels,
-                )
-                g = self._narrow_geom(g)
+                if geom_samp is not None:
+                    g = solar.interp_sampled(geom_samp, x["geom"]["gi"],
+                                             x["geom"]["gf"], xp=jnp)
+                    g["doy"] = x["geom"]["doy"]
+                    g["surface_tilt"] = geom_samp["surface_tilt"]
+                    g["albedo"] = geom_samp["albedo"]
+                else:
+                    g = solar.device_geometry(
+                        x["geom"]["day2000"], x["geom"]["sec_of_day"],
+                        x["geom"]["doy"],
+                        site["latitude"], site["longitude"],
+                        site["altitude"],
+                        site["surface_tilt"], site["surface_azimuth"],
+                        site["albedo"], turbidity, xp=jnp,
+                        kernels=self._kernels,
+                    )
+                    g = self._narrow_geom(g)
             else:
                 g = dict(geom_const, **x["geom"])
             # mixed path: the physics chain runs in the compute dtype;
@@ -1072,7 +1201,7 @@ class Simulation:
         """``_block_step_scan2_acc`` with the TelemetryAcc riding both
         scan levels (see ``_block_step_scan_acc_tel``)."""
         xs, step, cc_carry = self._scan_block_setup(state, inputs,
-                                                    predraw=False,
+                                                    predraw=(self._rng_batch == "block"),
                                                     with_extras=True)
         inner_body = self._make_acc_tel_body(step)
 
@@ -1198,7 +1327,7 @@ class Simulation:
         """``_block_step_scan2_acc`` with the FleetAcc riding both scan
         levels (see ``_block_step_scan_acc_fleet``)."""
         xs, step, cc_carry = self._scan_block_setup(state, inputs,
-                                                    predraw=False,
+                                                    predraw=(self._rng_batch == "block"),
                                                     with_extras=True)
         inner_body = self._make_acc_fleet_body(step)
 
@@ -1235,7 +1364,7 @@ class Simulation:
         """Both accumulators riding the nested scan; returns
         (state', acc, tel_delta, fleet_delta)."""
         xs, step, cc_carry = self._scan_block_setup(state, inputs,
-                                                    predraw=False,
+                                                    predraw=(self._rng_batch == "block"),
                                                     with_extras=True)
         inner_body = self._make_acc_tel_fleet_body(step)
 
@@ -1272,7 +1401,17 @@ class Simulation:
         to the flat scan's pre-drawn streams — then hands the tile to the
         ``inner(carry, xs_inner) -> (carry, ys)`` 60-second scan.  Returns
         ``lax.scan(outer, carry0, xs_t)``'s (carry, ys) with ys stacked
-        per minute."""
+        per minute.
+
+        ``rng_batch='block'``: the caller builds xs WITH the pre-drawn
+        whole-block u/z/meter streams (``_scan_block_setup`` predraw),
+        which the reshape above tiles to the exact (n_min, 60, n_chains)
+        shape the in-body draws would produce — same keyed slots, so
+        bit-identical values (tests/test_rng_batch.py) — and the outer
+        body becomes a pure gather, no hashing.  Under mega-dispatch the
+        per-block pre-generation happens inside the outer mega scan
+        body, one inner block at a time, which bounds the stream HBM at
+        O(n_chains × block_s) regardless of blocks_per_dispatch."""
         cfg = self.config
         dtype = self.dtype
         # mixed path: u/z tiles in the compute dtype (same keyed slots as
@@ -1289,7 +1428,12 @@ class Simulation:
         max_w = cfg.meter_max_w
 
         def outer(carry, xm):
-            g = g0 + xm.pop("_mi")
+            mi = xm.pop("_mi")
+            if "u" in xm:
+                # pre-generated tiles already ride the xs (rng_batch=
+                # 'block'); the outer body does no hashing at all
+                return inner(carry, xm)
+            g = g0 + mi
 
             def draws(k):
                 kg = jax.random.fold_in(k, g)
@@ -1323,7 +1467,7 @@ class Simulation:
         (benchmarks/PERF_ANALYSIS.md §4a)."""
         cfg = self.config
         xs, step, cc_carry = self._scan_block_setup(state, inputs,
-                                                    predraw=False)
+                                                    predraw=(self._rng_batch == "block"))
         inner_body = self._make_acc_body(step)
 
         def inner(carry, xs_inner):
@@ -1344,7 +1488,7 @@ class Simulation:
         ensemble mode accepts ``block_impl='scan2'`` without coercion."""
         cfg = self.config
         xs, step, cc_carry = self._scan_block_setup(state, inputs,
-                                                    predraw=False)
+                                                    predraw=(self._rng_batch == "block"))
 
         def body(rc, x):
             rc, meter, ac = step(rc, x)
@@ -2334,15 +2478,20 @@ class Simulation:
 
     def precision_doc(self):
         """The report's ``precision`` section when a non-default lever is
-        active (``compute_dtype``/``kernel_impl``), else None — reports
-        written by app code and by :meth:`run_report` must agree."""
+        active (``compute_dtype``/``kernel_impl``/``rng_batch``/
+        ``geom_stride``), else None — reports written by app code and by
+        :meth:`run_report` must agree."""
         cdt = getattr(self.plan, "compute_dtype", "f32")
         kimpl = getattr(self.plan, "kernel_impl", "exact")
-        if cdt == "f32" and kimpl == "exact":
+        rb = getattr(self.plan, "rng_batch", "scan")
+        gs = int(getattr(self.plan, "geom_stride", 1))
+        if cdt == "f32" and kimpl == "exact" and rb == "scan" and gs == 1:
             return None
         return {
             "compute_dtype": cdt,
             "kernel_impl": kimpl,
+            "rng_batch": rb,
+            "geom_stride": gs,
             "telemetry": self.plan.telemetry,
             "output_overlap": bool(self._output_overlap),
         }

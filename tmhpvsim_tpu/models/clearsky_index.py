@@ -325,9 +325,21 @@ def _minute_grouped_draws(key, t, dtype):
     return u[off], z[off]
 
 
+def block_draws(key, t, dtype=jnp.float32):
+    """Whole-block (uniform, normal) pre-generation for ONE chain — the
+    ``rng_batch='block'`` hoist (Plan.rng_batch): exactly the draws
+    :func:`csi_scan_block` would make internally (same per-minute
+    ``fold_in`` keys, same counter slots, so values are bit-identical —
+    asserted by tests/test_rng_batch.py), generated as one batched
+    counter-mode tensor BEFORE the consumer instead of inside it.
+    Batch across chains with ``jax.vmap`` and feed the result back via
+    ``csi_scan_block(..., draws=...)``."""
+    return _minute_grouped_draws(key, t, dtype)
+
+
 def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
                    options: ModelOptions, dtype=jnp.float32, unroll=8,
-                   cloudy_pair=None):
+                   cloudy_pair=None, draws=None):
     """One block of per-second csi for one chain.
 
     TPU layout: the *only* sequential dependency is the renewal carry, so
@@ -348,6 +360,10 @@ def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
     block_idx : dict of shared int32/float arrays over the block's seconds:
         t (global second), hour_idx, day_idx, min_idx, hour_frac, day_frac,
         min_frac
+    draws : optional pre-generated (u_cycle, z_sec) pair from
+        :func:`block_draws` (Plan.rng_batch='block'); None — the
+        default — draws internally, leaving the historical graph
+        byte-identical.
     Returns (carry', csi[T], covered[T]).
     """
     cc, cloudy, clear_day, ws = (
@@ -370,7 +386,10 @@ def csi_scan_block(key, arrays, minute_vals, minute_lo, carry, block_idx,
     # invariant under ANY block partition or alignment; blocks that start
     # or end mid-minute (free-standing callers — Simulation itself always
     # aligns) just draw up to two spare groups.
-    u_cycle, z_sec = _minute_grouped_draws(key, t, dtype)
+    if draws is None:
+        u_cycle, z_sec = _minute_grouped_draws(key, t, dtype)
+    else:
+        u_cycle, z_sec = draws
 
     # --- elementwise sampler interpolation over the block
     cc_t = cc[h] * (1 - hf) + cc[h + 1] * hf

@@ -518,3 +518,112 @@ def block_geometry(epoch_s, doy, site, xp=jnp, kernels=None):
         "surface_tilt": site.surface_tilt,
         "albedo": site.albedo,
     }
+
+
+# ---------------------------------------------------------------------------
+# Strided geometry (Plan.geom_stride): evaluate every s seconds, lerp to 1 Hz
+# ---------------------------------------------------------------------------
+
+#: geometry fields linearly interpolated between stride samples — the
+#: TRIG-FREE outputs of the chain (angles in monotone sub-π ranges and
+#: already-composed irradiance terms), each smooth at the ~7.3e-5 rad/s
+#: apparent solar rate so the second-order lerp error over a 60 s
+#: stride is far below the fields' physical scale.  ``azimuth`` is NOT
+#: here: it wraps at 2π (a lerp through the wrap is catastrophically
+#: wrong) and nothing downstream of ``cos_aoi`` — which IS interpolated
+#: — consumes it (models/pv.py power_from_csi), so it is held at the
+#: left sample instead.  ``doy`` keeps its exact per-second value: its
+#: integer-day semantics feed the Spencer term and the turbidity LUT.
+STRIDE_LERP_FIELDS = (
+    "zenith", "cos_zenith", "apparent_zenith", "csi_cap",
+    "ghi_clear", "dni_extra", "airmass_abs", "cos_aoi",
+)
+
+#: Published float64-oracle error bounds for ``geom_stride=60`` (the
+#: coarsest supported stride; 30 is strictly tighter), in each field's
+#: native units, in the models/tables.py ``MAX_ULP`` style.  Metric:
+#: max |strided − per-second float64 oracle| over every DAYTIME second
+#: (``cos_zenith >= 0.01`` — night values multiply a zero irradiance in
+#: the power chain, and two night-only terms are intentionally
+#: discontinuous there: the apparent-elevation refraction cutoff at
+#: −0.83° and the csi-cap clamp) across solstice/equinox days at
+#: equatorial, mid-latitude and polar sites.  Enforced by
+#: tests/test_geom_stride.py; the end-to-end field-scale 1e-5
+#: reduce-stats contract over a full simulated year is asserted there
+#: too.
+STRIDE_MAX_ABS_ERR = {
+    "zenith": 5e-4,          # rad; worst measured 3.7e-4 (equatorial)
+    "cos_zenith": 1e-5,      # worst measured 2.4e-6
+    "apparent_zenith": 5e-4,  # rad; refraction steepens near the horizon
+    "csi_cap": 0.3,          # kinked at low sun → lerp across the knee;
+                             # large only where ghi_clear is ~0, so the
+                             # end-to-end 1e-5 field-scale contract holds
+    "ghi_clear": 0.5,        # W/m²; worst measured 2.1e-2 at the ramps
+    "dni_extra": 0.05,       # W/m²; ~0.06 %/day orbital drift
+    "airmass_abs": 0.2,      # Kasten–Young blows up toward the horizon;
+                             # worst measured 2.5e-2 under the daytime mask
+    "cos_aoi": 1e-4,         # worst measured 5.5e-6
+}
+
+#: the strides SimConfig.geom_stride admits (both divide 60, so stride
+#: windows never straddle a minute-RNG group or a block boundary)
+STRIDES = (1, 30, 60)
+
+
+def interp_sampled(sampled, i, f, xp=jnp):
+    """Lerp the :data:`STRIDE_LERP_FIELDS` of a stride-sampled geometry
+    dict at sample index ``i`` + fraction ``f`` in [0, 1).
+
+    ``sampled`` holds arrays with a leading sample axis of length
+    ``n_samples = T//stride + 1``; ``i``/``f`` may be scalars (the
+    in-scan per-second case) or arrays (the batched host / wide case).
+    Returns only the interpolated fields — callers add back the exact
+    per-second ``doy`` and the site scalars."""
+    out = {}
+    for k in STRIDE_LERP_FIELDS:
+        v = sampled[k]
+        lo = v[i]
+        fa = xp.asarray(f)
+        if lo.ndim > fa.ndim:
+            fa = fa.reshape(fa.shape + (1,) * (lo.ndim - fa.ndim))
+        out[k] = lo * (1.0 - fa) + v[i + 1] * fa
+    return out
+
+
+def strided_block_geometry(epoch_s, doy, site, stride, xp=np, kernels=None):
+    """:func:`block_geometry` evaluated on a stride-``s`` grid and
+    linearly interpolated back to 1 Hz — the shared-site
+    ``geom_stride`` fast path (engine/simulation.py ``host_inputs``
+    runs it on the host in float64, so the device graph is untouched).
+
+    The sample grid is ``0, s, 2s, …, T`` (``T//s + 1`` points); the
+    endpoint epoch is the exact next second after the block while its
+    ``doy`` is clamped to the block's last second (the two differ only
+    across a UTC-midnight block seam, where the day-keyed terms move by
+    ~0.06 % and the error is confined to the seam's final stride
+    window — inside the published :data:`STRIDE_MAX_ABS_ERR` bounds).
+    ``stride=1`` returns :func:`block_geometry` unchanged.
+    Accuracy contract: :data:`STRIDE_MAX_ABS_ERR`.
+    """
+    epoch_s = xp.asarray(epoch_s)
+    doy = xp.asarray(doy)
+    T = epoch_s.shape[0]
+    if stride <= 1:
+        return block_geometry(epoch_s, doy, site, xp=xp, kernels=kernels)
+    if stride not in STRIDES:
+        raise ValueError(f"geom_stride must be one of {STRIDES}, "
+                         f"got {stride}")
+    if T % stride:
+        raise ValueError(f"block length {T} not a multiple of "
+                         f"geom_stride {stride}")
+    ep_s = xp.concatenate([epoch_s[::stride], epoch_s[-1:] + 1.0])
+    doy_s = xp.concatenate([doy[::stride], doy[-1:]])
+    geom_s = block_geometry(ep_s, doy_s, site, xp=xp, kernels=kernels)
+    pos = np.arange(T)
+    i = pos // stride
+    f = (pos % stride) / float(stride)
+    out = dict(geom_s)
+    out.update(interp_sampled(geom_s, i, f, xp=xp))
+    out["doy"] = doy                       # exact per-second day index
+    out["azimuth"] = geom_s["azimuth"][i]  # held: wraps at 2π, unconsumed
+    return out

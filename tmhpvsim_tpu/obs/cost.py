@@ -86,6 +86,25 @@ _KERNEL_FACTORS = {
     "exact": (1.0, 1.0),
     "table": (0.45, 1.15),
 }
+#: rng_batch='block' hoists every threefry hash out of the scan body
+#: into one batched counter-mode tensor: the per-second flop budget
+#: loses the per-minute hash amortisation (~100 ALU ops / 64 bits,
+#: SimConfig.prng_impl) but the pre-generated streams round-trip HBM
+#: once at (block_s, n_chains) — flops drop, bytes rise slightly.
+_RNG_BATCH_FACTORS = {
+    "scan": (1.0, 1.0),
+    "block": (0.80, 1.06),
+}
+#: geom_stride=s runs the transcendental PSA/irradiance chain once per
+#: s seconds and replaces the other s-1 evaluations with a lerp (two
+#: multiply-adds per interpolated field); traffic is unchanged — the
+#: per-second xs rows still flow.  Keyed by str(stride) so the doc's
+#: string fields stay uniform; unknown strides price as 1.0.
+_GEOM_STRIDE_FACTORS = {
+    "1": (1.0, 1.0),
+    "30": (0.72, 1.0),
+    "60": (0.70, 1.0),
+}
 
 
 def _resolve(value: Optional[str], default: str) -> str:
@@ -94,23 +113,34 @@ def _resolve(value: Optional[str], default: str) -> str:
 
 def model_cost(block_impl: Optional[str] = None,
                compute_dtype: Optional[str] = None,
-               kernel_impl: Optional[str] = None) -> dict:
+               kernel_impl: Optional[str] = None,
+               rng_batch: Optional[str] = None,
+               geom_stride=None) -> dict:
     """Static flops/bytes per site-second for one plan cell.  Unknown
     axis values price as the default cell (factor 1.0) rather than
     raising — a future plan axis must not break old pricing."""
     bi = _resolve(block_impl, "scan")
     dt = _resolve(compute_dtype, "f32")
     ki = _resolve(kernel_impl, "exact")
+    rb = _resolve(rng_batch, "scan")
+    gs = _resolve(None if geom_stride in (None, "", "auto", 0, "0")
+                  else str(geom_stride), "1")
     f1, b1 = _BLOCK_IMPL_FACTORS.get(bi, (1.0, 1.0))
     f2, b2 = _DTYPE_FACTORS.get(dt, (1.0, 1.0))
     f3, b3 = _KERNEL_FACTORS.get(ki, (1.0, 1.0))
+    f4, b4 = _RNG_BATCH_FACTORS.get(rb, (1.0, 1.0))
+    f5, b5 = _GEOM_STRIDE_FACTORS.get(gs, (1.0, 1.0))
     return {
         "model": MODEL,
         "block_impl": bi,
         "compute_dtype": dt,
         "kernel_impl": ki,
-        "flops_per_site_s": round(BASE_FLOPS_PER_SITE_S * f1 * f2 * f3, 2),
-        "bytes_per_site_s": round(BASE_BYTES_PER_SITE_S * b1 * b2 * b3, 2),
+        "rng_batch": rb,
+        "geom_stride": int(gs),
+        "flops_per_site_s": round(
+            BASE_FLOPS_PER_SITE_S * f1 * f2 * f3 * f4 * f5, 2),
+        "bytes_per_site_s": round(
+            BASE_BYTES_PER_SITE_S * b1 * b2 * b3 * b4 * b5, 2),
     }
 
 
@@ -118,16 +148,19 @@ def cost_doc(*, site_s_per_s: Optional[float],
              block_impl: Optional[str] = None,
              compute_dtype: Optional[str] = None,
              kernel_impl: Optional[str] = None,
+             rng_batch: Optional[str] = None,
+             geom_stride=None,
              device_kind: Optional[str] = None,
              measured_flops_per_site_s: Optional[float] = None,
              measured_bytes_per_site_s: Optional[float] = None) -> dict:
-    """The RunReport v10 ``cost`` section: static model × measured rate
-    (→ achieved GFLOP/s, GB/s, north-star fraction), plus roofline
-    fractions when the device kind has published peaks.  Measured XLA
-    per-site costs, when provided, take precedence over the static
-    prediction for the achieved rates; the prediction stays in the doc
-    either way."""
-    doc = model_cost(block_impl, compute_dtype, kernel_impl)
+    """The RunReport ``cost`` section (v10; v11 adds the rng_batch /
+    geom_stride axes): static model × measured rate (→ achieved
+    GFLOP/s, GB/s, north-star fraction), plus roofline fractions when
+    the device kind has published peaks.  Measured XLA per-site costs,
+    when provided, take precedence over the static prediction for the
+    achieved rates; the prediction stays in the doc either way."""
+    doc = model_cost(block_impl, compute_dtype, kernel_impl,
+                     rng_batch, geom_stride)
     flops_ss = (measured_flops_per_site_s
                 if measured_flops_per_site_s else doc["flops_per_site_s"])
     bytes_ss = (measured_bytes_per_site_s
@@ -185,6 +218,13 @@ def validate_cost(doc) -> list:
         if not isinstance(doc.get(key), str):
             errors.append(f"cost.{key}: expected str, got "
                           f"{type(doc.get(key)).__name__}")
+    # v11 axes — optional, so v10 documents keep validating
+    if "rng_batch" in doc and not isinstance(doc["rng_batch"], str):
+        errors.append(f"cost.rng_batch: expected str, got "
+                      f"{type(doc['rng_batch']).__name__}")
+    if "geom_stride" in doc and not isinstance(doc["geom_stride"], int):
+        errors.append(f"cost.geom_stride: expected int, got "
+                      f"{type(doc['geom_stride']).__name__}")
     for key in ("flops_per_site_s", "bytes_per_site_s"):
         if not isinstance(doc.get(key), (int, float)):
             errors.append(f"cost.{key}: expected number, got "

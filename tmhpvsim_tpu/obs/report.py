@@ -71,9 +71,16 @@ logger = logging.getLogger(__name__)
 #: the chip's published peaks, north-star fraction; ``basis`` records
 #: whether the per-site costs were measured via XLA cost_analysis or
 #: priced by the static model).
+#: v11: adds the ``rng_batch`` / ``geom_stride`` fields to the plan
+#: echo (the scan-restructuring axes: whole-block RNG pre-generation
+#: and strided solar geometry — engine/autotune.py, models/solar.py)
+#: and prices them in the ``cost`` section (obs/cost.py static-v1
+#: factors).  Both additive — a v10 reader of the plan echo's original
+#: keys is unaffected, and documents omitting them mean the historical
+#: scan/1 path.
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 10
+REPORT_SCHEMA_VERSION = 11
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -258,6 +265,9 @@ def _plan_doc(plan) -> Optional[dict]:
             # getattr: pre-v8 plans predate the precision axes
             "compute_dtype": str(getattr(plan, "compute_dtype", "f32")),
             "kernel_impl": str(getattr(plan, "kernel_impl", "exact")),
+            # getattr: pre-v11 plans predate the scan-restructuring axes
+            "rng_batch": str(getattr(plan, "rng_batch", "scan")),
+            "geom_stride": int(getattr(plan, "geom_stride", 1)),
             "source": plan.source}
 
 

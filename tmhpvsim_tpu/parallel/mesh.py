@@ -80,6 +80,14 @@ class ShardedSimulation(Simulation):
     e.g. a 1-chain shard and an 8-chain batch round differently in the
     transcendental-heavy solar/PV math.  Deterministic for a fixed mesh
     shape; there is no cross-chain reduction in the per-chain outputs.
+
+    The scan-restructuring plan axes shard transparently: the
+    ``rng_batch='block'`` pre-generated streams are per-chain values
+    born INSIDE the shard_mapped block step (each shard hoists only its
+    own chains' draws — same fold_in keys, so sharded 'block' stays
+    bit-identical to sharded 'scan'; tests/test_rng_batch.py), and the
+    ``geom_stride`` sample/lerp features ship as extra replicated
+    ``host_inputs`` leaves riding the existing ``P()`` input spec.
     """
 
     #: the base __init__ must not AOT-warm the unsharded jits this

@@ -110,15 +110,17 @@ def _serve_ratio(doc) -> float | None:
 
 
 def _precision_axes(doc) -> tuple:
-    """(compute_dtype, kernel_impl, best_sweep_speedup) of one document.
+    """(compute_dtype, kernel_impl, rng_batch, geom_stride,
+    best_sweep_speedup) of one document.
 
     Axes come from the winning plan echo (``tuned_plan`` on headline
-    docs, the v8 ``plan`` fields on RunReports); pre-v8 documents
-    predate both fields and read as the exact/f32 defaults.  The third
-    element is the best ``speedup_vs_exact_f32`` among the non-default
-    variants of the doc's own ``precision`` section — the
-    within-process pricing bench.py computed when its sweep timed both
-    sides — or None."""
+    docs, the v8/v11 ``plan`` fields on RunReports); pre-v8 documents
+    predate the precision fields and read as the exact/f32 defaults,
+    pre-v11 documents predate the scan-restructuring fields and read as
+    scan/1.  The last element is the best ``speedup_vs_exact_f32``
+    among the non-default variants of the doc's own ``precision``
+    section — the within-process pricing bench.py computed when its
+    sweep timed both sides — or None."""
     if doc.get("kind") == REPORT_KIND:
         plan, rep = doc.get("plan"), doc
     else:
@@ -131,6 +133,8 @@ def _precision_axes(doc) -> tuple:
         plan = {}
     cdt = plan.get("compute_dtype") or "f32"
     kimpl = plan.get("kernel_impl") or "exact"
+    rb = plan.get("rng_batch") or "scan"
+    gs = plan.get("geom_stride") or 1
     speed = None
     prec = rep.get("precision")
     if isinstance(prec, dict):
@@ -139,10 +143,12 @@ def _precision_axes(doc) -> tuple:
                 continue
             s = v.get("speedup_vs_exact_f32")
             nondefault = (v.get("compute_dtype", "f32") != "f32"
-                          or v.get("kernel_impl", "exact") != "exact")
+                          or v.get("kernel_impl", "exact") != "exact"
+                          or v.get("rng_batch", "scan") != "scan"
+                          or (v.get("geom_stride", 1) or 1) != 1)
             if s is not None and nondefault:
                 speed = s if speed is None else max(speed, s)
-    return cdt, kimpl, speed
+    return cdt, kimpl, rb, gs, speed
 
 
 def _cost_fields(doc) -> tuple:
@@ -185,16 +191,27 @@ def normalize(path: str) -> dict:
            "compile_s": None, "steady_block_s": None,
            "telemetry": None, "analytics": None, "serve": None,
            "compute_dtype": None, "kernel_impl": None,
+           "rng_batch": None, "geom_stride": None,
            "precision_speedup": None, "north_star_frac": None,
            "roofline_frac_vpu": None, "failed": True}
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
-        row["note"] = f"unreadable: {e}"
+        # a bench_partial.jsonl journal is many records, not one doc —
+        # show it as a partial row (never gated on) instead of
+        # "unreadable" noise
+        if path.endswith(".jsonl"):
+            row["note"] = "partial journal (not a round artifact)"
+        else:
+            row["note"] = f"unreadable: {e}"
         return row
     if not isinstance(doc, dict):
         row["note"] = "not a JSON object"
+        return row
+    if "phase" in doc and "value" not in doc and "variants" not in doc:
+        # a single journalled partial record (bench.py _persist_partial)
+        row["note"] = f"partial record (phase={doc.get('phase')})"
         return row
 
     if "parsed" in doc and "cmd" in doc:          # driver wrapper
@@ -210,7 +227,7 @@ def normalize(path: str) -> dict:
         timing = doc.get("timing") or {}
         headline = doc.get("headline") or {}
         tel, ana = _levels(doc.get("config"))
-        cdt, kimpl, prec_speed = _precision_axes(doc)
+        cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
         row.update(
             failed=False,
@@ -221,6 +238,7 @@ def normalize(path: str) -> dict:
             telemetry=tel, analytics=ana,
             serve=_serve_ratio(doc),
             compute_dtype=cdt, kernel_impl=kimpl,
+            rng_batch=rb, geom_stride=gs,
             precision_speedup=prec_speed,
             north_star_frac=nsf, roofline_frac_vpu=vpu,
         )
@@ -232,7 +250,7 @@ def normalize(path: str) -> dict:
         rep = doc.get("run_report")
         tel, ana = _levels(rep.get("config")
                            if isinstance(rep, dict) else None)
-        cdt, kimpl, prec_speed = _precision_axes(doc)
+        cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
         row.update(
             failed=False,
@@ -243,6 +261,7 @@ def normalize(path: str) -> dict:
             telemetry=tel, analytics=ana,
             serve=_serve_ratio(doc),
             compute_dtype=cdt, kernel_impl=kimpl,
+            rng_batch=rb, geom_stride=gs,
             precision_speedup=prec_speed,
             north_star_frac=nsf, roofline_frac_vpu=vpu,
         )
@@ -287,19 +306,27 @@ def annotate_overhead(rows: list) -> None:
             r["overhead_pct"] = (r["steady_block_s"] / b - 1.0) * 100.0
 
 
+def _all_defaults(r) -> bool:
+    """True when the row ran every speed-lever axis at its default —
+    the only rows that may anchor the cross-row lever pricing."""
+    return ((r.get("compute_dtype") or "f32") == "f32"
+            and (r.get("kernel_impl") or "exact") == "exact"
+            and (r.get("rng_batch") or "scan") == "scan"
+            and (r.get("geom_stride") or 1) == 1)
+
+
 def annotate_precision(rows: list) -> None:
-    """Price the precision levers across rows: every row running a
-    non-default compute_dtype/kernel_impl whose own document carried no
-    sweep pricing gets ``precision_speedup`` = its throughput vs the
-    best same-platform exact/f32 row.  Rows priced by their own v8
-    ``precision`` section (bench.py timed both sides in one process —
-    the cleaner comparison) keep that number."""
+    """Price the speed levers across rows: every row running a
+    non-default compute_dtype/kernel_impl/rng_batch/geom_stride whose
+    own document carried no sweep pricing gets ``precision_speedup`` =
+    its throughput vs the best same-platform all-defaults row.  Rows
+    priced by their own v8 ``precision`` section (bench.py timed both
+    sides in one process — the cleaner comparison) keep that number."""
     base: dict = {}
     for r in rows:
         if r["failed"] or r["value"] is None:
             continue
-        if (r.get("compute_dtype") or "f32") == "f32" and \
-                (r.get("kernel_impl") or "exact") == "exact":
+        if _all_defaults(r):
             p = r["platform"]
             if p not in base or r["value"] > base[p]:
                 base[p] = r["value"]
@@ -309,8 +336,7 @@ def annotate_precision(rows: list) -> None:
             continue
         if r["failed"] or r["value"] is None:
             continue
-        if (r.get("compute_dtype") or "f32") == "f32" and \
-                (r.get("kernel_impl") or "exact") == "exact":
+        if _all_defaults(r):
             continue
         b = base.get(r["platform"])
         if b:
@@ -333,7 +359,7 @@ def _fmt_cost(r) -> str:
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
-            "cdt", "kimpl", "prec", "cost", "note")
+            "cdt", "kimpl", "rb", "gs", "prec", "cost", "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
@@ -346,6 +372,8 @@ def print_table(rows: list) -> None:
             "-" if ovh is None else f"{ovh:+.1f}",
             "-" if srv is None else f"{srv:.2f}x",
             r.get("compute_dtype") or "-", r.get("kernel_impl") or "-",
+            r.get("rng_batch") or "-",
+            "-" if r.get("geom_stride") is None else str(r["geom_stride"]),
             "-" if prec is None else f"{prec:.2f}x",
             _fmt_cost(r),
             r.get("note", ""),
@@ -379,8 +407,12 @@ def check_regression(rows: list, max_regress_pct: float):
     otherwise.  Rows with a v10 cost section get their roofline
     fractions reported alongside the verdict."""
     valid = [r for r in rows if not r["failed"]]
+    if not valid:
+        return True, ("no prior same-platform round to compare against "
+                      "(only partial/failed artifacts); gate passes")
     if len(valid) < 2:
-        return True, "no prior round to compare against; gate passes"
+        return True, ("no prior same-platform round to compare against; "
+                      "gate passes")
     newest = valid[-1]
     prior = [r for r in valid[:-1] if r["platform"] == newest["platform"]]
     if not prior:

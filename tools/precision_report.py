@@ -48,6 +48,9 @@ _NUM = (int, float)
 _DTYPES = ("f32", "bf16")
 _KIMPLS = ("exact", "table")
 _TELEMETRY = ("off", "light", "full")
+# v11 scan-restructuring axes (optional: pre-v11 sections omit them)
+_RNG_BATCHES = ("scan", "block")
+_GEOM_STRIDES = (1, 30, 60)
 
 
 def _check(cond: bool, errors: list, msg: str) -> None:
@@ -62,6 +65,12 @@ def _validate_axes(doc: dict, prefix: str, errors: list) -> None:
            f"{prefix}compute_dtype {cdt!r} not in {_DTYPES}")
     _check(kimpl in _KIMPLS, errors,
            f"{prefix}kernel_impl {kimpl!r} not in {_KIMPLS}")
+    rb = doc.get("rng_batch", "scan")
+    gs = doc.get("geom_stride", 1)
+    _check(rb in _RNG_BATCHES, errors,
+           f"{prefix}rng_batch {rb!r} not in {_RNG_BATCHES}")
+    _check(gs in _GEOM_STRIDES, errors,
+           f"{prefix}geom_stride {gs!r} not in {_GEOM_STRIDES}")
 
 
 def validate_precision(sec) -> list:
@@ -147,7 +156,9 @@ def print_precision(sec: dict, label: str) -> None:
     if variants is None:
         print(f"{label}: precision axes compute_dtype="
               f"{sec.get('compute_dtype', 'f32')} kernel_impl="
-              f"{sec.get('kernel_impl', 'exact')} telemetry="
+              f"{sec.get('kernel_impl', 'exact')} rng_batch="
+              f"{sec.get('rng_batch', 'scan')} geom_stride="
+              f"{sec.get('geom_stride', 1)} telemetry="
               f"{sec.get('telemetry', '-')} output_overlap="
               f"{sec.get('output_overlap', '-')}")
         return
@@ -160,6 +171,8 @@ def print_precision(sec: dict, label: str) -> None:
         speed = v.get("speedup_vs_exact_f32")
         print(f"  {name.ljust(width)}  {v.get('compute_dtype', 'f32'):>4}"
               f"/{v.get('kernel_impl', 'exact'):<5}  "
+              f"rng={v.get('rng_batch', 'scan'):<5} "
+              f"gs={v.get('geom_stride', 1):<2}  "
               f"rate={v.get('rate'):,}  "
               + ("-" if speed is None else f"{speed:.2f}x vs exact/f32"))
 
