@@ -1235,6 +1235,77 @@ def _reduce_config_run_resilient(label: str, make_cfg_bs, sharded: bool,
     print(json.dumps(doc))
 
 
+def fleet_bench(fleet_csv: str | None, fleet_synth: int | None,
+                fleet_seed: int = 0) -> None:
+    """Heterogeneous-fleet variant (--fleet-csv / --fleet-synth N): the
+    standard reduce-mode measurement protocol run twice on the same
+    chain shape — a homogeneous baseline, then a per-site parameter
+    fleet (fleet/params.py) — so the artifact prices what heterogeneity
+    costs and tools/bench_trend.py can carry it as the ``fleet``
+    column.  Synthetic fleets are the seeded national-fleet sampler
+    (FleetParams.synthetic); a CSV runs whatever installation list the
+    operator exported."""
+    import jax
+
+    from tmhpvsim_tpu import fleet as fleet_mod
+    from tmhpvsim_tpu.engine import Simulation
+
+    platform, fallback = _probe_or_fallback()
+    if fleet_csv is not None:
+        fp = fleet_mod.FleetParams.from_csv(fleet_csv)
+        source = "csv"
+    else:
+        fp = fleet_mod.FleetParams.synthetic(fleet_synth or 1024,
+                                             seed=fleet_seed)
+        source = "synthetic"
+    n = len(fp)
+    n_blocks, bs = (3, 1800) if platform != "tpu" else (4, BLOCK_S)
+
+    def timed(cfg):
+        sim = Simulation(cfg)
+        c_s, steady, rate = _timed_reduce_run(sim, sim.n_blocks - 1, 1)
+        plan = sim.plan
+        del sim  # resident sims degrade later timed runs (VARIANT_CFGS)
+        return c_s, steady, rate, plan
+
+    c0, s0, r0, _ = timed(_make_cfg(n, n_blocks, block_s=bs))
+    _persist_partial({"phase": "fleet-homog", "n_chains": n,
+                      "rate": round(r0, 1)})
+    het_cfg = _make_cfg(n, n_blocks, block_s=bs, fleet=fp)
+    c1, s1, r1, plan = timed(het_cfg)
+    doc = {
+        "config": "fleet-het",
+        "metric": "simulated site-seconds/sec/chip",
+        "value": round(r1, 1),
+        "unit": "site-s/s/chip",
+        "vs_baseline": round(r1 / REF_CEILING, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": 1,
+        "fleet": {
+            "n_sites": n,
+            "n_cohorts": fp.n_cohorts,
+            "digest": fp.digest()[:12],
+            "source": source,
+            "homog_rate": round(r0, 1),
+            # the pricing lever: heterogeneous rate as a fraction of the
+            # homogeneous rate on the identical chain shape
+            "het_over_homog": round(r1 / r0, 3) if r0 else None,
+        },
+        "compile_s": round(c1, 1),
+        "steady_wall_s": round(s1, 2),
+        "note": "" if not fallback else "cpu-fallback",
+    }
+    doc["run_report"] = _bench_report(
+        "bench.fleet", config=het_cfg, plan=_plan_doc(plan),
+        timing=_bench_timing(c1, s1, n_blocks - 1, r1),
+        headline={"site_seconds_per_s": doc["value"]},
+        cost=_config_cost(plan, doc["value"], doc["device_kind"]),
+    )
+    _persist_partial({"phase": "fleet", **doc})
+    print(json.dumps(doc))
+
+
 def config_1() -> None:
     """1 site, 1 day @ 1 Hz on the asyncio/CPU reference path: the real
     app pair (metersim producer -> local transport -> pvsim consumer ->
@@ -1906,6 +1977,16 @@ def main() -> None:
                          "section")
     ap.add_argument("--serve-requests", type=int, metavar="R", default=8,
                     help="requests per client in --serve mode (default 8)")
+    ap.add_argument("--fleet-csv", metavar="PATH", default=None,
+                    help="heterogeneous-fleet variant from a site CSV "
+                         "(fleet/params.py FleetParams.from_csv): prices "
+                         "per-site parameters vs the homogeneous run")
+    ap.add_argument("--fleet-synth", type=int, metavar="N", default=None,
+                    help="heterogeneous-fleet variant: N synthetic sites "
+                         "from the seeded national-fleet sampler "
+                         "(FleetParams.synthetic)")
+    ap.add_argument("--fleet-seed", type=int, default=0,
+                    help="sampler seed for --fleet-synth (default 0)")
     ap.add_argument("--telemetry", choices=["off", "light", "full"],
                     default="off",
                     help="in-graph telemetry level for every config this "
@@ -1947,6 +2028,8 @@ def main() -> None:
         one_variant()
     elif args.serve is not None:
         serve_bench(args.serve, args.serve_requests)
+    elif args.fleet_csv is not None or args.fleet_synth is not None:
+        fleet_bench(args.fleet_csv, args.fleet_synth, args.fleet_seed)
     else:
         headline()
 

@@ -1,0 +1,709 @@
+"""Heterogeneous fleet subsystem (tmhpvsim_tpu/fleet/): per-site
+parameters as a first-class batched pytree on the chain axis.
+
+Covered here:
+* FleetParams validation (lengths, ranges, regimes, cohorts), the
+  heterogeneity flags, digest stability across builders, slice_fleet;
+* builders: from_csv (line-numbered refusals, blank-cell defaults),
+  the seeded synthetic national-fleet sampler (reproducible bit-stream);
+* a NEUTRAL fleet is the absence of the feature: run_reduced bitwise
+  equal to the no-fleet run AND byte-identical lowered HLO;
+* per-site transform semantics: regime row 0 aliases the Munich fit,
+  demand affine map, DC capacity scale + inverter AC clip;
+* the partition exactness matrix (ISSUE satellite): a heterogeneous
+  uniform-geometry fleet is bit-identical 8-device-sharded vs single
+  device on wide/scan/scan2, slab-vs-monolithic, and mega-dispatch;
+  per-cohort analytics merge with the established contract (int
+  counts, extrema and quantiles exact; float-sum means reassociate);
+* checkpoint config echo: a changed fleet refuses resume, the same
+  fleet (and a fleet-less checkpoint) resumes fine;
+* the scenario-serving site selector: a site/cohort-selected reply is
+  bit-identical to simulating exactly those chains alone, and the
+  selector validation is typed;
+* RunReport v12: per-cohort ``fleet.cohorts`` table + config-echo fleet
+  identity round-trip the validator (v11 documents still validate) and
+  tools/fleet_report.py prints/validates the cohort table.
+
+Geometry note: the fleet fixtures here are deliberately
+geometry-UNIFORM (every site the Munich default) while heterogeneous in
+demand/power/regime/cohort — per-site GEOMETRY already has its own
+equivalence scope in tests/test_sitegrid.py (the CPU backend's
+shape-dependent geometry codegen is float-close, not bitwise, across
+shard layouts, a pre-existing property unrelated to the fleet leaves).
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import Site, SimConfig
+from tmhpvsim_tpu.engine import Simulation, autotune
+from tmhpvsim_tpu.engine import checkpoint as ckpt
+from tmhpvsim_tpu.fleet import (
+    COLUMN_RANGES,
+    N_REGIMES,
+    NO_AC_LIMIT,
+    FleetParams,
+    slice_fleet,
+)
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, validate_report
+from tmhpvsim_tpu.parallel import ShardedSimulation
+from tmhpvsim_tpu.serve import schema
+from tmhpvsim_tpu.serve.schema import RequestError, Scenario
+from tmhpvsim_tpu.serve.server import ScenarioEngine
+
+REPO = Path(__file__).resolve().parents[1]
+FLEET_REPORT = REPO / "tools" / "fleet_report.py"
+
+SITE = Site()
+INF = float("inf")
+
+
+def small_cfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=7200,
+        n_chains=8,
+        seed=7,
+        block_s=3600,
+        dtype="float32",
+        block_impl="scan",
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _geom(n):
+    """Uniform geometry at the Munich default site (see module note)."""
+    return dict(
+        latitude=(SITE.latitude,) * n, longitude=(SITE.longitude,) * n,
+        altitude=(SITE.altitude,) * n,
+        surface_tilt=(SITE.surface_tilt,) * n,
+        surface_azimuth=(SITE.surface_azimuth,) * n,
+        albedo=(SITE.albedo,) * n,
+    )
+
+
+def het_fleet(n=8):
+    """Heterogeneous in every non-geometry column: scaled+shifted demand,
+    scaled+half-clipped pv, all three weather regimes, three cohorts."""
+    return FleetParams(
+        dc_capacity_scale=tuple(0.5 + 0.2 * i for i in range(n)),
+        ac_limit_w=(150.0,) * (n // 2) + (INF,) * (n - n // 2),
+        weather_regime=tuple(i % 3 for i in range(n)),
+        demand_scale=tuple(1.0 + 0.1 * i for i in range(n)),
+        demand_shift_w=tuple(10.0 * i for i in range(n)),
+        cohort=tuple((0, 0, 1, 1, 2, 2, 0, 1)[i % 8] for i in range(n)),
+        **_geom(n),
+    )
+
+
+def neutral_fleet(n=8):
+    return FleetParams(**_geom(n))
+
+
+def _reduced(cfg, plan=None, cls=Simulation):
+    with use_registry(MetricsRegistry()):
+        sim = cls(cfg, plan=plan)
+        red = sim.run_reduced()
+        return ({k: np.asarray(v) for k, v in red.items()},
+                sim.fleet_summary())
+
+
+def _assert_reduced_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def _assert_fleet_equal_cohort_means_close(a, b):
+    """The merge contract for per-cohort sections: every risk leaf and
+    every cohort counting/extremum/quantile leaf bitwise; the cohort
+    float-sum means reassociate across shard/slab merges (float32), so
+    they compare to tolerance — mirroring test_analytics.py's
+    sharded-full-level contract."""
+    ka, kb = dict(a), dict(b)
+    ca, cb = ka.pop("cohorts"), kb.pop("cohorts")
+    assert ka == kb
+    assert ca is not None and cb is not None
+    assert len(ca) == len(cb)
+    for ra, rb in zip(ca, cb):
+        for k in ("cohort", "count", "residual_min", "residual_max",
+                  "quantiles"):
+            assert rb[k] == ra[k], k
+        for k in ("meter_mean", "pv_mean", "residual_mean"):
+            if ra[k] is None:
+                assert rb[k] is None
+            else:
+                assert rb[k] == pytest.approx(ra[k], rel=1e-4), k
+
+
+# ---------------------------------------------------------------------------
+# FleetParams: validation, flags, digest, slicing
+# ---------------------------------------------------------------------------
+
+class TestParams:
+    def test_defaults_are_neutral(self):
+        fp = FleetParams(latitude=(48.1, 47.0), longitude=(11.6, 9.5))
+        assert len(fp) == 2
+        assert fp.dc_capacity_scale == (1.0, 1.0)
+        assert fp.ac_limit_w == (NO_AC_LIMIT, NO_AC_LIMIT)
+        assert fp.weather_regime == (0, 0)
+        assert fp.demand_scale == (1.0, 1.0)
+        assert fp.demand_shift_w == (0.0, 0.0)
+        assert fp.cohort == (0, 0)
+        assert fp.surface_tilt == (48.1, 47.0)  # tilt-equals-latitude
+        assert not (fp.het_demand or fp.het_power or fp.het_regime)
+        assert fp.n_cohorts == 1
+
+    def test_het_flags_gate_per_axis(self):
+        kw = _geom(2)
+        assert FleetParams(demand_scale=(1.0, 1.5), **kw).het_demand
+        assert FleetParams(demand_shift_w=(0.0, 5.0), **kw).het_demand
+        assert FleetParams(dc_capacity_scale=(1.0, 2.0), **kw).het_power
+        # ANY finite AC limit is a heterogeneity (the clip is traced)
+        assert FleetParams(ac_limit_w=(200.0, 200.0), **kw).het_power
+        assert FleetParams(weather_regime=(0, 1), **kw).het_regime
+        fp = FleetParams(demand_scale=(1.0, 1.5), **kw)
+        assert not (fp.het_power or fp.het_regime)
+        assert fp.uniform_geometry
+
+    def test_length_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="must have length 2"):
+            FleetParams(latitude=(48.0, 47.0), longitude=(11.0, 9.0),
+                        demand_scale=(1.0,))
+
+    def test_empty_fleet_rejected(self):
+        with pytest.raises(ValueError, match="at least one site"):
+            FleetParams(latitude=(), longitude=())
+
+    @pytest.mark.parametrize("col,bad", [
+        ("latitude", 95.0),
+        ("albedo", 1.5),
+        ("dc_capacity_scale", -0.5),
+        ("demand_scale", -1.0),
+        ("ac_limit_w", -10.0),
+    ])
+    def test_out_of_range_column_rejected(self, col, bad):
+        kw = _geom(2)
+        kw[col] = (kw.get(col, (1.0, 1.0))[0], bad) if col in kw \
+            else (1.0, bad)
+        assert col in COLUMN_RANGES  # the bound the refusal cites
+        with pytest.raises(ValueError,
+                           match=rf"FleetParams\.{col}\[1\]"):
+            FleetParams(**kw)
+
+    def test_bad_regime_and_cohort_rejected(self):
+        with pytest.raises(ValueError, match="weather_regime"):
+            FleetParams(weather_regime=(0, N_REGIMES), **_geom(2))
+        with pytest.raises(ValueError, match="cohort"):
+            FleetParams(cohort=(0, -1), **_geom(2))
+
+    def test_digest_stable_and_content_addressed(self):
+        fp = het_fleet(4)
+        again = het_fleet(4)
+        assert fp.digest() == again.digest()
+        changed = dataclasses.replace(fp, demand_shift_w=(0.0, 10.0,
+                                                          20.0, 31.0))
+        assert changed.digest() != fp.digest()
+
+    def test_uniform_site_is_the_munich_default(self):
+        fp = neutral_fleet(4)
+        assert fp.uniform_geometry
+        assert fp.uniform_site() == SITE
+
+    def test_slice_keeps_cohort_width(self):
+        fp = het_fleet(8)
+        assert fp.n_cohorts == 3
+        sl = slice_fleet(fp, 2, 3)
+        assert len(sl) == 3
+        assert sl.latitude == fp.latitude[2:5]
+        assert sl.cohort == fp.cohort[2:5]
+        # the slice's cohort ids span < 3 values but the accumulator
+        # width must stay the parent's (slab merges need equal shapes)
+        assert sl.n_cohorts == 3
+        assert slice_fleet(None, 0, 4) is None
+
+
+# ---------------------------------------------------------------------------
+# builders: CSV and the synthetic sampler
+# ---------------------------------------------------------------------------
+
+class TestBuilders:
+    def _write(self, tmp_path, text):
+        p = tmp_path / "fleet.csv"
+        p.write_text(text)
+        return str(p)
+
+    def test_csv_full_columns(self, tmp_path):
+        path = self._write(tmp_path, (
+            "latitude,longitude,dc_capacity_scale,ac_limit_w,"
+            "weather_regime,demand_scale,demand_shift_w,cohort,owner\n"
+            "48.1,11.6,1.5,200,1,1.2,50,2,alice\n"
+            "47.0,9.5,0.8,,0,0.9,-25,0,bob\n"
+        ))
+        fp = FleetParams.from_csv(path)
+        assert len(fp) == 2
+        assert fp.dc_capacity_scale == (1.5, 0.8)
+        assert fp.ac_limit_w == (200.0, NO_AC_LIMIT)  # blank = no clip
+        assert fp.weather_regime == (1, 0)
+        assert fp.demand_shift_w == (50.0, -25.0)
+        assert fp.cohort == (2, 0)
+        assert fp.het_demand and fp.het_power and fp.het_regime
+
+    def test_csv_defaults_applied(self, tmp_path):
+        fp = FleetParams.from_csv(self._write(
+            tmp_path, "latitude,longitude\n48.1,11.6\n"))
+        assert fp.dc_capacity_scale == (1.0,)
+        assert fp.ac_limit_w == (NO_AC_LIMIT,)
+        assert not (fp.het_demand or fp.het_power or fp.het_regime)
+
+    @pytest.mark.parametrize("row,match", [
+        ("48.1,11.6,-2.0", r"line 3: demand_scale=-2\.0 outside"),
+        ("95.0,11.6,1.0", r"line 3: latitude=95\.0 outside"),
+        ("48.1,11.6,oops", r"line 3: bad value 'oops'"),
+    ])
+    def test_csv_refusals_name_the_line(self, tmp_path, row, match):
+        path = self._write(tmp_path, (
+            "latitude,longitude,demand_scale\n"
+            "48.1,11.6,1.0\n" + row + "\n"
+        ))
+        with pytest.raises(ValueError, match=match):
+            FleetParams.from_csv(path)
+
+    def test_csv_bad_regime_names_the_line(self, tmp_path):
+        path = self._write(tmp_path, (
+            "latitude,longitude,weather_regime\n"
+            f"48.1,11.6,{N_REGIMES}\n"
+        ))
+        with pytest.raises(ValueError, match="line 2: weather_regime"):
+            FleetParams.from_csv(path)
+
+    def test_csv_missing_required_column(self, tmp_path):
+        path = self._write(tmp_path, "latitude,cohort\n48.1,0\n")
+        with pytest.raises(ValueError, match="longitude"):
+            FleetParams.from_csv(path)
+
+    def test_synthetic_is_reproducible(self):
+        a = FleetParams.synthetic(64, seed=11)
+        assert len(a) == 64
+        assert a.digest() == FleetParams.synthetic(64, seed=11).digest()
+        assert a.digest() != FleetParams.synthetic(64, seed=12).digest()
+        # a real national fleet is heterogeneous on every axis
+        assert a.het_demand and a.het_power and a.het_regime
+        assert not a.uniform_geometry
+        assert a.n_cohorts == 3
+        # validation ran in __post_init__, so every column is in range;
+        # spot-check the documented envelope
+        assert all(47.3 <= v <= 55.0 for v in a.latitude)
+        assert all(v == NO_AC_LIMIT or v > 0 for v in a.ac_limit_w)
+
+
+# ---------------------------------------------------------------------------
+# neutral fleet == no fleet: bitwise results AND byte-identical HLO
+# ---------------------------------------------------------------------------
+
+class TestHomogeneousIsAbsent:
+    def test_neutral_fleet_reduces_bitwise_to_baseline(self):
+        base, _ = _reduced(small_cfg())
+        fl, _ = _reduced(small_cfg(fleet=neutral_fleet(8)))
+        _assert_reduced_equal(base, fl)
+
+    @pytest.mark.parametrize("impl", ["scan", "scan2"])
+    def test_neutral_fleet_lowers_byte_identical(self, impl):
+        """The acceptance bar: a homogeneous FleetParams must not merely
+        compute the same numbers — the traced block step must lower to
+        byte-identical HLO (no dead leaves, no gated branches)."""
+        bare = Simulation(small_cfg(block_impl=impl, n_chains=4))
+        fleeted = Simulation(small_cfg(block_impl=impl, n_chains=4,
+                                       fleet=neutral_fleet(4)))
+        state = bare.init_state()
+        acc = bare.init_reduce_acc()
+        inputs, _ = bare.host_inputs(0)
+        attr = f"_{impl}_acc_jit"
+        a = getattr(bare, attr).lower(state, inputs, acc).as_text()
+        b = getattr(fleeted, attr).lower(state, inputs, acc).as_text()
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# per-site transform semantics
+# ---------------------------------------------------------------------------
+
+class TestTransforms:
+    def test_regime_zero_rows_alias_the_munich_fit(self):
+        """Stacked regime tables: row 0 is the Munich fit byte-for-byte,
+        so a regime-0 chain inside a heterogeneous-regime fleet must
+        reproduce the no-fleet chain bitwise (same fold_in keying, same
+        step-distribution constants)."""
+        fp = FleetParams(weather_regime=(0, 1), **_geom(2))
+        base, _ = _reduced(small_cfg(n_chains=2))
+        fl, _ = _reduced(small_cfg(n_chains=2, fleet=fp))
+        for k in base:
+            np.testing.assert_array_equal(base[k][0], fl[k][0], err_msg=k)
+        # ...and the regime-1 chain really simulates different weather
+        assert fl["pv_sum"][1] != base["pv_sum"][1] or \
+            fl["residual_sum"][1] != base["residual_sum"][1]
+
+    def test_demand_affine_map(self):
+        scale = (1.0, 1.5, 0.5, 2.0)
+        shift = (0.0, 100.0, -50.0, 25.0)
+        fp = FleetParams(demand_scale=scale, demand_shift_w=shift,
+                         **_geom(4))
+        cfg = small_cfg(n_chains=4, duration_s=3600)
+        with use_registry(MetricsRegistry()):
+            base = next(iter(Simulation(cfg).run_blocks()))
+        with use_registry(MetricsRegistry()):
+            het = next(iter(Simulation(
+                dataclasses.replace(cfg, fleet=fp)).run_blocks()))
+        # pv untouched by the demand axis
+        np.testing.assert_array_equal(np.asarray(base.pv),
+                                      np.asarray(het.pv))
+        # the neutral row is untouched BITWISE (identity transform rows
+        # still trace the op, but 1.0*x + 0.0 is exact in IEEE)
+        np.testing.assert_array_equal(np.asarray(base.meter[0]),
+                                      np.asarray(het.meter[0]))
+        sc = np.asarray(scale, np.float32)[:, None]
+        sh = np.asarray(shift, np.float32)[:, None]
+        np.testing.assert_allclose(np.asarray(het.meter),
+                                   np.asarray(base.meter) * sc + sh,
+                                   rtol=1e-6, atol=1e-3)
+
+    def test_capacity_scale_and_ac_clip(self):
+        cap = (1.0, 2.0, 1.0, 0.5)
+        lim = (INF, INF, 40.0, INF)
+        fp = FleetParams(dc_capacity_scale=cap, ac_limit_w=lim,
+                         **_geom(4))
+        cfg = small_cfg(n_chains=4)  # 10:00-12:00, daylight
+        base, _ = _reduced(cfg)
+        het, _ = _reduced(dataclasses.replace(cfg, fleet=fp))
+        assert base["pv_max"].max() > 40.0  # the clip actually bites
+        # meter untouched by the power axis
+        np.testing.assert_array_equal(base["meter_sum"], het["meter_sum"])
+        # max(min(pv*c, L)) == min(max(pv)*c, L): f32 multiply by a
+        # positive constant and min against it are monotone, so the
+        # extremum transforms exactly
+        expect = np.minimum(base["pv_max"] * np.float32(cap),
+                            np.asarray(lim, np.float32))
+        np.testing.assert_array_equal(het["pv_max"], expect)
+        assert het["pv_max"][2] == np.float32(40.0)
+
+
+# ---------------------------------------------------------------------------
+# the partition exactness matrix (ISSUE satellite 3)
+# ---------------------------------------------------------------------------
+
+#: memoised monolithic references, keyed by config extras
+_REF = {}
+
+
+def _mono(impl="scan", **kw):
+    key = (impl,) + tuple(sorted(kw.items()))
+    if key not in _REF:
+        _REF[key] = _reduced(small_cfg(fleet=het_fleet(8),
+                                       analytics="risk",
+                                       block_impl=impl, **kw))
+    return _REF[key]
+
+
+class TestPartitions:
+    @pytest.mark.parametrize("impl", ["scan", "scan2", "wide"])
+    def test_sharded_equals_single_device(self, impl):
+        """Heterogeneous (uniform-geometry) fleet, 8 chains over 8
+        devices vs one: per-chain reductions bitwise on all three block
+        formulations; the fleet section merges with the cohort
+        contract."""
+        red1, sec1 = _mono(impl)
+        red8, sec8 = _reduced(small_cfg(fleet=het_fleet(8),
+                                        analytics="risk",
+                                        block_impl=impl),
+                              cls=ShardedSimulation)
+        _assert_reduced_equal(red1, red8)
+        _assert_fleet_equal_cohort_means_close(sec1, sec8)
+
+    def test_slab_equals_monolithic(self):
+        cfg = small_cfg(fleet=het_fleet(8), analytics="risk",
+                        duration_s=3600, block_s=1800)
+        plan = dataclasses.replace(autotune.static_plan(cfg),
+                                   slab_chains=3)  # uneven 3+3+2
+        red1, sec1 = _mono(duration_s=3600, block_s=1800)
+        reds, secs = _reduced(cfg, plan=plan)
+        _assert_reduced_equal(red1, reds)
+        _assert_fleet_equal_cohort_means_close(sec1, secs)
+
+    def test_mega_dispatch_is_fully_bitwise(self):
+        """blocks_per_dispatch fuses blocks on ONE device in the same
+        order — no reassociation anywhere, so even the cohort float
+        sums are bitwise."""
+        cfg = small_cfg(fleet=het_fleet(8), analytics="risk")
+        plan = dataclasses.replace(autotune.static_plan(cfg),
+                                   blocks_per_dispatch=2)
+        red1, sec1 = _mono()
+        redm, secm = _reduced(cfg, plan=plan)
+        _assert_reduced_equal(red1, redm)
+        assert secm == sec1
+
+    def test_cohort_counts_partition_the_fleet(self):
+        _, sec = _mono()
+        rows = sec["cohorts"]
+        assert [r["cohort"] for r in rows] == [0, 1, 2]
+        # chains per cohort (0,0,1,1,2,2,0,1) x 7200 s
+        assert [r["count"] for r in rows] == [3 * 7200, 3 * 7200,
+                                              2 * 7200]
+        assert sum(r["count"] for r in rows) == sec["count"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint config echo (ISSUE satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestCheckpointEcho:
+    def _run_and_save(self, tmp_path, cfg):
+        with use_registry(MetricsRegistry()):
+            sim = Simulation(cfg)
+            list(sim.run_blocks())
+        path = str(tmp_path / "ck.npz")
+        ckpt.save(path, sim.state, 1, cfg)
+        return path
+
+    def test_changed_fleet_refuses_resume(self, tmp_path):
+        fp = het_fleet(4)
+        cfg = small_cfg(n_chains=4, fleet=fp)
+        path = self._run_and_save(tmp_path, cfg)
+        other = dataclasses.replace(
+            fp, demand_shift_w=(0.0, 10.0, 20.0, 31.0))
+        with pytest.raises(ValueError, match="different configuration"):
+            ckpt.load(path, small_cfg(n_chains=4, fleet=other))
+        # dropping the fleet entirely also refuses
+        with pytest.raises(ValueError, match="different configuration"):
+            ckpt.load(path, small_cfg(n_chains=4))
+        # the same fleet resumes fine (digest equality, not identity)
+        state, nb = ckpt.load(path, small_cfg(n_chains=4,
+                                              fleet=het_fleet(4)))
+        assert nb == 1
+
+    def test_fleetless_checkpoint_roundtrips(self, tmp_path):
+        cfg = small_cfg(n_chains=4)
+        path = self._run_and_save(tmp_path, cfg)
+        _, nb = ckpt.load(path, cfg)
+        assert nb == 1
+        with pytest.raises(ValueError, match="different configuration"):
+            ckpt.load(path, dataclasses.replace(cfg,
+                                                fleet=het_fleet(4)))
+
+
+# ---------------------------------------------------------------------------
+# scenario serving: the site/cohort selector
+# ---------------------------------------------------------------------------
+
+def _serve_cfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=120,
+        n_chains=4,
+        seed=7,
+        block_s=60,
+        dtype="float32",
+        output="reduce",
+        block_impl="scan",
+        scan_unroll=1,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _serve_fleet():
+    n = 4
+    return FleetParams(
+        dc_capacity_scale=(1.0, 1.5, 0.8, 2.0),
+        ac_limit_w=(150.0, INF, INF, 300.0),
+        weather_regime=(0, 1, 2, 0),
+        demand_scale=(1.0, 1.2, 0.9, 1.1),
+        demand_shift_w=(0.0, 40.0, -20.0, 10.0),
+        cohort=(0, 0, 1, 1),
+        **_geom(n),
+    )
+
+
+def _req(rid, scenario, mode="reduce"):
+    return schema.Request(id=rid, reply_to="r", mode=mode,
+                          scenario=scenario)
+
+
+@pytest.fixture(scope="module")
+def fleet_engine():
+    with use_registry(MetricsRegistry()):
+        return ScenarioEngine(_serve_cfg(fleet=_serve_fleet()), (1,))
+
+
+class TestServeSelector:
+    def test_selector_parse_rejections(self):
+        ok = schema.parse_scenario({"site_index": 2}, max_horizon_s=120,
+                                   n_sites=4, n_cohorts=2)
+        assert ok.site_index == 2 and ok.cohort == -1
+        with pytest.raises(RequestError, match="expected an integer"):
+            schema.parse_scenario({"site_index": True},
+                                  max_horizon_s=120, n_sites=4)
+        with pytest.raises(RequestError, match=r"outside \[0, 4\)"):
+            schema.parse_scenario({"site_index": 4}, max_horizon_s=120,
+                                  n_sites=4)
+        with pytest.raises(RequestError, match="no site axis"):
+            schema.parse_scenario({"site_index": 0}, max_horizon_s=120)
+        with pytest.raises(RequestError, match="no cohort tags"):
+            schema.parse_scenario({"cohort": 0}, max_horizon_s=120,
+                                  n_sites=4, n_cohorts=0)
+        with pytest.raises(RequestError, match="mutually exclusive"):
+            schema.parse_scenario({"site_index": 1, "cohort": 0},
+                                  max_horizon_s=120, n_sites=4,
+                                  n_cohorts=2)
+
+    def test_engine_advertises_fleet_axes(self, fleet_engine):
+        assert fleet_engine.n_sites == 4
+        assert fleet_engine.n_cohorts == 2
+        with use_registry(MetricsRegistry()):
+            plain = ScenarioEngine(_serve_cfg(), (1,))
+        assert plain.n_sites is None
+        assert plain.n_cohorts == 0
+
+    def test_site_selected_reply_is_the_single_site_run(self,
+                                                        fleet_engine):
+        """The acceptance bar: a site-selected reduce reply must be
+        bit-identical to simulating exactly that installation alone —
+        the same chain carved out via the slab machinery (global chain
+        index preserved, fleet row sliced along)."""
+        fp = _serve_fleet()
+        sel = fleet_engine.run(
+            [_req("s", Scenario(horizon_s=120, site_index=2))])[0]
+        assert sel["site_index"] == 2
+        carve = dataclasses.replace(
+            _serve_cfg(fleet=None), n_chains=1, n_chains_total=4,
+            chain_offset=2, fleet=slice_fleet(fp, 2, 1))
+        with use_registry(MetricsRegistry()):
+            alone = ScenarioEngine(carve, (1,)).run(
+                [_req("a", Scenario(horizon_s=120))])[0]
+        assert sel["stats"] == alone["stats"]
+        assert sel["stats"]["n_seconds"] == 120
+
+    def test_cohort_selected_reply_is_the_cohort_run(self, fleet_engine):
+        """cohort=1 tags chains {2, 3} — a contiguous slab, so the
+        selected reply must equal the 2-chain carve bitwise."""
+        fp = _serve_fleet()
+        sel = fleet_engine.run(
+            [_req("c", Scenario(horizon_s=120, cohort=1))])[0]
+        assert sel["cohort"] == 1
+        carve = dataclasses.replace(
+            _serve_cfg(fleet=None), n_chains=2, n_chains_total=4,
+            chain_offset=2, fleet=slice_fleet(fp, 2, 2))
+        with use_registry(MetricsRegistry()):
+            alone = ScenarioEngine(carve, (1,)).run(
+                [_req("a", Scenario(horizon_s=120))])[0]
+        assert sel["stats"] == alone["stats"]
+        assert sel["stats"]["n_seconds"] == 240
+
+    def test_unselected_reply_has_no_selector_keys(self, fleet_engine):
+        out = fleet_engine.run([_req("n", Scenario(horizon_s=120))])[0]
+        assert "site_index" not in out and "cohort" not in out
+        assert out["stats"]["n_seconds"] == 480
+
+
+# ---------------------------------------------------------------------------
+# RunReport v12: cohorts table + config echo, tools/fleet_report.py
+# ---------------------------------------------------------------------------
+
+def _v12_doc():
+    with use_registry(MetricsRegistry()):
+        sim = Simulation(small_cfg(fleet=het_fleet(8), analytics="risk"))
+        sim.run_reduced()
+        return sim.run_report()
+
+
+class TestReportV12:
+    def test_round_trip(self):
+        doc = _v12_doc()
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 12
+        assert doc["config"]["fleet"]["n_sites"] == 8
+        assert doc["config"]["fleet"]["n_cohorts"] == 3
+        assert doc["config"]["fleet"]["digest"] == het_fleet(8).digest()
+        rows = doc["fleet"]["cohorts"]
+        assert [r["cohort"] for r in rows] == [0, 1, 2]
+        validate_report(json.loads(json.dumps(doc)))
+
+    def test_v11_documents_still_validate(self):
+        doc = _v12_doc()
+        doc["schema_version"] = 11
+        doc["fleet"].pop("cohorts")
+        doc["config"].pop("fleet")
+        validate_report(doc)
+
+    def test_cohortless_fleet_section_validates(self):
+        with use_registry(MetricsRegistry()):
+            sim = Simulation(small_cfg(analytics="risk"))
+            sim.run_reduced()
+            doc = sim.run_report()
+        assert doc["fleet"]["cohorts"] is None
+        assert doc["config"].get("fleet") is None
+        validate_report(doc)
+
+    def test_bad_cohort_rows_rejected(self):
+        doc = _v12_doc()
+        doc["fleet"]["cohorts"][1]["count"] = "many"
+        with pytest.raises(ValueError, match="cohort"):
+            validate_report(doc)
+
+    def test_fleet_report_tool_prints_cohort_table(self, tmp_path):
+        path = tmp_path / "report.json"
+        path.write_text(json.dumps(_v12_doc()))
+        r = subprocess.run([sys.executable, str(FLEET_REPORT),
+                            str(path)], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "cohort" in r.stdout
+
+    def test_fleet_report_tool_rejects_broken_partition(self, tmp_path):
+        doc = _v12_doc()
+        doc["fleet"]["cohorts"][0]["count"] += 1  # no longer partitions
+        path = tmp_path / "report.json"
+        path.write_text(json.dumps(doc))
+        r = subprocess.run([sys.executable, str(FLEET_REPORT),
+                            str(path)], capture_output=True, text=True)
+        assert r.returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_fleet_synth_end_to_end(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tmhpvsim_tpu.cli import main as cli_main
+
+        out = tmp_path / "fleet.csv"
+        r = CliRunner().invoke(cli_main, [
+            "pvsim", str(out), "--backend=jax", "--no-realtime",
+            "--duration", "120", "--block-s", "60", "--seed", "5",
+            "--fleet-synth", "4", "--fleet-seed", "1",
+            "--output", "reduce", "--start", "2019-09-05 10:00:00",
+        ])
+        assert r.exit_code == 0, r.output
+        lines = out.read_text().strip().splitlines()
+        assert len(lines) == 1 + 4 + 1  # header + 4 chains + ensemble
+
+    def test_fleet_flags_are_exclusive(self):
+        from click.testing import CliRunner
+
+        from tmhpvsim_tpu.cli import main as cli_main
+
+        r = CliRunner().invoke(cli_main, [
+            "pvsim", "out.csv", "--backend=jax", "--fleet-synth", "4",
+            "--sites-csv", "README.md",
+        ])
+        assert r.exit_code != 0
+        assert "mutually exclusive" in r.output

@@ -240,6 +240,32 @@ class TestSiteGridFromCsv:
         with pytest.raises(ValueError, match="no data rows"):
             SiteGrid.from_csv(path)
 
+    @pytest.mark.parametrize("header,row,match", [
+        ("latitude,longitude", "95.0,11.6",
+         r"line 3: latitude=95\.0 outside \[-90, 90\]"),
+        ("latitude,longitude", "48.1,191.0",
+         r"line 3: longitude=191\.0 outside"),
+        ("latitude,longitude,albedo", "48.1,11.6,1.5",
+         r"line 3: albedo=1\.5 outside \[0, 1\]"),
+        ("latitude,longitude,surface_tilt", "48.1,11.6,120",
+         r"line 3: surface_tilt=120\.0 outside"),
+    ])
+    def test_out_of_range_value_names_the_line(self, tmp_path, header,
+                                               row, match):
+        """Physically impossible values are refused with the offending
+        CSV line number — an asset register with one typo'd row among
+        thousands must point AT the row, not just fail."""
+        path = self._write(tmp_path,
+                           f"{header}\n48.1,11.6{',0.2' * (header.count(',') - 1)}\n{row}\n")
+        with pytest.raises(ValueError, match=match):
+            SiteGrid.from_csv(path)
+
+    def test_non_finite_value_rejected(self, tmp_path):
+        path = self._write(tmp_path,
+                           "latitude,longitude\n48.1,11.6\nnan,11.6\n")
+        with pytest.raises(ValueError, match="line 3"):
+            SiteGrid.from_csv(path)
+
     def test_cli_sites_csv_end_to_end(self, tmp_path):
         from click.testing import CliRunner
 

@@ -413,6 +413,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               block_s: Optional[int] = None,
               realtime: bool = False,
               site_grid=None,
+              fleet=None,
               profile_dir: Optional[str] = None,
               output: str = "trace",
               prng_impl: str = "threefry2x32",
@@ -541,6 +542,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 file, duration_s, n_chains, seed, start=start,
                 chain=chain, sharded=sharded, checkpoint=checkpoint,
                 block_s=block_s, realtime=realtime, site_grid=site_grid,
+                fleet=fleet,
                 profile_dir=profile_dir, output=output,
                 prng_impl=prng_impl, block_impl=block_impl, tune=tune,
                 telemetry=telemetry, telemetry_strict=telemetry_strict,
@@ -624,6 +626,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    block_s: Optional[int] = None,
                    realtime: bool = False,
                    site_grid=None,
+                   fleet=None,
                    profile_dir: Optional[str] = None,
                    output: str = "trace",
                    prng_impl: str = "threefry2x32",
@@ -725,6 +728,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         seed=seed,
         block_s=block_s,
         site_grid=site_grid,
+        fleet=fleet,
         output=output,
         prng_impl=prng_impl,
         block_impl=block_impl,

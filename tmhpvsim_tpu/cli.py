@@ -241,6 +241,26 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "longitude [, altitude, surface_tilt, surface_azimuth, "
                    "albedo]) — one chain per row (jax backend; overrides "
                    "--chains; mutually exclusive with --site-grid)")
+@click.option("--fleet-csv", "fleet_csv", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="Heterogeneous fleet from a CSV (columns latitude, "
+                   "longitude [, altitude, surface_tilt, surface_azimuth, "
+                   "albedo, dc_capacity_scale, ac_limit_w, weather_regime, "
+                   "demand_scale, demand_shift_w, cohort]) — one chain per "
+                   "row, per-site parameters on device (jax backend; "
+                   "overrides --chains; mutually exclusive with "
+                   "--site-grid/--sites-csv; fleet/params.py)")
+@click.option("--fleet-synth", "fleet_synth", type=int, default=None,
+              metavar="N",
+              help="Synthetic seeded national fleet of N sites — geometry, "
+                   "inverter limits, weather regimes and demand profiles "
+                   "sampled reproducibly (jax backend; overrides --chains; "
+                   "mutually exclusive with --fleet-csv; "
+                   "fleet.FleetParams.synthetic)")
+@click.option("--fleet-seed", "fleet_seed", type=int, default=0,
+              show_default=True,
+              help="seed of the --fleet-synth sampler (independent of "
+                   "--seed, which drives the weather/demand draws)")
 @click.option("--profile", "profile_dir", default=None,
               help="Write a jax.profiler device trace to this directory "
                    "(jax backend; view in TensorBoard/Perfetto)")
@@ -389,7 +409,8 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
 @_chaos_options
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           start, trace, backend, n_chains, chain, sharded, checkpoint,
-          block_s, site_grid_spec, sites_csv, profile_dir, output,
+          block_s, site_grid_spec, sites_csv, fleet_csv, fleet_synth,
+          fleet_seed, profile_dir, output,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
           analytics, metrics_path, run_report_path, compile_cache,
           blocks_per_dispatch, compute_dtype, kernel_impl, rng_batch,
@@ -407,6 +428,19 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
     if site_grid_spec and sites_csv:
         raise click.UsageError("--site-grid and --sites-csv are mutually "
                                "exclusive")
+    if (fleet_csv or fleet_synth is not None) and backend != "jax":
+        raise click.UsageError("--fleet-csv/--fleet-synth require "
+                               "--backend=jax")
+    if fleet_csv and fleet_synth is not None:
+        raise click.UsageError("--fleet-csv and --fleet-synth are mutually "
+                               "exclusive")
+    if (fleet_csv or fleet_synth is not None) and \
+            (site_grid_spec or sites_csv):
+        raise click.UsageError("--fleet-csv/--fleet-synth carry their own "
+                               "geometry and are mutually exclusive with "
+                               "--site-grid/--sites-csv")
+    if fleet_synth is not None and fleet_synth < 1:
+        raise click.UsageError("--fleet-synth must be >= 1")
     if profile_dir and backend != "jax":
         raise click.UsageError("--profile requires --backend=jax")
     if output != "trace" and backend != "jax":
@@ -460,6 +494,16 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                 raise click.UsageError(str(e)) from e
         else:
             site_grid = _parse_site_grid(site_grid_spec)
+        fleet = None
+        if fleet_csv or fleet_synth is not None:
+            from tmhpvsim_tpu.fleet import FleetParams
+
+            try:
+                fleet = (FleetParams.from_csv(fleet_csv) if fleet_csv
+                         else FleetParams.synthetic(fleet_synth,
+                                                    seed=fleet_seed))
+            except ValueError as e:
+                raise click.UsageError(str(e)) from e
         if seed is None:
             from tmhpvsim_tpu.engine import checkpoint as _ckpt
 
@@ -478,7 +522,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                 seed = secrets.randbits(31)
         pvsim_jax(file, duration_s, n_chains, seed, start, chain,
                   sharded, checkpoint, block_s, realtime=realtime,
-                  site_grid=site_grid, profile_dir=profile_dir,
+                  site_grid=site_grid, fleet=fleet,
+                  profile_dir=profile_dir,
                   output=output, prng_impl=prng_impl,
                   block_impl=block_impl, tune=tune,
                   telemetry=telemetry,

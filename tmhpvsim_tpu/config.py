@@ -36,6 +36,31 @@ _SITE_CSV_COLUMNS = frozenset({
     "surface_azimuth", "albedo",
 })
 
+#: valid ranges for the geometry columns, inclusive: a CSV row outside
+#: them is a data-entry error that must be refused by line, never fed
+#: into the solar-geometry chain as silent NaN/garbage
+_SITE_CSV_RANGES = {
+    "latitude": (-90.0, 90.0),
+    "longitude": (-180.0, 180.0),
+    "altitude": (-430.0, 9000.0),
+    "surface_tilt": (0.0, 90.0),
+    "surface_azimuth": (0.0, 360.0),
+    "albedo": (0.0, 1.0),
+}
+
+
+def _check_csv_range(path, line_num, name, value) -> None:
+    rng = _SITE_CSV_RANGES.get(name)
+    if rng is None:
+        return
+    lo, hi = rng
+    import math as _math
+
+    if not (_math.isfinite(value) and lo <= value <= hi):
+        raise ValueError(
+            f"{path} line {line_num}: {name}={value!r} outside "
+            f"[{lo:g}, {hi:g}]")
+
 
 @dataclasses.dataclass(frozen=True)
 class SiteGrid:
@@ -106,6 +131,7 @@ class SiteGrid:
                             f"{path} line {reader.line_num}: bad value "
                             f"{v!r} for {k}"
                         ) from None
+                    _check_csv_range(path, reader.line_num, k, vals[k])
                 if "latitude" not in vals or "longitude" not in vals:
                     raise ValueError(
                         f"{path} line {reader.line_num}: latitude and "
@@ -336,6 +362,15 @@ class SimConfig:
     site: Site = dataclasses.field(default_factory=Site)
     #: per-chain sites (overrides `site`/`n_chains`: chain i = grid site i)
     site_grid: Optional[SiteGrid] = None
+    #: heterogeneous fleet: per-site geometry + capacity/inverter/weather-
+    #: regime/demand columns and cohort tags as one batched pytree on the
+    #: chain axis (tmhpvsim_tpu.fleet.FleetParams; chain i = fleet row i,
+    #: overrides `n_chains`).  A non-uniform-geometry fleet derives
+    #: `site_grid` at engine construction; a uniform one lowers onto the
+    #: scalar `site` path (byte-identical HLO when the electrical /
+    #: stochastic columns are neutral).  Typed Optional[object] only to
+    #: avoid a config -> fleet -> config import cycle.
+    fleet: Optional[object] = None
     options: ModelOptions = dataclasses.field(default_factory=ModelOptions)
 
     #: meter demand upper bound [W]; reference draws uniform [0, 9000)

@@ -10,6 +10,7 @@ by exact SAM database rows when the ``TMHPVSIM_SAM_MODULES`` /
 from tmhpvsim_tpu.data.parameters import (  # noqa: F401
     MARKOV_STEP_BINS,
     MARKOV_STEP_PARAMS,
+    MARKOV_STEP_PARAMS_REGIMES,
     SAPM_MODULE,
     SANDIA_INVERTER,
     LINKE_TURBIDITY_MONTHLY_MUNICH,

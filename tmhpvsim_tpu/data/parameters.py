@@ -66,6 +66,60 @@ MARKOV_STEP_PARAMS = (
 )
 
 # --------------------------------------------------------------------------
+# 1b. Weather-regime step-distribution tables (heterogeneous fleets).
+#
+# A fleet spanning a country does not share one cloud climate: the
+# per-site ``weather_regime`` id in ``tmhpvsim_tpu.fleet.FleetParams``
+# selects which of the tables below drives that chain's hourly Markov
+# step.  Regime 0 is EXACTLY the vendored Munich fit above
+# (``MARKOV_STEP_PARAMS`` — byte-identical rows, so a regime-0-only
+# fleet reproduces the single-table simulation bit for bit).  Regimes 1
+# and 2 are plausible same-shape refits for contrasting climates (the
+# re-fitting tool in ``offline/fitting.py`` produces rows of this exact
+# encoding from any ERA-5 cell):
+#
+# * regime 1 "maritime": faster, larger steps with a bias toward high
+#   cover — North-Sea-coast-like variability (broader scales, kappa < 1
+#   in mid bins pulls steps upward).
+# * regime 2 "continental-dry": slow, small steps biased toward clearing
+#   — Iberian-plateau-like persistence of clear skies.
+#
+# All tables share ``MARKOV_STEP_BINS`` and the (loc, scale, kappa, df,
+# is_t) row encoding, so device-side regime selection is one gather on
+# a stacked (n_regimes, 6, 5) tensor (models/markov_hourly.py
+# ``regime_step_params``).
+# --------------------------------------------------------------------------
+
+#: Regime 1: maritime / coastal — broader steps, bias toward overcast.
+MARKOV_STEP_PARAMS_MARITIME = (
+    (2.1e-03, 0.05210, 0.5480, 1.0, 0.0),
+    (-3.05e-02, 0.14630, 0.5910, 1.0, 0.0),
+    (2.84e-02, 0.21080, 1.0, 8.92, 1.0),
+    (8.93e-02, 0.12740, 1.4210, 1.0, 0.0),
+    (3.11e-02, 0.05890, 1.6730, 1.0, 0.0),
+    (6.2e-06, 0.00941, 1.9820, 1.0, 0.0),
+)
+
+#: Regime 2: continental-dry — small steps, bias toward clearing.
+MARKOV_STEP_PARAMS_CONTINENTAL_DRY = (
+    (-8.4e-04, 0.02110, 0.7150, 1.0, 0.0),
+    (-5.62e-02, 0.08120, 0.7890, 1.0, 0.0),
+    (-1.12e-02, 0.14210, 1.0, 13.34, 1.0),
+    (6.01e-02, 0.08930, 1.9470, 1.0, 0.0),
+    (1.48e-02, 0.03120, 2.2910, 1.0, 0.0),
+    (9.1e-07, 0.00442, 2.6120, 1.0, 0.0),
+)
+
+#: Stacked regime tables, indexed by ``FleetParams.weather_regime``.
+#: Regime 0 IS ``MARKOV_STEP_PARAMS`` (same tuple object), so the
+#: homogeneous path and a regime-0 fleet draw identical steps.
+MARKOV_STEP_PARAMS_REGIMES = (
+    MARKOV_STEP_PARAMS,
+    MARKOV_STEP_PARAMS_MARITIME,
+    MARKOV_STEP_PARAMS_CONTINENTAL_DRY,
+)
+
+# --------------------------------------------------------------------------
 # 2. PV hardware coefficients.
 # --------------------------------------------------------------------------
 

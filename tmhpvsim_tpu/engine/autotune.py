@@ -41,6 +41,7 @@ import tempfile
 import time
 
 from tmhpvsim_tpu.config import Plan, SimConfig, slice_grid
+from tmhpvsim_tpu import fleet as fleet_mod
 
 logger = logging.getLogger(__name__)
 
@@ -310,6 +311,8 @@ def probe_plan(config: SimConfig, plan: Plan,
         n_chains_total=None,
         chain_offset=0,
         site_grid=slice_grid(config.site_grid, 0, n),
+        fleet=(fleet_mod.slice_fleet(config.fleet, 0, n)
+               if config.fleet is not None else None),
         # k blocks per dispatch: the probe must cover one warm-up
         # dispatch plus n_timed timed ones (time_reduce_blocks)
         duration_s=config.block_s * k * (n_timed + 1),
@@ -405,6 +408,8 @@ def _sentinel_gate(config: SimConfig, plan: Plan) -> bool:
         n_chains_total=None,
         chain_offset=0,
         site_grid=slice_grid(config.site_grid, 0, n),
+        fleet=(fleet_mod.slice_fleet(config.fleet, 0, n)
+               if config.fleet is not None else None),
         duration_s=config.block_s * SENTINEL_GATE_BLOCKS,
         output="reduce",
         telemetry="light",
@@ -550,11 +555,19 @@ def plan_key(config: SimConfig) -> str:
     import jax
 
     dev = jax.devices()[0]
-    return "|".join(str(x) for x in (
+    parts = [
         dev.device_kind, jax.default_backend(), config.n_chains,
         config.block_s, config.dtype, config.prng_impl,
         AUTOTUNE_ENGINE_VERSION,
-    ))
+    ]
+    # chains stopped being exchangeable once fleets landed: a plan tuned
+    # for one parameter mix must not be replayed onto another, so the
+    # fleet shape + content digest joins the key (fleet-less configs keep
+    # their historical keys — cache entries stay warm across the upgrade)
+    if getattr(config, "fleet", None) is not None:
+        parts.append(
+            f"fleet{len(config.fleet)}-{config.fleet.digest()[:12]}")
+    return "|".join(str(x) for x in parts)
 
 
 def _load_cache(path: str) -> dict:
@@ -791,6 +804,8 @@ def resolve_plan_for_mesh(config: SimConfig, n_dev: int) -> Plan:
             n_chains_total=None,
             chain_offset=0,
             site_grid=slice_grid(config.site_grid, 0, per_dev),
+            fleet=(fleet_mod.slice_fleet(config.fleet, 0, per_dev)
+                   if config.fleet is not None else None),
         )
         if jax.process_count() > 1 and jax.process_index() != 0:
             plan = static_plan(pcfg)  # replaced by the broadcast below

@@ -139,6 +139,12 @@ def _config_echo(config) -> dict:
         "output": config.output,
         "options": dataclasses.asdict(config.options),
         "meter_max_w": config.meter_max_w,
+        # fleet identity rides as size + content digest rather than the
+        # full column dump: a national fleet is millions of rows, and the
+        # digest refuses on ANY per-site parameter drift just the same
+        "fleet": ({"n": len(config.fleet),
+                   "digest": config.fleet.digest()}
+                  if getattr(config, "fleet", None) is not None else None),
     }
 
 
@@ -416,6 +422,7 @@ def _check_config(meta: dict, config) -> None:
     # to the current version, so pre-v2 checkpoints are refused rather
     # than resumed onto a different random stream
     saved.setdefault("rng_stream", 1)
+    saved.setdefault("fleet", None)
     current = json.loads(json.dumps(_config_echo(config)))  # tuple->list
     if saved != current:
         keys = set(saved) | set(current)

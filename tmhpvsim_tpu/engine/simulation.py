@@ -55,6 +55,7 @@ from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs import telemetry as tel
 from tmhpvsim_tpu.obs.profiler import BlockTimer, annotate
 from tmhpvsim_tpu.models import clearsky_index as ci
+from tmhpvsim_tpu.models import markov_hourly as mh
 from tmhpvsim_tpu.models import pv as pvmod
 from tmhpvsim_tpu.models import renewal
 from tmhpvsim_tpu.models import solar
@@ -174,6 +175,27 @@ class Simulation:
     def __init__(self, config: SimConfig, plan=None):
         if config.block_s % 60 != 0:
             raise ValueError("block_s must be a multiple of 60 (minute grid)")
+        # Heterogeneous fleet (fleet/params.py): chain i simulates fleet
+        # row i.  Non-uniform geometry derives the site grid; a
+        # geometry-uniform fleet lowers onto the scalar-site path (its
+        # shared Site and n_chains come from the fleet) so the traced
+        # graph stays byte-identical to the no-fleet run.  A config that
+        # already carries a site_grid of the same length (autotune probe
+        # carves, explicit pairings) passes through untouched.
+        if config.fleet is not None:
+            fp = config.fleet
+            if config.site_grid is None:
+                if fp.uniform_geometry:
+                    config = dataclasses.replace(
+                        config, n_chains=len(fp), site=fp.uniform_site())
+                else:
+                    config = dataclasses.replace(
+                        config, site_grid=fp.site_grid())
+            elif len(config.site_grid) != len(fp):
+                raise ValueError(
+                    f"fleet has {len(fp)} sites but site_grid has "
+                    f"{len(config.site_grid)} — they must pair 1:1 on "
+                    "the chain axis")
         if config.site_grid is not None and \
                 config.n_chains != len(config.site_grid):
             config = dataclasses.replace(
@@ -378,6 +400,28 @@ class Simulation:
                 self._scan2_acc_fleet_jit = jax.jit(
                     self._block_step_scan2_acc_fleet, donate_argnums=(0, 2))
             self._wide_fleet_jit = jax.jit(self._wide_fleet)
+        #: heterogeneous-fleet gating (fleet/params.py): host-static
+        #: flags decide which per-chain parameter leaves enter the state
+        #: pytree (init_state) and which transforms are traced into the
+        #: block steps.  An absent fleet — or one whose column is
+        #: uniform at the neutral value — sets no flag, adds no leaf and
+        #: traces no transform, so the homogeneous path lowers to
+        #: byte-identical HLO vs the scalar configuration
+        #: (tests/test_fleet.py).
+        fp = config.fleet
+        self._fleet = fp
+        self._het_demand = fp is not None and fp.het_demand
+        self._het_power = fp is not None and fp.het_power
+        self._het_regime = fp is not None and fp.het_regime
+        #: stacked per-regime Markov step tables, built only when some
+        #: chain leaves regime 0 (row 0 is the Munich fit byte-for-byte)
+        self._regime_params = (mh.regime_step_params(self.dtype)
+                               if self._het_regime else None)
+        #: per-cohort analytics group-by (obs/analytics.py): active only
+        #: when analytics is on AND the fleet has >= 2 cohorts
+        self._n_cohorts = (fp.n_cohorts
+                           if fp is not None and self._analytics != "off"
+                           and fp.n_cohorts > 1 else 0)
         #: multi-block fused dispatch factor (Plan.blocks_per_dispatch):
         #: K consecutive blocks run as one outer lax.scan in a single
         #: jit, so the host pays one dispatch per K blocks.  getattr:
@@ -441,16 +485,20 @@ class Simulation:
         dtype = self.dtype
         grid = self.config.site_grid
 
-        def one(key):
+        def one(key, regime=None):
             k_arr, k_min, k_renew, k_scan, k_meter = jax.random.split(key, 5)
             k_cc, k_cloudy, _k_day, k_ws = jax.random.split(k_arr, 4)
             # construction-time primer values (global indices 0, 1): the
             # renewal process starts from the samplers' *before* values
             # (clearskyindexmodel.py:98-99), cc0 is the construction-time
             # cloud-cover interpolation every k<2 cloudy draw sees, and
-            # the cloudy pair is what compat mode interpolates forever
+            # the cloudy pair is what compat mode interpolates forever.
+            # Heterogeneous weather regimes prime from the chain's own
+            # step table (regime 0 == the default table byte-for-byte).
+            params = (None if regime is None
+                      else mh.select_regime(self._regime_params, regime))
             cc01, _ = ci.cc_window(k_cc, 0, 2, jnp.asarray(1.0, dtype),
-                                   opts, dtype)
+                                   opts, dtype, params=params)
             cc0 = cc01[0] * (1 - feats.f0_hour) + cc01[1] * feats.f0_hour
             ws0 = ci.ws_window(k_ws, 0, 1, dtype)[0]
             carry = renewal.init(k_renew, cc01[0], ws0, dtype)
@@ -478,7 +526,34 @@ class Simulation:
             if total != cfg.n_chains or cfg.chain_offset:
                 keys = keys[cfg.chain_offset:cfg.chain_offset
                             + cfg.n_chains]
-            state = jax.vmap(one)(keys)
+            fp = self._fleet
+            regime = (jnp.asarray(fp.weather_regime, jnp.int32)
+                      if self._het_regime else None)
+            state = (jax.vmap(one)(keys, regime)
+                     if regime is not None else jax.vmap(one)(keys))
+            # Heterogeneous fleet leaves (only the columns that ARE
+            # heterogeneous — the absent-key discipline keeps the
+            # homogeneous traced graph byte-identical): like the site
+            # scalars below, they live in the state pytree so they get
+            # the chain sharding, ride through shard_map specs, and land
+            # in checkpoints without special-casing.  Broadcast rule:
+            # leaf i pairs with chain i; slabs/shards carry the slice
+            # their chains own (slice_fleet).
+            fleet = {}
+            if self._het_demand:
+                fleet["demand_scale"] = jnp.asarray(fp.demand_scale, dtype)
+                fleet["demand_shift_w"] = jnp.asarray(fp.demand_shift_w,
+                                                      dtype)
+            if self._het_power:
+                fleet["pv_scale"] = jnp.asarray(fp.dc_capacity_scale,
+                                                dtype)
+                fleet["ac_limit_w"] = jnp.asarray(fp.ac_limit_w, dtype)
+            if regime is not None:
+                fleet["regime"] = regime
+            if self._n_cohorts:
+                fleet["cohort"] = jnp.asarray(fp.cohort, jnp.int32)
+            if fleet:
+                state["fleet"] = fleet
             if grid is not None:
                 # per-chain site parameters live in the state pytree: they
                 # get the chain sharding, ride through shard_map specs, and
@@ -689,8 +764,15 @@ class Simulation:
         win = inputs["win"]
         k_cc, k_cloudy, k_day, k_ws = jax.random.split(chain["k_arr"], 4)
 
+        # heterogeneous weather regimes: gather this chain's Markov step
+        # table from the stacked regime leaves (one (R, 6)->(6,) take per
+        # leaf under the chain vmap); None traces the historical graph
+        params = (mh.select_regime(self._regime_params,
+                                   chain["fleet"]["regime"])
+                  if self._het_regime else None)
         cc_w, _ = ci.cc_window(k_cc, win["hour_lo"], self._w_hours,
-                               chain["cc_carry"], cfg.options, dtype)
+                               chain["cc_carry"], cfg.options, dtype,
+                               params=params)
         nxt, lo = win["hour_next_lo"], win["hour_lo"]
         adv = jnp.clip(nxt - lo - 1, 0, self._w_hours - 1)
         cc_carry = jnp.where(nxt == lo, chain["cc_carry"], cc_w[adv])
@@ -802,6 +884,15 @@ class Simulation:
             meter = (pre["meter"] if pre is not None else ci.meter_block(
                 chain["k_meter"], block_idx["t"], cfg.meter_max_w, dtype
             ))
+            # heterogeneous per-site transforms (fleet/params.py): DC
+            # capacity scale + inverter AC clip on pv, demand scale/shift
+            # on the meter — traced only when the column is heterogeneous
+            if self._het_power:
+                fl = chain["fleet"]
+                ac = jnp.minimum(ac * fl["pv_scale"], fl["ac_limit_w"])
+            if self._het_demand:
+                fl = chain["fleet"]
+                meter = meter * fl["demand_scale"] + fl["demand_shift_w"]
             return dict(chain, carry=carry, cc_carry=cc_carry), meter, ac
 
         pre = None
@@ -1046,6 +1137,10 @@ class Simulation:
         if predraw:
             xs.update(u=u_T, z=z_T, meter=meter_T)
 
+        fl = state.get("fleet")
+        fl_power = fl if self._het_power else None
+        fl_demand = fl if self._het_demand else None
+
         def step(rc, x):
             rc, csi, covered = ci.csi_compose_step(
                 tables, x, rc, opts, dtype
@@ -1081,10 +1176,21 @@ class Simulation:
                 csi_c, g, SAPM_MODULE, SANDIA_INVERTER, xp=jnp,
                 kernels=self._kernels,
             ).astype(dtype)
+            meter = x["meter"].astype(dtype)
+            # heterogeneous per-site transforms: (n_chains,) fleet leaves
+            # bound at setup, elementwise against the per-second vectors;
+            # neither branch traces anything when the fleet is absent or
+            # the column homogeneous (byte-identical scan body)
+            if fl_power is not None:
+                ac = jnp.minimum(ac * fl_power["pv_scale"],
+                                 fl_power["ac_limit_w"])
+            if fl_demand is not None:
+                meter = (meter * fl_demand["demand_scale"]
+                         + fl_demand["demand_shift_w"])
             if with_extras:
-                return (rc, x["meter"].astype(dtype), ac,
+                return (rc, meter, ac,
                         {"csi": csi, "covered": covered})
-            return rc, x["meter"].astype(dtype), ac
+            return rc, meter, ac
 
         return xs, step, cc_carry
 
@@ -1225,13 +1331,22 @@ class Simulation:
         return tel.fold_wide(ta, self._telemetry, meter=meter, pv=pv,
                              t=t, duration_s=self.config.duration_s)
 
-    def _make_acc_fleet_body(self, step):
+    def _cohort_ids(self, state):
+        """The (n_chains,) int32 cohort-id vector for the analytics
+        group-by, or None when cohorts are off.  Read from the STATE
+        pytree, not ``self._fleet`` — under shard_map/slabs the state
+        carries exactly the chains this shard owns, so the ids always
+        pair 1:1 with the fold's vectors."""
+        return state["fleet"]["cohort"] if self._n_cohorts else None
+
+    def _make_acc_fleet_body(self, step, cohort=None):
         """Fleet-analytics variant of ``_make_acc_body``: the same
         statistics fold (duplicated verbatim, same reasoning as
         ``_make_acc_tel_body``) plus the FleetAcc fold on a second carry
         passenger.  ``step`` must come from
         ``_scan_block_setup(..., with_extras=True)`` (the 'covered'
-        regime mask; at level 'risk' it is DCE'd)."""
+        regime mask; at level 'risk' it is DCE'd).  ``cohort``: per-chain
+        group ids for the per-cohort sketches (None folds none)."""
         cfg = self.config
         dtype = self.dtype
         big = jnp.asarray(jnp.finfo(dtype).max, dtype)
@@ -1259,12 +1374,13 @@ class Simulation:
             fa = flt.fold_second(
                 fa, level, params, meter=meter, pv=ac, residual=residual,
                 covered=extras["covered"], t=x["t"], valid=valid,
+                cohort=cohort,
             )
             return ((rc, st), fa), None
 
         return body
 
-    def _make_acc_tel_fleet_body(self, step):
+    def _make_acc_tel_fleet_body(self, step, cohort=None):
         """Both passengers at once (telemetry AND analytics on): the
         stats fold, the TelemetryAcc fold and the FleetAcc fold in one
         scan body, so the carry stays a single scan."""
@@ -1300,6 +1416,7 @@ class Simulation:
             fa = flt.fold_second(
                 fa, level, params, meter=meter, pv=ac, residual=residual,
                 covered=extras["covered"], t=x["t"], valid=valid,
+                cohort=cohort,
             )
             return ((rc, st), ta, fa), None
 
@@ -1315,9 +1432,11 @@ class Simulation:
                                                     with_extras=True)
         n = state["carry"]["sec"].shape[0]
         fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
-                           params=self._fleet_params)
+                           params=self._fleet_params,
+                           cohorts=self._n_cohorts)
         ((rcarry, acc), fa), _ = jax.lax.scan(
-            self._make_acc_fleet_body(step), ((state["carry"], acc), fa0),
+            self._make_acc_fleet_body(step, self._cohort_ids(state)),
+            ((state["carry"], acc), fa0),
             xs, unroll=self._unroll,
         )
         return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
@@ -1329,7 +1448,8 @@ class Simulation:
         xs, step, cc_carry = self._scan_block_setup(state, inputs,
                                                     predraw=(self._rng_batch == "block"),
                                                     with_extras=True)
-        inner_body = self._make_acc_fleet_body(step)
+        inner_body = self._make_acc_fleet_body(step,
+                                               self._cohort_ids(state))
 
         def inner(carry, xs_inner):
             return jax.lax.scan(inner_body, carry, xs_inner,
@@ -1337,7 +1457,8 @@ class Simulation:
 
         n = state["carry"]["sec"].shape[0]
         fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
-                           params=self._fleet_params)
+                           params=self._fleet_params,
+                           cohorts=self._n_cohorts)
         ((rcarry, acc), fa), _ = self._scan2_outer(
             state, xs, inner, ((state["carry"], acc), fa0)
         )
@@ -1352,9 +1473,10 @@ class Simulation:
         n = state["carry"]["sec"].shape[0]
         ta0 = tel.init_acc(self._telemetry, self.dtype, n_chains=n)
         fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
-                           params=self._fleet_params)
+                           params=self._fleet_params,
+                           cohorts=self._n_cohorts)
         ((rcarry, acc), ta, fa), _ = jax.lax.scan(
-            self._make_acc_tel_fleet_body(step),
+            self._make_acc_tel_fleet_body(step, self._cohort_ids(state)),
             ((state["carry"], acc), ta0, fa0), xs, unroll=self._unroll,
         )
         return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
@@ -1366,7 +1488,8 @@ class Simulation:
         xs, step, cc_carry = self._scan_block_setup(state, inputs,
                                                     predraw=(self._rng_batch == "block"),
                                                     with_extras=True)
-        inner_body = self._make_acc_tel_fleet_body(step)
+        inner_body = self._make_acc_tel_fleet_body(step,
+                                                   self._cohort_ids(state))
 
         def inner(carry, xs_inner):
             return jax.lax.scan(inner_body, carry, xs_inner,
@@ -1375,22 +1498,26 @@ class Simulation:
         n = state["carry"]["sec"].shape[0]
         ta0 = tel.init_acc(self._telemetry, self.dtype, n_chains=n)
         fa0 = flt.init_acc(self._analytics, self.dtype, n_chains=n,
-                           params=self._fleet_params)
+                           params=self._fleet_params,
+                           cohorts=self._n_cohorts)
         ((rcarry, acc), ta, fa), _ = self._scan2_outer(
             state, xs, inner, ((state["carry"], acc), ta0, fa0)
         )
         return (dict(state, carry=rcarry, cc_carry=cc_carry), acc,
                 tel.reduce_chainwise(ta), flt.reduce_chainwise(fa))
 
-    def _wide_fleet(self, meter, pv, t):
+    def _wide_fleet(self, meter, pv, t, cohort=None):
         """Fleet fold over the wide impl's materialised block arrays
         (scalar-form acc; the wide producer never materialises the cloud
-        state, so the 'full' regime leaves stay unobserved)."""
+        state, so the 'full' regime leaves stay unobserved).  ``cohort``:
+        per-chain group ids matching the meter/pv chain axis."""
         fa = flt.init_acc(self._analytics, self.dtype,
-                          params=self._fleet_params)
+                          params=self._fleet_params,
+                          cohorts=self._n_cohorts)
         return flt.fold_wide(fa, self._analytics, self._fleet_params,
                              meter=meter, pv=pv, t=t,
-                             duration_s=self.config.duration_s)
+                             duration_s=self.config.duration_s,
+                             cohort=cohort)
 
     def _scan2_outer(self, state, xs, inner, carry0):
         """The nested ('scan2') outer scan, shared by the reduce and
@@ -1589,7 +1716,10 @@ class Simulation:
             t = inputs["block_idx"]["t"]
             if tel_on:
                 self._tel_last = self._wide_tel_jit(meter, pv, t)
-            fa = self._wide_fleet_jit(meter, pv, t)
+            fa = (self._wide_fleet_jit(meter, pv, t,
+                                       self._cohort_ids(state))
+                  if self._n_cohorts
+                  else self._wide_fleet_jit(meter, pv, t))
             # last: _stats_acc_jit donates the meter/pv buffers
             acc = self._stats_acc_jit(meter, pv, t, acc)
         self._fleet_last = fa
@@ -1635,6 +1765,10 @@ class Simulation:
         f = jax.ShapeDtypeStruct((b,), self.dtype)
         scen = {k: f for k in SCENARIO_FLOAT_KNOBS}
         scen["horizon_s"] = jax.ShapeDtypeStruct((b,), jnp.int32)
+        # bounded site selector (serve/schema.py): -1 = whole fleet,
+        # else restrict the fold to one chain / one cohort
+        scen["site_index"] = jax.ShapeDtypeStruct((b,), jnp.int32)
+        scen["cohort"] = jax.ShapeDtypeStruct((b,), jnp.int32)
         return scen
 
     def _block_step_scan_scenario(self, state, inputs, acc, scen):
@@ -1669,6 +1803,15 @@ class Simulation:
         facc = jax.tree.map(
             lambda l: jnp.broadcast_to(l, (batch,) + l.shape),
             flt.init_acc("risk", dtype, cfg.n_chains, params=params))
+        # bounded site selector: chain iota vs the request's site index /
+        # cohort tag.  -1 selects everything (an all-true mask folds the
+        # same values, so whole-fleet replies are unchanged).  Closure
+        # constants are safe here: the scenario jit never runs sharded
+        # (ScenarioEngine always wraps a plain Simulation).
+        iota = jnp.arange(cfg.n_chains, dtype=jnp.int32)
+        cohort_arr = (jnp.asarray(self._fleet.cohort, jnp.int32)
+                      if self._fleet is not None
+                      and self._fleet.n_cohorts > 1 else None)
 
         def body(carry, x):
             rc, st, fa = carry
@@ -1682,7 +1825,11 @@ class Simulation:
                     ac * (sc["pv_scale"] * sc["weather_bias"]),
                     sc["curtail_w"])
                 residual = meter_i - pv_i
-                valid = base_valid & (t < sc["horizon_s"])
+                sel = (sc["site_index"] < 0) | (iota == sc["site_index"])
+                if cohort_arr is not None:
+                    sel = sel & ((sc["cohort"] < 0)
+                                 | (cohort_arr == sc["cohort"]))
+                valid = sel & base_valid & (t < sc["horizon_s"])
                 vz = jnp.where(valid, 1.0, 0.0).astype(dtype)
                 st_i = {
                     "pv_sum": st_i["pv_sum"] + pv_i * vz,
@@ -1843,7 +1990,8 @@ class Simulation:
             def wide_fleet(state, inputs, acc):
                 state, meter, pv = self._block_step(state, inputs)
                 t = inputs["block_idx"]["t"]
-                fa = self._wide_fleet(meter, pv, t)
+                fa = self._wide_fleet(meter, pv, t,
+                                      self._cohort_ids(state))
                 return state, self._block_stats_acc(meter, pv, t, acc), fa
 
             return wide_fleet
@@ -1857,7 +2005,8 @@ class Simulation:
                 state, meter, pv = self._block_step(state, inputs)
                 t = inputs["block_idx"]["t"]
                 ta = self._wide_telemetry(meter, pv, t)
-                fa = self._wide_fleet(meter, pv, t)
+                fa = self._wide_fleet(meter, pv, t,
+                                      self._cohort_ids(state))
                 return (state, self._block_stats_acc(meter, pv, t, acc),
                         ta, fa)
 

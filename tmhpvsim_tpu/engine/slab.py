@@ -27,6 +27,7 @@ from typing import Iterator
 import numpy as np
 
 from tmhpvsim_tpu.config import Plan, SimConfig, slice_grid
+from tmhpvsim_tpu import fleet as fleet_mod
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs.profiler import annotate
 
@@ -64,6 +65,8 @@ class SlabScheduler:
                 n_chains_total=total,
                 chain_offset=off,
                 site_grid=slice_grid(config.site_grid, off, n),
+                fleet=(fleet_mod.slice_fleet(config.fleet, off, n)
+                       if config.fleet is not None else None),
             ))
         # merged fleet-analytics total across slabs (None when analytics
         # is off); every risk leaf merges by exact int sum / extremum so

@@ -1,0 +1,11 @@
+"""Heterogeneous fleet subsystem: per-site parameters as a first-class
+batched pytree on the chain axis (see fleet/params.py)."""
+
+from tmhpvsim_tpu.fleet.params import (  # noqa: F401
+    COLUMN_RANGES,
+    N_REGIMES,
+    NO_AC_LIMIT,
+    FleetParams,
+    check_range,
+    slice_fleet,
+)

@@ -112,17 +112,23 @@ def _cloudy_csi_draw(key, cc, dtype):
     return jnp.where(cc < 6 / 8, z, g)
 
 
-def cc_window(k_cc, lo, n, carry, options: ModelOptions, dtype=jnp.float32):
+def cc_window(k_cc, lo, n, carry, options: ModelOptions, dtype=jnp.float32,
+              params=None):
     """Hourly cloud-cover values for global indices [lo, lo+n).
 
     ``carry`` is the chain state before transition ``lo`` (ignored in the
     iid-compat mode).  Returns (values[n], new_carry).  Every draw is
     keyed by its global index (markov_hourly.chain_window/iid_window), so
     any window regenerates identically — the foundation of the engine's
-    O(window) state (SURVEY.md §5 checkpoint note)."""
+    O(window) state (SURVEY.md §5 checkpoint note).  ``params``
+    overrides the step-distribution table (heterogeneous fleets pass a
+    per-chain regime gather, markov_hourly.select_regime; None = the
+    vendored Munich table, byte-identical draws)."""
     if options.persistent_cloud_chain:
-        return markov_hourly.chain_window(k_cc, lo, n, carry, dtype)
-    return markov_hourly.iid_window(k_cc, lo, n, dtype), carry
+        return markov_hourly.chain_window(k_cc, lo, n, carry, dtype,
+                                          params=params)
+    return markov_hourly.iid_window(k_cc, lo, n, dtype,
+                                    params=params), carry
 
 
 def cloudy_window(k_cloudy, lo, n, cc_vals, cc_lo, cc0, dtype=jnp.float32):

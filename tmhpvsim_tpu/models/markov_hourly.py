@@ -31,13 +31,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tmhpvsim_tpu.data import MARKOV_STEP_BINS, MARKOV_STEP_PARAMS
+from tmhpvsim_tpu.data import (MARKOV_STEP_BINS, MARKOV_STEP_PARAMS,
+                               MARKOV_STEP_PARAMS_REGIMES)
 from tmhpvsim_tpu.models import distributions as dist
 
 
-def step_params(dtype=jnp.float32):
+def step_params(dtype=jnp.float32, table=MARKOV_STEP_PARAMS):
     """Stacked per-bin step-distribution parameters for device-side gathers."""
-    p = np.asarray(MARKOV_STEP_PARAMS, dtype=np.float64)
+    p = np.asarray(table, dtype=np.float64)
     return {
         "bins": jnp.asarray(MARKOV_STEP_BINS, dtype=dtype),
         "loc": jnp.asarray(p[:, 0], dtype=dtype),
@@ -46,6 +47,32 @@ def step_params(dtype=jnp.float32):
         "df": jnp.asarray(p[:, 3], dtype=dtype),
         "is_t": jnp.asarray(p[:, 4], dtype=dtype),
     }
+
+
+def regime_step_params(dtype=jnp.float32):
+    """Every vendored regime table stacked on a leading regime axis:
+    each per-bin leaf becomes (n_regimes, 6), ``bins`` stays shared.
+    Row 0 is the Munich fit byte-for-byte (``MARKOV_STEP_PARAMS_REGIMES``
+    aliases it), so ``select_regime(regime_step_params(dt), 0)`` equals
+    ``step_params(dt)`` exactly — heterogeneous-fleet chains pinned at
+    regime 0 draw the same steps as the homogeneous path."""
+    p = np.asarray(MARKOV_STEP_PARAMS_REGIMES, dtype=np.float64)
+    return {
+        "bins": jnp.asarray(MARKOV_STEP_BINS, dtype=dtype),
+        "loc": jnp.asarray(p[:, :, 0], dtype=dtype),
+        "scale": jnp.asarray(p[:, :, 1], dtype=dtype),
+        "kappa": jnp.asarray(p[:, :, 2], dtype=dtype),
+        "df": jnp.asarray(p[:, :, 3], dtype=dtype),
+        "is_t": jnp.asarray(p[:, :, 4], dtype=dtype),
+    }
+
+
+def select_regime(regime_params, regime):
+    """One chain's (6,)-leaf parameter dict gathered from the stacked
+    regime tables; ``regime`` may be a traced int scalar (a per-chain
+    leaf inside a vmapped block body)."""
+    return {k: (v if k == "bins" else v[regime])
+            for k, v in regime_params.items()}
 
 
 def transition(key, state, params, dtype=jnp.float32):
@@ -101,12 +128,13 @@ def chain(key, n_samples, initial_state=1.0, dtype=jnp.float32):
     return samples
 
 
-def iid_window(key, start, n, dtype=jnp.float32):
+def iid_window(key, start, n, dtype=jnp.float32, params=None):
     """Reference-compat mode, windowed: value i is one i.i.d. step from
     state 1.0 (the accidental behaviour of clearskyindexmodel.py:61-63),
     keyed by global index — randomly accessible like
     :func:`chain_window`, no carry."""
-    params = step_params(dtype)
+    if params is None:
+        params = step_params(dtype)
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         start + jnp.arange(n)
     )

@@ -141,7 +141,7 @@ def params_from_config(config) -> FleetParams:
 
 
 def init_acc(level: str, dtype=jnp.float32, n_chains=None, *,
-             params: FleetParams) -> dict:
+             params: FleetParams, cohorts: int = 0) -> dict:
     """Fresh zeroed FleetAcc pytree for one block.
 
     Flat dict, mirroring ``telemetry.init_acc``: with ``n_chains`` the
@@ -154,6 +154,18 @@ def init_acc(level: str, dtype=jnp.float32, n_chains=None, *,
     :func:`fold_wide`, ``psum_fleet`` and :func:`summarize` consume.
     min/max start at +/-finfo.max (not inf — inf survives pmin/pmax but
     poisons the observed heuristic in :func:`summarize`).
+
+    ``cohorts`` (heterogeneous fleets, fleet/params.py): with C >= 2 the
+    acc additionally carries per-cohort group-by leaves — count, sum of
+    meter/pv/residual, residual min/max and a (C, bins+2) grouped
+    residual histogram.  Like the shared sketches they are scatter-add /
+    scatter-extremum targets WITHOUT a chain axis, identical in both acc
+    forms, so they pass through :func:`reduce_chainwise` unchanged and
+    merge associatively (int leaves and extrema bit-exactly) across
+    slabs, shards and mega-blocks.  C is a host-static property of the
+    whole fleet (``FleetParams.n_cohorts``; slices keep the parent's
+    width via ``n_cohorts_hint``), so every partition allocates the same
+    shapes.
     """
     if level not in ("risk", "full"):
         raise ValueError(f"init_acc: analytics level {level!r} must be "
@@ -177,6 +189,14 @@ def init_acc(level: str, dtype=jnp.float32, n_chains=None, *,
         for w in params.ramp_windows:
             acc[f"prev_ramp_{w}s"] = jnp.zeros(shape, dt)
             acc[f"seen_ramp_{w}s"] = jnp.zeros(shape, jnp.int32)
+    if cohorts:
+        c = int(cohorts)
+        acc["cohort_count"] = jnp.zeros((c,), jnp.int32)
+        acc["cohort_hist"] = jnp.zeros((c, params.bins + 2), jnp.int32)
+        acc["min_cohort_res"] = jnp.full((c,), big, dt)
+        acc["max_cohort_res"] = jnp.full((c,), -big, dt)
+        for f in ("meter", "pv", "residual"):
+            acc[f"cohort_sum_{f}"] = jnp.zeros((c,), dt)
     if level == "full":
         acc["regime_observed"] = jnp.zeros((), jnp.int32)
         acc["cov_count"] = jnp.zeros(shape, jnp.int32)
@@ -201,16 +221,21 @@ def leaf_kinds(acc: dict) -> dict:
 
 
 def fold_second(acc: dict, level: str, params: FleetParams, *, meter, pv,
-                residual, covered, t, valid) -> dict:
+                residual, covered, t, valid, cohort=None) -> dict:
     """Fold one second of per-chain ``(n_chains,)`` vectors into a
     **per-chain** acc (``init_acc(..., n_chains=n)``).
 
     ``t`` is the scalar global second index the scan body already
     carries (``x["t"]``) — it drives the ramp sample grids.  ``valid``
-    is the scalar duration mask.  A non-finite residual sample drops the
-    whole second from every statistic (``use`` mask); by IEEE semantics
-    a finite residual implies finite meter and pv, so the single mask is
-    sufficient for the conditional means too.
+    is the scalar duration mask (a per-chain vector is also accepted —
+    the scenario path's site-selector mask).  A non-finite residual
+    sample drops the whole second from every statistic (``use`` mask);
+    by IEEE semantics a finite residual implies finite meter and pv, so
+    the single mask is sufficient for the conditional means too.
+    ``cohort``: per-chain int32 group ids for the per-cohort leaves
+    (required when the acc was built with ``cohorts``; the masked-out
+    samples scatter zero / the extremum identity, so partial partitions
+    merge bit-exactly).
     """
     dt = acc["min_res"].dtype
     big = jnp.asarray(jnp.finfo(dt).max, dt)
@@ -255,6 +280,20 @@ def fold_second(acc: dict, level: str, params: FleetParams, *, meter, pv,
             acc[f"max_ramp_{w}s"])
         out[f"prev_ramp_{w}s"] = jnp.where(at & use, r, prev)
         out[f"seen_ramp_{w}s"] = jnp.where(at, uz, seen)
+    if "cohort_count" in acc and cohort is not None:
+        # per-cohort group-by: one scatter per leaf, keyed by the chain's
+        # cohort id.  Same histogram slot ``idx`` as the shared sketch,
+        # so the grouped histogram's column sums equal ``res_hist``.
+        out["cohort_count"] = acc["cohort_count"].at[cohort].add(uz)
+        out["cohort_hist"] = acc["cohort_hist"].at[cohort, idx].add(uz)
+        out["min_cohort_res"] = acc["min_cohort_res"].at[cohort].min(
+            jnp.where(use, r, big))
+        out["max_cohort_res"] = acc["max_cohort_res"].at[cohort].max(
+            jnp.where(use, r, -big))
+        for name, v in (("meter", meter), ("pv", pv), ("residual", r)):
+            v = v.astype(dt)
+            out[f"cohort_sum_{name}"] = acc[f"cohort_sum_{name}"].at[
+                cohort].add(jnp.where(use, v, jnp.zeros_like(v)))
     if level == "full":
         # covered arrives as the model's 0/1 float mask, not bool
         cov = (covered != 0) & use
@@ -280,7 +319,9 @@ def reduce_chainwise(acc: dict) -> dict:
     for k, v in acc.items():
         if k == "lol_run" or k.startswith(("prev_ramp_", "seen_ramp_")):
             continue
-        if k.startswith("min_"):
+        if "cohort" in k:
+            out[k] = v  # (C,)-grouped scatter targets: already shard-level
+        elif k.startswith("min_"):
             out[k] = v.min()
         elif k.startswith("max_"):
             out[k] = v.max()
@@ -294,7 +335,7 @@ def reduce_chainwise(acc: dict) -> dict:
 
 
 def fold_wide(acc: dict, level: str, params: FleetParams, *, meter, pv,
-              t, duration_s) -> dict:
+              t, duration_s, cohort=None) -> dict:
     """Fold materialised ``(n_chains, T)`` block arrays into a
     **scalar-form** acc.
 
@@ -347,6 +388,21 @@ def fold_wide(acc: dict, level: str, params: FleetParams, *, meter, pv,
         pair_ok = at[w:][None, :] & use[:, w:] & use[:, :-w]
         cand = jnp.where(pair_ok, d, -big).max().astype(dt)
         out[key] = jnp.maximum(acc[key], cand)
+    if "cohort_count" in acc and cohort is not None:
+        # same per-sample classification as fold_second's cohort scatter,
+        # vectorised over the block: int leaves fold bit-identically
+        cid = jnp.broadcast_to(cohort[:, None], r.shape).ravel()
+        out["cohort_count"] = acc["cohort_count"].at[cid].add(uz.ravel())
+        out["cohort_hist"] = acc["cohort_hist"].at[
+            cid, idx.ravel()].add(uz.ravel())
+        out["min_cohort_res"] = acc["min_cohort_res"].at[cid].min(
+            jnp.where(use, r, big).ravel())
+        out["max_cohort_res"] = acc["max_cohort_res"].at[cid].max(
+            jnp.where(use, r, -big).ravel())
+        for name, v in (("meter", meter), ("pv", pv), ("residual", r)):
+            v = v.astype(dt)
+            out[f"cohort_sum_{name}"] = acc[f"cohort_sum_{name}"].at[
+                cid].add(jnp.where(use, v, jnp.zeros_like(v)).ravel())
     return out
 
 
@@ -465,7 +521,45 @@ def summarize(acc: dict, params: FleetParams) -> dict:
             "overflow": int(hist[-1]),
         },
         "regimes": None,
+        "cohorts": None,
     }
+    if "cohort_count" in host:
+        counts = host["cohort_count"].astype(np.int64)
+        ghist = host["cohort_hist"].astype(np.int64)
+        mins = host["min_cohort_res"].astype(np.float64)
+        maxs = host["max_cohort_res"].astype(np.float64)
+        width = (params.hi - params.lo) / params.bins
+        interior_lo = params.lo + width * np.arange(params.bins)
+        cohorts = []
+        for c in range(len(counts)):
+            n = int(counts[c])
+            c_mn, c_mx = float(mins[c]), float(maxs[c])
+            seen = n > 0 and c_mn < 0.5 * big and c_mx > -0.5 * big
+            q = None
+            if seen:
+                e_lo = np.concatenate(
+                    [[min(c_mn, params.lo)], interior_lo, [params.hi]])
+                e_hi = np.concatenate(
+                    [[params.lo], interior_lo + width,
+                     [max(c_mx, params.hi)]])
+                ccum = np.cumsum(ghist[c])
+                q = {f"p{int(p * 100)}": _quantile(
+                    p, ccum, e_lo, e_hi, ghist[c], c_mn, c_mx, n)
+                    for p in (0.05, 0.50, 0.95)}
+            means = {
+                f"{f}_mean": (float(host[f"cohort_sum_{f}"][c]) / n
+                              if n else None)
+                for f in ("meter", "pv", "residual")
+            }
+            cohorts.append({
+                "cohort": c,
+                "count": n,
+                "residual_min": c_mn if seen else None,
+                "residual_max": c_mx if seen else None,
+                "quantiles": q,
+                **means,
+            })
+        out["cohorts"] = cohorts
     if level == "full" and int(host["regime_observed"]):
         cov_n = int(host["cov_count"])
         clr_n = count - cov_n

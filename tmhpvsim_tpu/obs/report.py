@@ -78,9 +78,17 @@ logger = logging.getLogger(__name__)
 #: factors).  Both additive — a v10 reader of the plan echo's original
 #: keys is unaffected, and documents omitting them mean the historical
 #: scan/1 path.
+#: v12: the heterogeneous-fleet subsystem (fleet/params.py).  The
+#: ``fleet`` section gains the optional ``cohorts`` list (per-cohort
+#: group-by reductions: count, residual extrema + quantiles, mean
+#: meter/pv/residual — obs/analytics.py ``summarize``) and the config
+#: echo gains the optional ``fleet`` identity (site count + content
+#: digest, mirroring the checkpoint echo).  All additive — a v11
+#: reader of the fleet section's original keys is unaffected, and
+#: documents omitting them mean a homogeneous (fleet-less) run.
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 11
+REPORT_SCHEMA_VERSION = 12
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -155,6 +163,38 @@ def _check_fields(doc: dict, schema: dict, where: str,
                              f"{sorted(unknown)}")
 
 
+def validate_fleet_section(sec: dict) -> list:
+    """Shape-check the v12 additions to the ``fleet`` section; returns
+    a list of error strings (empty = valid).  Pre-v12 sections (no
+    ``cohorts`` key) and homogeneous runs (``cohorts: null``) are
+    valid by construction."""
+    errors = []
+    co = sec.get("cohorts")
+    if co is None:
+        return errors
+    if not isinstance(co, list):
+        return [f"cohorts: expected a list or null, "
+                f"got {type(co).__name__}"]
+    for i, row in enumerate(co):
+        if not isinstance(row, dict):
+            errors.append(f"cohorts[{i}]: expected an object")
+            continue
+        for key in ("cohort", "count"):
+            if not isinstance(row.get(key), int):
+                errors.append(f"cohorts[{i}].{key}: expected an integer")
+        for key in ("residual_min", "residual_max", "meter_mean",
+                    "pv_mean", "residual_mean"):
+            if key in row and not isinstance(
+                    row[key], _NUM + (type(None),)):
+                errors.append(f"cohorts[{i}].{key}: expected a number "
+                              "or null")
+        if "quantiles" in row and not isinstance(row["quantiles"],
+                                                 _OPT_DICT):
+            errors.append(f"cohorts[{i}].quantiles: expected an object "
+                          "or null")
+    return errors
+
+
 def validate_report(doc) -> dict:
     """Validate ``doc`` against the versioned schema; returns it.
 
@@ -184,6 +224,10 @@ def validate_report(doc) -> dict:
         errors = validate_cost(doc["cost"])
         if errors:
             raise ValueError("run report cost: " + "; ".join(errors))
+    if isinstance(doc.get("fleet"), dict):
+        errors = validate_fleet_section(doc["fleet"])
+        if errors:
+            raise ValueError("run report fleet: " + "; ".join(errors))
     try:
         json.dumps(doc)
     except (TypeError, ValueError) as e:
@@ -239,6 +283,12 @@ def _config_doc(config) -> Optional[dict]:
     grid = doc.get("site_grid")
     if isinstance(grid, dict):  # 10k-site grids: echo the size, not rows
         doc["site_grid"] = {"n_sites": len(grid.get("latitude", ()))}
+    if getattr(config, "fleet", None) is not None:
+        # million-row fleets: echo the identity (size + content digest +
+        # cohort width), never the parameter columns (schema v12)
+        fp = config.fleet
+        doc["fleet"] = {"n_sites": len(fp), "n_cohorts": fp.n_cohorts,
+                        "digest": fp.digest()}
     return json.loads(json.dumps(doc, default=_jsonable))
 
 

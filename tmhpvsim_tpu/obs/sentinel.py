@@ -66,7 +66,16 @@ def _golden_reference(config, n_blocks: int,
     start = _dt.datetime.fromisoformat(config.start)
     total_s = min(n_blocks * config.block_s, config.duration_s)
     n_blocks = -(-total_s // config.block_s)
-    single_site = config.site_grid is None
+    fp = getattr(config, "fleet", None)
+    # heterogeneous per-site power transforms move the ensemble pv mean
+    # away from the one-site golden chain, so those bands are dropped the
+    # same way multi-site geometry drops them
+    single_site = config.site_grid is None and (
+        fp is None or not fp.het_power)
+    # chains on non-default weather regimes draw from step tables the
+    # golden chain does not model — the csi ensemble mean is a regime
+    # mixture, so its band is dropped too (NaN/Inf checks remain)
+    with_csi = fp is None or not fp.het_regime
 
     times = [start + _dt.timedelta(seconds=i) for i in range(total_s)]
     if single_site:
@@ -107,8 +116,10 @@ def _golden_reference(config, n_blocks: int,
 
     refs = []
     for b in range(n_blocks):
-        entry = {"csi": (float(csi_means[:, b].mean()),
-                         band(csi_means[:, b], _BAND_FLOORS["csi"]))}
+        entry = {}
+        if with_csi:
+            entry["csi"] = (float(csi_means[:, b].mean()),
+                            band(csi_means[:, b], _BAND_FLOORS["csi"]))
         if single_site:
             entry["pv"] = (float(pv_means[:, b].mean()),
                            band(pv_means[:, b], _BAND_FLOORS["pv"]))
@@ -203,12 +214,26 @@ class DriftSentinel:
         # mean over `count` samples has SE = std / sqrt(count)
         mmax = float(self.config.meter_max_w)
         if count > 0:
-            m_se = (mmax / math.sqrt(12.0)) / math.sqrt(count)
-            bands["meter"] = (mmax / 2.0, max(m_se, 1e-9 * max(mmax, 1.0)))
+            fp = getattr(self.config, "fleet", None)
+            if fp is not None and fp.het_demand:
+                # per-site affine demand: meter_i ~ scale_i*U(0,mmax)
+                # + shift_i, so the ensemble mean recenters on the
+                # fleet-average transform and the SE widens by the RMS
+                # of the scales (cohort-aware widening — every site's
+                # variance contributes, not the nominal one)
+                sc = np.asarray(fp.demand_scale, dtype=np.float64)
+                sh = np.asarray(fp.demand_shift_w, dtype=np.float64)
+                center = float(sc.mean()) * mmax / 2.0 + float(sh.mean())
+                m_se = (mmax * math.sqrt(float((sc * sc).mean()) / 12.0)
+                        / math.sqrt(count))
+            else:
+                center = mmax / 2.0
+                m_se = (mmax / math.sqrt(12.0)) / math.sqrt(count)
+            bands["meter"] = (center, max(m_se, 1e-9 * max(mmax, 1.0)))
             if "pv" in ref_entry:
                 pv_mean, pv_band = ref_entry["pv"]
                 bands["residual"] = (
-                    mmax / 2.0 - pv_mean,
+                    center - pv_mean,
                     math.sqrt(pv_band ** 2 + m_se ** 2),
                 )
         for f, (ref_mean, band) in bands.items():

@@ -317,13 +317,24 @@ class ShardedSimulation(Simulation):
         the scan variant."""
         from tmhpvsim_tpu.parallel import distributed
 
-        def fold(meter, pv, t):
-            fa = self._wide_fleet(meter, pv, t)
-            return distributed.psum_fleet(fa, CHAIN_AXIS)
+        if self._n_cohorts:
+            # cohort ids shard with the chains; the (C,) cohort leaves in
+            # the accumulator are shared scatter targets and psum-merge
+            def fold(meter, pv, t, cohort):
+                fa = self._wide_fleet(meter, pv, t, cohort)
+                return distributed.psum_fleet(fa, CHAIN_AXIS)
+
+            in_specs = (P(CHAIN_AXIS), P(CHAIN_AXIS), P(), P(CHAIN_AXIS))
+        else:
+            def fold(meter, pv, t):
+                fa = self._wide_fleet(meter, pv, t)
+                return distributed.psum_fleet(fa, CHAIN_AXIS)
+
+            in_specs = (P(CHAIN_AXIS), P(CHAIN_AXIS), P())
 
         mapped = shard_map(
             fold, mesh=self.mesh,
-            in_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS), P()),
+            in_specs=in_specs,
             out_specs=P(),
             check_vma=False,
         )

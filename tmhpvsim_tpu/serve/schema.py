@@ -16,7 +16,18 @@ Request meta (on the server's request exchange)::
         "weather_bias":     float in [0.25, 4],    # default 1
         "curtail_w":        float >= 0 or null,    # default null (no cap)
         "horizon_s":        int in [1, server max] # default server max
+        "site_index":       int in [0, n_sites),   # default -1 (all sites)
+        "cohort":           int in [0, n_cohorts)  # default -1 (all cohorts)
      }}
+
+The two **site selectors** bound a what-if to one installation
+(``site_index``, a chain-axis index into the served fleet) or to one
+cohort tag (``cohort``, against the fleet's dense cohort-id space).
+They are mutually exclusive, and each is only accepted when the served
+config can answer it: ``site_index`` needs a multi-site run
+(``n_sites`` known), ``cohort`` a heterogeneous fleet with >1 cohort.
+A selected reply folds exactly the chains the selector names — bit
+identical to running the equivalent single-site config on its own.
 
 Reply meta (on ``reply_to``)::
 
@@ -97,6 +108,10 @@ class Scenario:
     weather_bias: float = 1.0
     curtail_w: Optional[float] = None
     horizon_s: int = 0
+    #: chain-axis index to restrict the fold to (-1 = whole fleet)
+    site_index: int = -1
+    #: cohort tag to restrict the fold to (-1 = every cohort)
+    cohort: int = -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,17 +147,22 @@ def _check_float(name: str, v, lo: float, hi: float) -> float:
     return v
 
 
-def parse_scenario(doc, *, max_horizon_s: int) -> Scenario:
+def parse_scenario(doc, *, max_horizon_s: int,
+                   n_sites: Optional[int] = None,
+                   n_cohorts: int = 0) -> Scenario:
     """Validate one request's ``scenario`` value (may be None/absent:
     every knob has a neutral default and the horizon defaults to the
-    server's maximum)."""
+    server's maximum).  ``n_sites``/``n_cohorts`` bound the site
+    selectors; a selector the served config cannot answer is a typed
+    ``invalid`` rejection, never a silent whole-fleet answer."""
     if doc is None:
         doc = {}
     if not isinstance(doc, dict):
         raise RequestError("invalid",
                            f"scenario: expected an object, "
                            f"got {type(doc).__name__}")
-    known = set(KNOB_BOUNDS) | {"curtail_w", "horizon_s"}
+    known = set(KNOB_BOUNDS) | {"curtail_w", "horizon_s",
+                                "site_index", "cohort"}
     unknown = sorted(set(doc) - known)
     if unknown:
         raise RequestError(
@@ -167,10 +187,36 @@ def parse_scenario(doc, *, max_horizon_s: int) -> Scenario:
             "invalid",
             f"scenario.horizon_s={h} outside [1, {max_horizon_s}]")
     kw["horizon_s"] = h
+
+    def _selector(name, limit, what):
+        v = doc.get(name, -1)
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise RequestError("invalid",
+                               f"scenario.{name}: expected an integer")
+        if v == -1:
+            return -1
+        if limit is None or limit <= 0:
+            raise RequestError(
+                "invalid",
+                f"scenario.{name}: the served config has no {what}")
+        if not 0 <= v < limit:
+            raise RequestError(
+                "invalid",
+                f"scenario.{name}={v} outside [0, {limit})")
+        return v
+
+    kw["site_index"] = _selector("site_index", n_sites, "site axis")
+    kw["cohort"] = _selector("cohort", n_cohorts or None, "cohort tags")
+    if kw["site_index"] >= 0 and kw["cohort"] >= 0:
+        raise RequestError(
+            "invalid",
+            "scenario: site_index and cohort are mutually exclusive")
     return Scenario(**kw)
 
 
-def parse_request(meta, *, max_horizon_s: int) -> Request:
+def parse_request(meta, *, max_horizon_s: int,
+                  n_sites: Optional[int] = None,
+                  n_cohorts: int = 0) -> Request:
     """Validate one request meta dict (``op`` already checked by the
     caller's traffic filter).  Raises :class:`RequestError` with code
     ``invalid`` on any malformation."""
@@ -197,7 +243,8 @@ def parse_request(meta, *, max_horizon_s: int) -> Request:
         raise RequestError(
             "invalid", f"unknown request field(s) {', '.join(unknown)}")
     scenario = parse_scenario(meta.get("scenario"),
-                              max_horizon_s=max_horizon_s)
+                              max_horizon_s=max_horizon_s,
+                              n_sites=n_sites, n_cohorts=n_cohorts)
     tid, sid = meta.get("trace_id"), meta.get("span_id")
     return Request(
         id=rid, reply_to=reply_to, mode=mode, scenario=scenario,
@@ -270,4 +317,8 @@ def encode_batch(scenarios: Sequence[Scenario], batch: int,
                           for s in scenarios), no_cap),
         "horizon_s": np.asarray(
             [s.horizon_s for s in scenarios] + [0] * pad, np.int32),
+        "site_index": np.asarray(
+            [s.site_index for s in scenarios] + [-1] * pad, np.int32),
+        "cohort": np.asarray(
+            [s.cohort for s in scenarios] + [-1] * pad, np.int32),
     }

@@ -139,6 +139,19 @@ class ScenarioEngine:
         self.dtype = self.sim.dtype
         self.max_horizon_s = cfg.duration_s
         self.params = self.sim.scenario_fleet_params()
+        # site-selector bounds (schema.parse_scenario): a site_index is
+        # only answerable when chains ARE distinct sites (multi-site
+        # grid or fleet — for an exchangeable MC ensemble the "site"
+        # would be an arbitrary replicate); cohorts need a fleet that
+        # actually tags >1 of them.  Read from the RESOLVED config (the
+        # Simulation derives grid/n_chains from the fleet).
+        rcfg = self.sim.config
+        fp = rcfg.fleet
+        self.n_sites = (rcfg.n_chains
+                        if (rcfg.site_grid is not None or fp is not None)
+                        else None)
+        self.n_cohorts = (fp.n_cohorts
+                          if fp is not None and fp.n_cohorts > 1 else 0)
         #: device-resident base state, shared by every query via a
         #: non-donating device copy (engine/simulation.py _copy_jit)
         self._state0 = self.sim.init_state()
@@ -188,20 +201,31 @@ class ScenarioEngine:
         round-trip), so equal scenarios give byte-equal replies through
         any transport."""
         h = int(req.scenario.horizon_s)
+
+        def sel(out):
+            # echo an active site selector so the reply is self-
+            # describing; unselected replies stay byte-identical to the
+            # pre-selector wire format
+            if req.scenario.site_index >= 0:
+                out["site_index"] = int(req.scenario.site_index)
+            if req.scenario.cohort >= 0:
+                out["cohort"] = int(req.scenario.cohort)
+            return out
+
         if req.mode == "fleet":
-            return {"mode": "fleet", "horizon_s": h,
-                    "fleet": flt.summarize(total, self.params)}
+            return sel({"mode": "fleet", "horizon_s": h,
+                        "fleet": flt.summarize(total, self.params)})
         if req.mode == "quantiles":
             fleet = flt.summarize(total, self.params)
-            return {"mode": "quantiles", "horizon_s": h,
-                    "count": fleet["count"],
-                    "residual": fleet["residual"]}
+            return sel({"mode": "quantiles", "horizon_s": h,
+                        "count": fleet["count"],
+                        "residual": fleet["residual"]})
         ns = int(row["n_seconds"].sum())
 
         def tot(name):
             return float(row[name].astype(np.float64).sum())
 
-        return {"mode": "reduce", "horizon_s": h, "stats": {
+        return sel({"mode": "reduce", "horizon_s": h, "stats": {
             "n_seconds": ns,
             "pv_sum_w": tot("pv_sum"),
             "meter_sum_w": tot("meter_sum"),
@@ -209,7 +233,7 @@ class ScenarioEngine:
             "pv_max_w": float(row["pv_max"].max()),
             "residual_min_w": float(row["residual_min"].min()),
             "residual_max_w": float(row["residual_max"].max()),
-        }}
+        }})
 
 
 class ScenarioServer:
@@ -413,7 +437,9 @@ class ScenarioServer:
                 raise RequestError("draining",
                                    "server is draining; retry elsewhere")
             req = schema.parse_request(
-                meta, max_horizon_s=self.engine.max_horizon_s)
+                meta, max_horizon_s=self.engine.max_horizon_s,
+                n_sites=self.engine.n_sites,
+                n_cohorts=self.engine.n_cohorts)
             if req.id in self._inflight_ids or \
                     req.id in self._recent_ids:
                 if req.id in self._recent_ids:  # true LRU: a replayed

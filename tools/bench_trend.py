@@ -193,7 +193,8 @@ def normalize(path: str) -> dict:
            "compute_dtype": None, "kernel_impl": None,
            "rng_batch": None, "geom_stride": None,
            "precision_speedup": None, "north_star_frac": None,
-           "roofline_frac_vpu": None, "failed": True}
+           "roofline_frac_vpu": None, "fleet_sites": None,
+           "fleet_ratio": None, "failed": True}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -229,6 +230,7 @@ def normalize(path: str) -> dict:
         tel, ana = _levels(doc.get("config"))
         cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
+        fs, fr = _fleet_fields(doc)
         row.update(
             failed=False,
             platform=(doc.get("device") or {}).get("platform"),
@@ -241,6 +243,7 @@ def normalize(path: str) -> dict:
             rng_batch=rb, geom_stride=gs,
             precision_speedup=prec_speed,
             north_star_frac=nsf, roofline_frac_vpu=vpu,
+            fleet_sites=fs, fleet_ratio=fr,
         )
         return row
 
@@ -252,6 +255,7 @@ def normalize(path: str) -> dict:
                            if isinstance(rep, dict) else None)
         cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
+        fs, fr = _fleet_fields(doc)
         row.update(
             failed=False,
             platform=doc.get("platform"),
@@ -264,6 +268,7 @@ def normalize(path: str) -> dict:
             rng_batch=rb, geom_stride=gs,
             precision_speedup=prec_speed,
             north_star_frac=nsf, roofline_frac_vpu=vpu,
+            fleet_sites=fs, fleet_ratio=fr,
         )
         return row
 
@@ -343,6 +348,33 @@ def annotate_precision(rows: list) -> None:
             r["precision_speedup"] = round(r["value"] / b, 2)
 
 
+def _fleet_fields(doc: dict) -> tuple:
+    """(fleet_sites, fleet_ratio) — from a ``bench.py --fleet-*``
+    artifact's ``fleet`` block (het_over_homog is the heterogeneity
+    price), else from a v12 config echo's fleet identity (sites only).
+    Fleet-less documents read as (None, None)."""
+    sec = doc.get("fleet")
+    if isinstance(sec, dict) and "n_sites" in sec:
+        return sec.get("n_sites"), sec.get("het_over_homog")
+    for rep in (doc, doc.get("run_report")):
+        if isinstance(rep, dict):
+            cfg = rep.get("config")
+            if isinstance(cfg, dict) and isinstance(cfg.get("fleet"),
+                                                    dict):
+                return cfg["fleet"].get("n_sites"), None
+    return None, None
+
+
+def _fmt_fleet(r) -> str:
+    """The ``fleet`` cell: site count, with the heterogeneous-over-
+    homogeneous throughput ratio appended when bench.py timed both."""
+    fs = r.get("fleet_sites")
+    if fs is None:
+        return "-"
+    fr = r.get("fleet_ratio")
+    return f"{fs}" if fr is None else f"{fs}@{fr:.2f}x"
+
+
 def _fmt_cost(r) -> str:
     """The ``cost`` cell: north-star fraction, with the VPU roofline
     fraction parenthesised when the chip's peaks were known."""
@@ -359,7 +391,7 @@ def _fmt_cost(r) -> str:
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
-            "cdt", "kimpl", "rb", "gs", "prec", "cost", "note")
+            "cdt", "kimpl", "rb", "gs", "prec", "fleet", "cost", "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
@@ -375,6 +407,7 @@ def print_table(rows: list) -> None:
             r.get("rng_batch") or "-",
             "-" if r.get("geom_stride") is None else str(r["geom_stride"]),
             "-" if prec is None else f"{prec:.2f}x",
+            _fmt_fleet(r),
             _fmt_cost(r),
             r.get("note", ""),
         ))

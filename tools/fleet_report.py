@@ -137,6 +137,44 @@ def validate_fleet(sec) -> list:
                 if not isinstance(row, dict) or not isinstance(
                         row.get("seconds"), int):
                     errors.append(f"regimes[{name!r}] malformed")
+
+    co = sec.get("cohorts")
+    if co is not None:
+        if not isinstance(co, list):
+            errors.append("cohorts neither list nor null")
+        else:
+            total = 0
+            for j, row in enumerate(co):
+                if not isinstance(row, dict):
+                    errors.append(f"cohorts[{j}] not an object")
+                    continue
+                for key, types in (("cohort", int), ("count", int)):
+                    _check(isinstance(row.get(key), types), errors,
+                           f"cohorts[{j}].{key} missing/mistyped")
+                for key in ("residual_min", "residual_max", "meter_mean",
+                            "pv_mean", "residual_mean"):
+                    _check(isinstance(row.get(key, None), _OPT_NUM),
+                           errors, f"cohorts[{j}].{key} not numeric/null")
+                q = row.get("quantiles")
+                if isinstance(q, dict):
+                    vals = [q.get(name) for name in ("p5", "p50", "p95")]
+                    _check(all(isinstance(v, _NUM) for v in vals), errors,
+                           f"cohorts[{j}].quantiles missing/non-numeric")
+                    if all(isinstance(v, _NUM) for v in vals):
+                        _check(vals == sorted(vals), errors,
+                               f"cohorts[{j}].quantiles not "
+                               f"non-decreasing: {vals}")
+                elif q is not None:
+                    errors.append(
+                        f"cohorts[{j}].quantiles neither object nor null")
+                if isinstance(row.get("count"), int):
+                    total += row["count"]
+            # every folded chain-second is tagged with exactly one
+            # cohort, so the group-by partitions the total count
+            if isinstance(sec.get("count"), int):
+                _check(total == sec["count"], errors,
+                       f"cohort counts sum to {total} != "
+                       f"fleet count {sec['count']}")
     return errors
 
 
@@ -183,6 +221,26 @@ def print_fleet(sec: dict, label: str) -> None:
                 f"{k.removesuffix('_mean')}={_fmt_w(v)}"
                 for k, v in row.items() if k.endswith("_mean"))
             print(f"  regime      {name}: {row['seconds']:,} s  {means}")
+    co = sec.get("cohorts")
+    if co:
+        heads = ("cohort", "seconds", "res_min_W", "res_p50_W",
+                 "res_max_W", "meter_mean_W", "pv_mean_W")
+        rows = []
+        for row in co:
+            q = row.get("quantiles") or {}
+            rows.append((str(row["cohort"]), f"{row['count']:,}",
+                         _fmt_w(row.get("residual_min")),
+                         _fmt_w(q.get("p50")),
+                         _fmt_w(row.get("residual_max")),
+                         _fmt_w(row.get("meter_mean")),
+                         _fmt_w(row.get("pv_mean"))))
+        widths = [max(len(r[i]) for r in rows + [heads])
+                  for i in range(len(heads))]
+        print("  cohorts     " + "  ".join(
+            h.rjust(w) for h, w in zip(heads, widths)))
+        for r in rows:
+            print("              " + "  ".join(
+                c.rjust(w) for c, w in zip(r, widths)))
 
 
 def _iter_docs(path: str):
