@@ -75,10 +75,24 @@ CPU_N_BLOCKS = 2
 from tmhpvsim_tpu.obs.cost import NORTH_STAR  # noqa: E402
 from tmhpvsim_tpu.obs.cost import PEAKS as _PEAKS  # noqa: E402
 
+# The probe child routes its matmul compile through the persistent
+# compilation cache (engine/compilecache.py): the first probe against a
+# device kind compiles once and persists, every later probe — including
+# the next battery round's — deserialises in milliseconds.  BENCH_r04/r05
+# lost whole rounds to probes that burned their budget recompiling
+# against a slow tunnel; with the cache the budget is spent only on the
+# genuinely wedged case.  Best-effort: a missing package on the child's
+# path must not fail the probe itself.
 _PROBE_SRC = (
-    "import jax, jax.numpy as jnp;"
+    "import jax;"
+    "\ntry:\n"
+    "    from tmhpvsim_tpu.engine import compilecache;"
+    " compilecache.configure()\n"
+    "except Exception as e:\n"
+    "    import sys; print(f'# probe cache off: {e}', file=sys.stderr)\n"
+    "import jax.numpy as jnp;"
     "x = jnp.ones((128, 128));"
-    "(x @ x).block_until_ready();"
+    "jax.jit(lambda a: a @ a)(x).block_until_ready();"
     "print(jax.devices()[0].platform)"
 )
 
@@ -89,10 +103,15 @@ def _probe_backend(timeout_s: float) -> str | None:
     Runs in a child process so a hanging backend init costs a bounded
     timeout instead of the whole benchmark.
     """
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (here + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else here)
     try:
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
             capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=here,
         )
     except subprocess.TimeoutExpired:
         print(f"# backend probe timed out after {timeout_s:.0f}s",
@@ -135,19 +154,57 @@ _PROBE_STATS = {"probe_attempts": 0, "probe_timeouts": 0}
 _PROBE_ATTEMPT_TIMEOUT_S = 150.0
 _PROBE_TOTAL_TIMEOUT_S = 240.0
 
+#: warmed budget: when the persistent compile cache already holds
+#: entries for SOME device kind, a healthy probe answers in seconds
+#: (deserialise, not compile) — so a longer budget costs nothing on the
+#: healthy path and buys the slow-but-alive tunnel more headroom before
+#: we give up on it (the BENCH_r04/r05 failure was giving up too early,
+#: then silently publishing CPU numbers)
+_PROBE_WARM_ATTEMPT_TIMEOUT_S = 240.0
+_PROBE_WARM_TOTAL_TIMEOUT_S = 420.0
+
+#: --assume-tpu (or TMHPVSIM_ASSUME_TPU=1): a failed probe degrades to a
+#: REAL watchdogged TPU attempt instead of the silent cpu-fallback —
+#: headline() already bounds a wedged backend with its monitor thread
+#: (rc=3 partial on hang), so assuming costs a bounded timeout, while a
+#: wrong cpu-fallback costs the round's TPU numbers
+ASSUME_TPU = False
+
+
+def _compile_cache_warm() -> bool:
+    """True when the persistent compile cache base dir already holds
+    entries for any device kind (engine/compilecache.py layout: one
+    subdir per device-kind slug)."""
+    try:
+        from tmhpvsim_tpu.engine import compilecache
+
+        base = os.environ.get(compilecache.ENV_VAR) or \
+            compilecache.default_dir()
+        if str(base).strip().lower() in compilecache.OFF_VALUES:
+            return False
+        for sub in os.listdir(base):
+            d = os.path.join(base, sub)
+            if os.path.isdir(d) and os.listdir(d):
+                return True
+    except OSError:
+        pass
+    except Exception as e:  # import trouble must not fail the probe
+        print(f"# compile-cache warm check failed: {e}", file=sys.stderr)
+    return False
+
 
 def _probe_doc() -> dict | None:
     """The ``probe`` report section, or None when no probe ran (so
     artifacts from probe-free paths stay byte-stable)."""
     if not _PROBE_STATS["probe_attempts"]:
         return None
-    return {**_PROBE_STATS,
-            "attempt_timeout_s": _PROBE_ATTEMPT_TIMEOUT_S,
-            "total_timeout_s": _PROBE_TOTAL_TIMEOUT_S}
+    return dict(_PROBE_STATS)
 
 
 def _probe_or_fallback() -> tuple[str, bool]:
-    """(platform, fallback?) — probe the pinned backend, else force CPU.
+    """(platform, fallback?) — probe the pinned backend, else force CPU
+    (or, under ``--assume-tpu``, return "tpu" so the caller makes a real
+    watchdogged attempt).
 
     The probe runs under ``runtime.resilience.ResiliencePolicy``
     (replacing the old ad-hoc two-timeout loop): two bounded attempts
@@ -157,14 +214,25 @@ def _probe_or_fallback() -> tuple[str, bool]:
     where it works).  A no-platform attempt raises TimeoutError so the
     policy's retry/giveup machinery — and its ``retry.*`` counters —
     drive the loop; attempts/timeouts are also journalled into
-    ``_PROBE_STATS`` for the v8 ``probe`` report section."""
+    ``_PROBE_STATS`` for the v8 ``probe`` report section.  The budget is
+    the lengthened warmed pair when the persistent compile cache already
+    holds entries (the probe child deserialises instead of compiling)."""
     import asyncio
 
     from tmhpvsim_tpu.runtime.resilience import ResiliencePolicy
 
+    warm = _compile_cache_warm()
+    attempt_s = (_PROBE_WARM_ATTEMPT_TIMEOUT_S if warm
+                 else _PROBE_ATTEMPT_TIMEOUT_S)
+    total_s = (_PROBE_WARM_TOTAL_TIMEOUT_S if warm
+               else _PROBE_TOTAL_TIMEOUT_S)
+    _PROBE_STATS["cache_warm"] = warm
+    _PROBE_STATS["attempt_timeout_s"] = attempt_s
+    _PROBE_STATS["total_timeout_s"] = total_s
+
     async def attempt():
         _PROBE_STATS["probe_attempts"] += 1
-        platform = _probe_backend(_PROBE_ATTEMPT_TIMEOUT_S)
+        platform = _probe_backend(attempt_s)
         if platform is None:
             _PROBE_STATS["probe_timeouts"] += 1
             raise TimeoutError("backend probe returned no platform")
@@ -172,10 +240,16 @@ def _probe_or_fallback() -> tuple[str, bool]:
 
     policy = ResiliencePolicy(
         attempts=2, base_delay_s=2.0, max_delay_s=10.0,
-        total_timeout_s=_PROBE_TOTAL_TIMEOUT_S,
+        total_timeout_s=total_s,
         name="bench.backend_probe", fallback=None)
     platform = asyncio.run(policy.call(attempt))
     if platform is None:
+        if ASSUME_TPU:
+            _PROBE_STATS["assumed_tpu"] = True
+            print("# backend probe failed; --assume-tpu: making a real "
+                  "watchdogged TPU attempt instead of cpu-fallback",
+                  file=sys.stderr)
+            return "tpu", False
         _force_cpu()
         return "cpu-fallback", True
     return platform, False
@@ -1953,6 +2027,139 @@ def serve_bench(clients: int, requests_per_client: int) -> None:
     print(json.dumps(doc), flush=True)
 
 
+#: worker body for --hosts K: one coordinated CPU process per simulated
+#: host (gloo collectives, virtual devices), the same execution model a
+#: TPU pod slice uses — and the same harness pattern as
+#: tests/test_distributed.py.  Process 0 prints the JSON payload.
+_HOSTS_WORKER_SRC = r"""
+import json, os, time
+import jax
+
+n_local = int(os.environ["TMHPVSIM_BENCH_LOCAL_DEVICES"])
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", n_local)
+except AttributeError:  # jax < 0.5 spells it as an XLA flag
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_local}")
+try:  # jax < 0.5: cross-process CPU collectives need the gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass  # newer jax: gloo is the default
+
+from tmhpvsim_tpu.parallel.distributed import initialize_from_env, mesh_doc
+assert initialize_from_env(), "coordinator env vars must initialise"
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
+
+n_chains = int(os.environ.get("TMHPVSIM_BENCH_HOSTS_CHAINS", "256"))
+m = int(os.environ.get("TMHPVSIM_BENCH_MESH_SCENARIO", "0"))
+mesh = make_mesh(scenario_devices=m) if m >= 1 else make_mesh()
+cfg = SimConfig(start="2019-09-05 00:00:00", duration_s=3 * 360,
+                n_chains=n_chains, seed=0, block_s=360, dtype="float32",
+                prng_impl="threefry2x32", output="reduce")
+sim = ShardedSimulation(cfg, mesh=mesh)
+t0 = time.perf_counter()
+red = sim.run_reduced()
+wall = time.perf_counter() - t0
+ens = sim.ensemble_stats()
+rate = n_chains * cfg.duration_s / wall
+if jax.process_index() == 0:
+    print(json.dumps({
+        "mesh": mesh_doc(mesh, n_chains=n_chains),
+        "rate": round(rate, 1),
+        "rate_includes_compile": True,
+        "wall_s": round(wall, 2),
+        "n_seconds": int(ens["n_seconds"]),
+    }), flush=True)
+print(f"HOSTOK {jax.process_index()}", flush=True)
+"""
+
+
+def hosts_bench(k: int, mesh_scenario: int = 0) -> None:
+    """--hosts K: multi-host mechanics artifact — K coordinated CPU
+    processes on this machine, each owning its share of 8 virtual
+    devices, joined into one global mesh over gloo.  Validates exactly
+    the ``process_count() > 1`` paths a pod slice exercises (distributed
+    init, per-host chain carving, cross-host psum) and emits one JSON
+    line with the mesh document and the combined rate.  NOT a hardware
+    number: every virtual device shares this host's cores."""
+    import socket
+
+    if k < 1 or 8 % k != 0:
+        raise SystemExit(f"--hosts {k}: must divide 8 virtual devices")
+    n_local = 8 // k
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs = []
+    for pid in range(k):
+        env = dict(
+            os.environ,
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES=str(k),
+            JAX_PROCESS_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+            TMHPVSIM_BENCH_LOCAL_DEVICES=str(n_local),
+            TMHPVSIM_BENCH_MESH_SCENARIO=str(mesh_scenario),
+        )
+        # the parent's XLA_FLAGS would fight jax_num_cpu_devices, and an
+        # eagerly-initialising sitecustomize on PYTHONPATH forbids
+        # jax.distributed.initialize (tests/test_distributed.py); cwd on
+        # sys.path keeps tmhpvsim_tpu importable without it
+        env.pop("XLA_FLAGS", None)
+        env.pop("PYTHONPATH", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _HOSTS_WORKER_SRC], env=env, cwd=here,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=900)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    inner = None
+    for ln in (outs[0][1] or "").splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                inner = json.loads(ln)
+            except json.JSONDecodeError:
+                pass
+    failed = [i for i, (rc, _, _) in enumerate(outs) if rc != 0]
+    for i in failed:
+        tail = (outs[i][2] or "").strip().splitlines()[-5:]
+        print(f"# hosts worker {i} failed rc={outs[i][0]}:",
+              *tail, sep="\n# ", file=sys.stderr)
+    doc = {
+        "artifact": "multi-host mechanics (gloo, virtual CPU devices)",
+        "hosts": k,
+        "local_devices_per_host": n_local,
+        "platform": "cpu",
+        "workers_ok": k - len(failed),
+        "caveat": ("all simulated hosts share this machine's cores; "
+                   "validates distributed init + carving + cross-host "
+                   "psum mechanics, not hardware scaling"),
+        **(inner or {"error": "worker 0 produced no JSON payload"}),
+    }
+    doc["run_report"] = _bench_report(
+        "bench.hosts",
+        config={"hosts": k, "local_devices_per_host": n_local,
+                "mesh_scenario": mesh_scenario},
+        headline={"site_seconds_per_s": doc.get("rate")},
+        device={"platform": "cpu"},
+    )
+    _persist_partial({"phase": "hosts", **doc})
+    print(json.dumps(doc), flush=True)
+    if failed or inner is None:
+        sys.exit(1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config",
@@ -2003,10 +2210,27 @@ def main() -> None:
                          "engine/compilecache.py).  Default: "
                          "$TMHPVSIM_COMPILE_CACHE, else "
                          "~/.cache/tmhpvsim_tpu/xla; 'off' disables")
+    ap.add_argument("--assume-tpu", action="store_true",
+                    default=os.environ.get("TMHPVSIM_ASSUME_TPU", "")
+                    in ("1", "true", "yes"),
+                    help="on probe failure, attempt TPU anyway under the "
+                         "headline watchdog (rc=3 partial on hang) "
+                         "instead of the silent cpu-fallback; also "
+                         "TMHPVSIM_ASSUME_TPU=1")
+    ap.add_argument("--hosts", type=int, metavar="K", default=None,
+                    help="multi-host mechanics artifact: K coordinated "
+                         "CPU processes (gloo) sharing 8 virtual "
+                         "devices, one global mesh — the simulated pod "
+                         "slice from tests/test_distributed.py as a "
+                         "bench mode")
+    ap.add_argument("--mesh-scenario", type=int, metavar="M", default=0,
+                    help="with --hosts: scenario-axis width of the 2-D "
+                         "(chains, scenario) mesh (0 = flat 1-D mesh)")
     args = ap.parse_args()
-    global TELEMETRY, ANALYTICS
+    global TELEMETRY, ANALYTICS, ASSUME_TPU
     TELEMETRY = args.telemetry
     ANALYTICS = args.analytics
+    ASSUME_TPU = args.assume_tpu
     # default ON: every mode after the first run starts cache-warm, and
     # the v4 run_report executor section records warm vs cold compiles.
     # --repro children override via TMHPVSIM_COMPILE_CACHE=off (repro()).
@@ -2026,6 +2250,8 @@ def main() -> None:
         repro(args.repro)
     elif args.one_variant:
         one_variant()
+    elif args.hosts is not None:
+        hosts_bench(args.hosts, args.mesh_scenario)
     elif args.serve is not None:
         serve_bench(args.serve, args.serve_requests)
     elif args.fleet_csv is not None or args.fleet_synth is not None:

@@ -277,5 +277,18 @@ for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
   python tools/cost_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- cost_report: MALFORMED COST SECTION $bench_doc rc=$?" >> "$LOG"
 done
+# mesh sanity (non-fatal), same contract as the loops above: any doc
+# carrying a v13 'mesh' section (parallel/distributed.mesh_doc — mesh
+# shape/axis names, device product, multi-host process bounds, the
+# per-process chain carve) must carry a WELL-FORMED one; unsharded or
+# pre-v13 docs just note the absence.  Catches a battery that silently
+# ran on the wrong topology (e.g. 1 host where 2 were requested).
+for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
+                 benchmarks/BENCH_*.json benchmarks/HOSTS_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- mesh_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/mesh_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- mesh_report: MALFORMED MESH SECTION $bench_doc rc=$?" >> "$LOG"
+done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

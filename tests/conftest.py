@@ -157,6 +157,15 @@ _SLOW_LANE = {
     "test_sharded_identical",
     "test_mega_dispatch_identical",
     "test_site_grid_stride60_field_scale",
+    # 2-D (chains, scenario) mesh: the full impl x telemetry x fleet
+    # bit-identity matrix (the fast lane keeps the default-path sibling
+    # test_nm_mesh_matches_1d_and_single and the (N,1) HLO-identity bar)
+    "test_mesh2d_matrix_bit_identical",
+    # real two-process jax.distributed elastic-resume runs (the fast
+    # lane keeps the single-process load_elastic tests in
+    # tests/test_checkpoint.py)
+    "test_two_process_elastic_resume",
+    "test_million_site_two_host_elastic",
 }
 
 

@@ -1,12 +1,21 @@
 """Sharded execution tests on the 8-virtual-CPU-device mesh (conftest)."""
 
+import re
+
 import jax
 import numpy as np
 import pytest
 
 from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.engine import Simulation
-from tmhpvsim_tpu.parallel import ShardedSimulation, chain_sharding, make_mesh
+from tmhpvsim_tpu.fleet import FleetParams
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.parallel import (
+    ShardedSimulation,
+    chain_sharding,
+    make_mesh,
+    scenario_sharding,
+)
 from tmhpvsim_tpu.parallel.distributed import local_chain_slice
 
 
@@ -202,3 +211,157 @@ class TestShardedReduce:
         sl, local = sim.local_reduced_view(reduced)
         assert (sl.start, sl.stop) == (0, 8)
         np.testing.assert_array_equal(local["pv_sum"], reduced["pv_sum"])
+
+
+# ---------------------------------------------------------------------------
+# the 2-D (chains, scenario) mesh
+# ---------------------------------------------------------------------------
+
+
+def _hfleet(n):
+    """Uniform geometry (bitwise across shard layouts on CPU — see
+    tests/test_fleet.py module note), heterogeneous in every other column
+    so the cohort psum path has real work."""
+    from tmhpvsim_tpu.config import Site
+
+    s = Site()
+    return FleetParams(
+        latitude=(s.latitude,) * n, longitude=(s.longitude,) * n,
+        altitude=(s.altitude,) * n, surface_tilt=(s.surface_tilt,) * n,
+        surface_azimuth=(s.surface_azimuth,) * n, albedo=(s.albedo,) * n,
+        dc_capacity_scale=tuple(0.5 + 0.2 * i for i in range(n)),
+        ac_limit_w=(150.0,) * (n // 2) + (float("inf"),) * (n - n // 2),
+        weather_regime=tuple(i % 3 for i in range(n)),
+        demand_scale=tuple(1.0 + 0.1 * i for i in range(n)),
+        demand_shift_w=tuple(10.0 * i for i in range(n)),
+        cohort=tuple(i % 3 for i in range(n)),
+    )
+
+
+def _mesh_cfg(impl="scan", tel="off", fleet="off", **kw):
+    base = dict(
+        start="2019-09-05 10:00:00", duration_s=120, n_chains=8, seed=11,
+        block_s=60, dtype="float32", block_impl=impl, telemetry=tel,
+    )
+    if fleet != "off":
+        base.update(analytics=fleet, fleet=_hfleet(8))
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _run_combo(c, mesh):
+    with use_registry(MetricsRegistry()):
+        sim = (Simulation(c) if mesh is None
+               else ShardedSimulation(c, mesh=mesh))
+        red = {k: np.asarray(v) for k, v in sim.run_reduced().items()}
+        ens = (sim.ensemble_stats() if mesh is not None else None)
+        sec = (sim.fleet_summary() if c.analytics != "off" else None)
+    return red, ens, sec
+
+
+class TestMesh2D:
+    def test_mesh_shapes_and_specs(self):
+        m = make_mesh(scenario_devices=2)
+        assert m.devices.shape == (4, 2)
+        assert m.axis_names == ("chains", "scenario")
+        assert scenario_sharding(m).spec == jax.sharding.PartitionSpec(
+            "scenario", "chains")
+        # chain data shards over BOTH axes: 8 shards either way
+        assert chain_sharding(m).spec == jax.sharding.PartitionSpec(
+            ("chains", "scenario"))
+        with pytest.raises(ValueError, match="divide"):
+            make_mesh(scenario_devices=3)
+        with pytest.raises(ValueError, match="scenario"):
+            scenario_sharding(make_mesh())
+
+    def test_state_sharded_over_both_axes(self):
+        sim = ShardedSimulation(cfg(), mesh=make_mesh(scenario_devices=2))
+        state = sim.init_state()
+        sh = state["carry"]["sec"].sharding
+        assert sh.is_equivalent_to(chain_sharding(sim.mesh), ndim=1)
+        assert len(state["carry"]["sec"].sharding.device_set) == 8
+
+    def test_n1_mesh_lowers_byte_identical_to_1d(self):
+        """The degenerate (N, 1) mesh is the acceptance bar for 'the 2-D
+        specs cost nothing': the reduce-path jit must produce the same
+        compiled HLO as the historical 1-D mesh, byte for byte.  The
+        lowered StableHLO is compared after stripping ``jax.result_info``
+        (pure result-naming metadata — the only textual difference);
+        the compiled module must match with no normalisation at all."""
+        c = _mesh_cfg(duration_s=60)
+        sim1 = ShardedSimulation(c, mesh=make_mesh())
+        sim2 = ShardedSimulation(c, mesh=make_mesh(scenario_devices=1))
+        assert sim2.mesh.devices.shape == (8, 1)
+        strip = re.compile(r'jax\.result_info = "[^"]*"')
+        for attr in ("_scan_acc_jit", "_sharded_ensemble"):
+            low1 = getattr(sim1, attr)
+            low2 = getattr(sim2, attr)
+            if attr == "_scan_acc_jit":
+                a1 = (sim1.init_state(), sim1.host_inputs(0)[0],
+                      sim1.init_reduce_acc())
+                a2 = (sim2.init_state(), sim2.host_inputs(0)[0],
+                      sim2.init_reduce_acc())
+            else:
+                sim1.run_reduced(), sim2.run_reduced()
+                a1, a2 = (sim1._last_acc,), (sim2._last_acc,)
+            l1, l2 = low1.lower(*a1), low2.lower(*a2)
+            assert (strip.sub("", l1.as_text())
+                    == strip.sub("", l2.as_text())), attr
+            assert l1.compile().as_text() == l2.compile().as_text(), attr
+
+    def test_nm_mesh_matches_1d_and_single(self):
+        """(4, 2) vs (8,) vs one device on the default path: the mesh
+        SHAPE is invisible (bit-identical — same per-shard batch shape,
+        psum over the axis tuple), the mesh SIZE only moves f32 results
+        by the documented ULPs (ints exact)."""
+        c = _mesh_cfg()
+        red2d, ens2d, _ = _run_combo(c, make_mesh(scenario_devices=2))
+        red1d, ens1d, _ = _run_combo(c, make_mesh())
+        assert set(red2d) == set(red1d)
+        for k in red1d:
+            np.testing.assert_array_equal(red2d[k], red1d[k], err_msg=k)
+        assert ens2d == ens1d
+        red1, _, _ = _run_combo(c, None)
+        np.testing.assert_array_equal(red2d["n_seconds"],
+                                      red1["n_seconds"])
+        for k in red1:
+            np.testing.assert_allclose(red2d[k], red1[k],
+                                       rtol=2e-5, atol=1e-2, err_msg=k)
+
+    @pytest.mark.parametrize("impl,tel,fleet", [
+        ("scan", "light", "off"),
+        ("scan", "off", "risk"),
+        ("scan", "light", "risk"),
+        ("scan2", "off", "off"),
+        ("scan2", "light", "risk"),
+        ("wide", "off", "off"),
+        ("wide", "light", "risk"),
+    ], ids=lambda v: str(v))
+    def test_mesh2d_matrix_bit_identical(self, impl, tel, fleet):
+        """The full impl x telemetry x fleet matrix: every sharded code
+        path (split/scan/scan2/wide producers, the telemetry fold, the
+        cohort fleet psum) must give BIT-identical results on (4, 2) vs
+        (8,) — the collectives ride the axis-name tuple, nothing else
+        changes — and match the single device at the ULP contract."""
+        c = _mesh_cfg(impl, tel, fleet)
+        red2d, ens2d, sec2d = _run_combo(c, make_mesh(scenario_devices=2))
+        red1d, ens1d, sec1d = _run_combo(c, make_mesh())
+        assert set(red2d) == set(red1d)
+        for k in red1d:
+            np.testing.assert_array_equal(red2d[k], red1d[k], err_msg=k)
+        assert ens2d == ens1d
+        assert sec2d == sec1d
+        red1, _, _ = _run_combo(c, None)
+        np.testing.assert_array_equal(red2d["n_seconds"],
+                                      red1["n_seconds"])
+        for k in red1:
+            np.testing.assert_allclose(red2d[k], red1[k],
+                                       rtol=2e-5, atol=1e-2, err_msg=k)
+
+    def test_scenario_mesh_via_config(self):
+        """SimConfig.mesh_scenario builds the 2-D mesh without an explicit
+        mesh argument, and the scenario dispatch advertises the batch
+        alignment the serve layer pads to."""
+        sim = ShardedSimulation(_mesh_cfg(mesh_scenario=2))
+        assert sim.mesh.devices.shape == (4, 2)
+        assert sim.scenario_batch_align() == 2

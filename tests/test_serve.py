@@ -254,6 +254,35 @@ class TestMicroBatcher:
             await b.stop(drain=True)
         _run(main())
 
+    def test_batch_align_validation(self):
+        with pytest.raises(ValueError, match="batch_align"):
+            MicroBatcher(lambda rs: list(rs), window_s=0.01, max_batch=4,
+                         registry=MetricsRegistry(), batch_align=0)
+
+    def test_batch_align_tops_up_from_queue(self):
+        """Soft alignment: at window close the batcher tops an odd batch
+        up to the next multiple of ``batch_align`` from requests ALREADY
+        queued — never waiting past the window for new ones.  With a
+        zero window each batch would close at occupancy 1; align=2 pairs
+        them up from the queue, and the last batch is allowed to stay
+        ragged when the queue runs dry."""
+        async def main():
+            calls = []
+
+            def dispatch(reqs):
+                calls.append(len(reqs))
+                return list(reqs)
+
+            b = MicroBatcher(dispatch, window_s=0.0, max_batch=8,
+                             registry=MetricsRegistry(), batch_align=2)
+            futs = [b.submit(i) for i in range(5)]  # queue BEFORE start
+            b.start()
+            out = await asyncio.gather(*futs)
+            assert [r for r, _ in out] == list(range(5))
+            assert calls == [2, 2, 1]
+            await b.stop(drain=True)
+        _run(main())
+
 
 # ---------------------------------------------------------------------------
 # request/reply correlation over all three transports
@@ -399,6 +428,40 @@ class TestEngineBitIdentity:
         assert stats["pv_max_w"] == float(red["pv_max"].max())
         assert stats["residual_min_w"] == float(red["residual_min"].min())
         assert stats["residual_max_w"] == float(red["residual_max"].max())
+
+
+# ---------------------------------------------------------------------------
+# engine on the 2-D (chains, scenario) mesh
+# ---------------------------------------------------------------------------
+
+
+class TestShardedEngine:
+    def test_sharded_replies_bit_identical(self):
+        """mesh_scenario >= 1 routes the engine onto ShardedSimulation's
+        scenario dispatch: buckets round UP to multiples of the scenario
+        mesh dim (padding rows fold nothing, so a rounded bucket answers
+        identically) and every reply matches the unsharded engine's
+        bits — including a single request padded to the aligned bucket."""
+        base = scfg(n_chains=8)
+        reqs = [
+            req("a", Scenario(horizon_s=120)),
+            req("b", Scenario(demand_scale=1.5, demand_shift_w=250.0,
+                              horizon_s=120), mode="fleet"),
+            req("c", Scenario(weather_bias=0.5, dc_capacity_scale=2.0,
+                              curtail_w=4000.0, horizon_s=60),
+                mode="quantiles"),
+        ]
+        with use_registry(MetricsRegistry()):
+            plain = ScenarioEngine(base, (1, 4))
+        with use_registry(MetricsRegistry()):
+            sharded = ScenarioEngine(
+                dataclasses.replace(base, mesh_scenario=2), (1, 4))
+        assert plain.batch_align == 1 and plain.buckets == (1, 4)
+        assert sharded.batch_align == 2
+        assert sharded.buckets == (2, 4)  # bucket 1 rounds up to 2
+        assert sharded.run(reqs) == plain.run(reqs)
+        # single request: sharded pads to bucket 2, plain runs bucket 1
+        assert sharded.run(reqs[:1]) == plain.run(reqs[:1])
 
 
 # ---------------------------------------------------------------------------
@@ -550,7 +613,7 @@ class TestServingReport:
         rep = RunReport("pvsim.serve")
         rep.attach_metrics(_serving_registry())
         doc = rep.doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 12
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 13
         validate_report(doc)
         doc2 = json.loads(json.dumps(doc))
         validate_report(doc2)

@@ -409,6 +409,10 @@ def _resume_source(checkpoint, ckpt_global, sim):
 def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               start: Optional[str] = None, chain: int = 0,
               sharded: bool = False,
+              mesh_scenario: int = 0,
+              coordinator: Optional[str] = None,
+              num_processes: Optional[int] = None,
+              process_id: Optional[int] = None,
               checkpoint: Optional[str] = None,
               block_s: Optional[int] = None,
               realtime: bool = False,
@@ -540,7 +544,12 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         try:
             sim = _pvsim_jax_run(
                 file, duration_s, n_chains, seed, start=start,
-                chain=chain, sharded=sharded, checkpoint=checkpoint,
+                chain=chain, sharded=sharded,
+                mesh_scenario=mesh_scenario,
+                coordinator=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+                checkpoint=checkpoint,
                 block_s=block_s, realtime=realtime, site_grid=site_grid,
                 fleet=fleet,
                 profile_dir=profile_dir, output=output,
@@ -609,6 +618,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
             rep.precision = prec
     if profile_dir:
         rep.profile = read_manifest(profile_dir)
+    if getattr(sim, "mesh", None) is not None:
+        from tmhpvsim_tpu.parallel.distributed import mesh_doc
+
+        rep.mesh = mesh_doc(sim.mesh, n_chains=sim.config.n_chains)
     if jax.process_count() > 1:
         from tmhpvsim_tpu.parallel.distributed import gather_metrics
 
@@ -622,6 +635,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
 def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    start: Optional[str] = None, chain: int = 0,
                    sharded: bool = False,
+                   mesh_scenario: int = 0,
+                   coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
                    checkpoint: Optional[str] = None,
                    block_s: Optional[int] = None,
                    realtime: bool = False,
@@ -664,7 +681,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
     from tmhpvsim_tpu.obs import cost as obs_cost
     from tmhpvsim_tpu.obs import metrics as obs_metrics
     from tmhpvsim_tpu.obs.profiler import BlockTimer, device_trace
-    from tmhpvsim_tpu.parallel.distributed import initialize_from_env
+    from tmhpvsim_tpu.parallel.distributed import initialize
 
     reg = obs_metrics.get_registry()
 
@@ -676,13 +693,19 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         reg.gauge("resilience.supervised_restarts").set(int(restart))
 
     # Join a pod slice when launched under a multi-host runtime; no-op
-    # single-process.  Must run before any jax.devices() query.  Guarded:
-    # stale coordinator env vars in a shell must degrade to a single-host
-    # run, not kill the simulation (the failure class that cost round 1
-    # its benchmark).
+    # single-process.  Explicit --coordinator/--num-processes/--process-id
+    # flags override the env-var equivalents.  Must run before any
+    # jax.devices() query.  Guarded: stale coordinator env vars in a
+    # shell must degrade to a single-host run, not kill the simulation
+    # (the failure class that cost round 1 its benchmark) — but an
+    # EXPLICIT coordinator that fails must fail loudly, not silently run
+    # a duplicate single-host simulation.
     try:
-        initialize_from_env()
+        initialize(coordinator=coordinator, num_processes=num_processes,
+                   process_id=process_id)
     except Exception as e:
+        if coordinator:
+            raise
         logger.warning("jax.distributed init failed (%s); continuing "
                        "single-host", e)
 
@@ -746,6 +769,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         checkpoint_keep=checkpoint_keep,
         checkpoint_async=checkpoint_async,
         preempt_grace_s=preempt_grace_s,
+        mesh_scenario=mesh_scenario,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation

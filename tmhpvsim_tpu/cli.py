@@ -225,6 +225,27 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
               help="Which chain to write to FILE (jax backend)")
 @click.option("--sharded/--no-sharded", default=False,
               help="Shard chains over all available devices (jax backend)")
+@click.option("--mesh-scenario", "mesh_scenario", type=int, default=0,
+              show_default=True, metavar="M",
+              help="Scenario axis length of the 2D (chains, scenario) "
+                   "device mesh (jax backend, with --sharded): 0 keeps "
+                   "the flat 1D chain mesh; M >= 1 reshapes the device "
+                   "pool to (n_devices//M, M).  Batch results are "
+                   "bit-identical under any M; scenario serving "
+                   "parallelises what-if batches over the scenario "
+                   "axis (parallel/mesh.py)")
+@click.option("--coordinator", "coordinator", default=None,
+              envvar="JAX_COORDINATOR_ADDRESS", metavar="HOST:PORT",
+              help="jax.distributed coordinator address for multi-host "
+                   "runs (jax backend; env JAX_COORDINATOR_ADDRESS)")
+@click.option("--num-processes", "num_processes", type=int, default=None,
+              envvar="JAX_NUM_PROCESSES", metavar="K",
+              help="Total process count of the multi-host run (jax "
+                   "backend; env JAX_NUM_PROCESSES)")
+@click.option("--process-id", "process_id", type=int, default=None,
+              envvar="JAX_PROCESS_ID", metavar="I",
+              help="This process's index in [0, K) (jax backend; env "
+                   "JAX_PROCESS_ID)")
 @click.option("--checkpoint", default=None,
               help="Checkpoint file: saved per block, resumed when present "
                    "(jax backend)")
@@ -408,7 +429,8 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
 @_obs_port_option
 @_chaos_options
 def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
-          start, trace, backend, n_chains, chain, sharded, checkpoint,
+          start, trace, backend, n_chains, chain, sharded, mesh_scenario,
+          coordinator, num_processes, process_id, checkpoint,
           block_s, site_grid_spec, sites_csv, fleet_csv, fleet_synth,
           fleet_seed, profile_dir, output,
           prng_impl, block_impl, tune, telemetry, telemetry_strict,
@@ -457,6 +479,16 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--analytics requires --backend=jax")
     if compile_cache is not None and backend != "jax":
         raise click.UsageError("--compile-cache requires --backend=jax")
+    if mesh_scenario != 0 and backend != "jax":
+        raise click.UsageError("--mesh-scenario requires --backend=jax")
+    if mesh_scenario < 0:
+        raise click.UsageError("--mesh-scenario must be >= 0")
+    if mesh_scenario != 0 and not sharded:
+        raise click.UsageError("--mesh-scenario requires --sharded")
+    if (coordinator or num_processes is not None
+            or process_id is not None) and backend != "jax":
+        raise click.UsageError("--coordinator/--num-processes/--process-id "
+                               "require --backend=jax")
     if blocks_per_dispatch != 0 and backend != "jax":
         raise click.UsageError("--blocks-per-dispatch requires "
                                "--backend=jax")
@@ -521,7 +553,12 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 
                 seed = secrets.randbits(31)
         pvsim_jax(file, duration_s, n_chains, seed, start, chain,
-                  sharded, checkpoint, block_s, realtime=realtime,
+                  sharded, checkpoint=checkpoint, block_s=block_s,
+                  realtime=realtime,
+                  mesh_scenario=mesh_scenario,
+                  coordinator=coordinator,
+                  num_processes=num_processes,
+                  process_id=process_id,
                   site_grid=site_grid, fleet=fleet,
                   profile_dir=profile_dir,
                   output=output, prng_impl=prng_impl,
@@ -587,6 +624,14 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
               default="off",
               help="runtime autotuner for the served plan "
                    "(config.SimConfig.tune)")
+@click.option("--mesh-scenario", "mesh_scenario", type=int, default=0,
+              metavar="M", show_default=True,
+              help="width of the scenario axis of a 2-D (chains, "
+                   "scenario) device mesh: the vmapped request batch "
+                   "shards over M scenario shards while chains shard "
+                   "over the rest; batch buckets round UP to multiples "
+                   "of M (padding rows are bit-inert).  0 = unsharded "
+                   "serving (the default)")
 @click.option("--window-ms", type=float, default=10.0, show_default=True,
               help="micro-batch coalescing window: the first pending "
                    "request waits at most this long for company before "
@@ -634,10 +679,10 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 @_obs_port_option
 @_chaos_options
 def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
-          block_s, block_impl, tune, window_ms, max_batch, batch_sizes,
-          queue_limit, timeout_s, drain_timeout_s, supervise, trace,
-          metrics_path, run_report_path, compile_cache, obs_port, chaos,
-          chaos_seed):
+          block_s, block_impl, tune, mesh_scenario, window_ms, max_batch,
+          batch_sizes, queue_limit, timeout_s, drain_timeout_s, supervise,
+          trace, metrics_path, run_report_path, compile_cache, obs_port,
+          chaos, chaos_seed):
     """Long-lived scenario server: a warm simulation answering "what-if"
     queries over the broker (serve/).  Each request perturbs bounded
     scenario knobs (demand scale/shift, DC-capacity scale, weather
@@ -650,8 +695,11 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
     _setup_logging(verbose)
     _maybe_supervise("serve", supervise)
     _activate_chaos(chaos, chaos_seed)
+    if mesh_scenario < 0:
+        raise click.UsageError("--mesh-scenario must be >= 0")
     sim_kw = dict(duration_s=duration_s, n_chains=n_chains, seed=seed,
-                  output="reduce", block_impl=block_impl, tune=tune)
+                  output="reduce", block_impl=block_impl, tune=tune,
+                  mesh_scenario=mesh_scenario)
     if start:
         sim_kw["start"] = start
     sim_kw["block_s"] = block_s if block_s else min(8640, duration_s)

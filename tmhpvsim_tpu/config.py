@@ -589,6 +589,18 @@ class SimConfig:
     #: nothing).
     serve_batch_sizes: tuple = ()
 
+    #: scenario axis length M of the sharded run's device mesh
+    #: (parallel/mesh.py ``make_mesh``): 0 (the default) keeps the flat
+    #: 1-D ``(chains,)`` mesh; M >= 1 builds the named 2-D
+    #: ``(n_devices // M, M)`` ``(chains, scenario)`` mesh.  Batch runs
+    #: treat both axes as one data-parallel pool (an ``(N, 1)`` mesh is
+    #: byte-identical HLO to 1-D; ``(N, M)`` is bit-identical to
+    #: ``(N*M,)``); scenario SERVING maps the request batch onto the
+    #: ``scenario`` axis so what-if batches parallelise across chips.
+    #: Execution layout only — NOT part of the checkpoint config echo
+    #: (resume under a different mesh is elastic by design).
+    mesh_scenario: int = 0
+
     #: checkpoint generations retained on disk (engine/checkpoint.py
     #: rotation: the anchor plus the newest N ``.g<gen>`` siblings named
     #: by the sidecar manifest).  Operational robustness, not identity —

@@ -548,7 +548,7 @@ def cache_path() -> str:
     return os.path.join(root, "tmhpvsim_tpu", "autotune.json")
 
 
-def plan_key(config: SimConfig) -> str:
+def plan_key(config: SimConfig, mesh_shape=None) -> str:
     """Cache key: everything the winning plan is conditional on — the
     device model + backend and the shape/dtype/PRNG knobs that move the
     optimum — plus the engine version (stale formulations never match)."""
@@ -567,6 +567,14 @@ def plan_key(config: SimConfig) -> str:
     if getattr(config, "fleet", None) is not None:
         parts.append(
             f"fleet{len(config.fleet)}-{config.fleet.digest()[:12]}")
+    # a scenario mesh axis changes the serving dispatch each chip
+    # compiles, so (N, M>1) meshes key separately.  1-D and (N, 1)
+    # meshes share the historical key on purpose: they lower to
+    # byte-identical HLO (parallel/mesh.py), so their optima are the
+    # same plan and existing cache entries stay warm.
+    if mesh_shape is not None and len(mesh_shape) > 1 and \
+            int(mesh_shape[1]) > 1:
+        parts.append("mesh" + "x".join(str(int(s)) for s in mesh_shape))
     return "|".join(str(x) for x in parts)
 
 
@@ -674,7 +682,8 @@ def cached_candidates(config: SimConfig) -> list:
 # ---------------------------------------------------------------------------
 
 
-def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
+def resolve_plan(config: SimConfig, slabs: bool = True,
+                 mesh_shape=None) -> Plan:
     """The plan a :class:`Simulation` of ``config`` should run.
 
     ``tune='off'``: the static plan (no measurement, no cache IO).
@@ -688,7 +697,7 @@ def resolve_plan(config: SimConfig, slabs: bool = True) -> Plan:
             f"tune must be 'auto', 'off' or 'force', got {config.tune!r}"
         )
     path = cache_path()
-    key = plan_key(config)
+    key = plan_key(config, mesh_shape=mesh_shape)
     if config.tune == "auto":
         entry = _load_cache(path).get(key)
         if entry is not None:
@@ -784,12 +793,15 @@ def broadcast_plan(plan: Plan) -> Plan:
     )
 
 
-def resolve_plan_for_mesh(config: SimConfig, n_dev: int) -> Plan:
+def resolve_plan_for_mesh(config: SimConfig, n_dev: int,
+                          mesh_shape=None) -> Plan:
     """Plan resolution for a sharded run over ``n_dev`` devices: probe at
     the PER-DEVICE chain shape (that is what each chip executes under
     shard_map), on process 0 only, and broadcast the winner so every host
-    runs the same plan.  Slabbing is disabled — the sharded loop drives
-    all devices in lockstep, so the slab dimension does not apply."""
+    runs the same plan.  ``mesh_shape`` (the mesh's device-grid shape)
+    joins the cache key — see :func:`plan_key`.  Slabbing is disabled —
+    the sharded loop drives all devices in lockstep, so the slab
+    dimension does not apply."""
     import jax
 
     if config.tune == "off":
@@ -810,7 +822,7 @@ def resolve_plan_for_mesh(config: SimConfig, n_dev: int) -> Plan:
         if jax.process_count() > 1 and jax.process_index() != 0:
             plan = static_plan(pcfg)  # replaced by the broadcast below
         else:
-            plan = resolve_plan(pcfg, slabs=False)
+            plan = resolve_plan(pcfg, slabs=False, mesh_shape=mesh_shape)
         plan = broadcast_plan(plan)
     # slabbing never applies to the sharded loop; pin it off
     n_eff = (len(config.site_grid) if config.site_grid is not None

@@ -41,7 +41,12 @@ chain count — are still refused with the exact config-diff error;
 placement deltas never refuse: ``load_elastic`` reassembles per-host
 ``PATH.host<i>`` shards into the full chain axis and reslices to the
 resuming topology, so a run saved on 8 devices (or K host shards)
-resumes on 1 device or a different mesh.
+resumes on 1 device or a different mesh.  The layout's ``mesh_shape``
+is descriptive only — 1-D ``[N]`` and 2-D ``[N, M]`` (chains x
+scenario, parallel/mesh.py) meshes both reduce to the same contiguous
+``chain_start``/``chain_stop`` records, so resumes are elastic across
+mesh RANK too: a 1-host 1-D checkpoint resumes on a 2-host 2-D mesh
+and vice versa (tests/test_distributed.py).
 """
 
 from __future__ import annotations

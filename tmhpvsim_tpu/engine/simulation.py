@@ -1738,11 +1738,13 @@ class Simulation:
             self._scn_fleet_params = flt.params_from_config(self.config)
         return self._scn_fleet_params
 
-    def init_scenario_acc(self, batch: int):
+    def init_scenario_acc(self, batch: int, sharding=None):
         """Zero reduce accumulator with a leading scenario axis: one
         (batch, n_chains) leaf per statistic, same init values as
         :meth:`init_reduce_acc` so row ``i`` of a batch-of-N run folds
-        exactly what a batch-of-1 run of scenario ``i`` folds."""
+        exactly what a batch-of-1 run of scenario ``i`` folds.  The
+        sharded subclass passes ``sharding`` to lay the batch axis over
+        the ``scenario`` mesh axis (parallel/mesh.py)."""
         n = self.config.n_chains
         dt = self.dtype
         b = int(batch)
@@ -1756,7 +1758,7 @@ class Simulation:
                 for name, (kind, dkind) in REDUCE_STATS.items()
             }
 
-        return self._memo_jit(("scenario_acc", b), None, build)()
+        return self._memo_jit(("scenario_acc", b), sharding, build)()
 
     def scenario_abstract(self, batch: int):
         """ShapeDtypeStructs of a (batch,)-leaf scenario knob pytree —
@@ -1794,24 +1796,41 @@ class Simulation:
         block's scalar-form FleetAcc per scenario (zero-initialised
         inside the jit — a pure per-block delta for the host merge).
         """
+        # bounded site selector: chain iota vs the request's site index /
+        # cohort tag.  -1 selects everything (an all-true mask folds the
+        # same values, so whole-fleet replies are unchanged).  Closure
+        # constants are safe in THIS unsharded wrapper; the sharded
+        # dispatch (parallel/mesh.py) feeds the core explicit
+        # chain-sharded device arguments instead, so each shard's rows
+        # carry their true global chain ids.
+        iota = jnp.arange(self.config.n_chains, dtype=jnp.int32)
+        cohort_arr = (jnp.asarray(self._fleet.cohort, jnp.int32)
+                      if self._fleet is not None
+                      and self._fleet.n_cohorts > 1 else None)
+        return self._scenario_block_core(state, inputs, acc, scen,
+                                         iota, cohort_arr)
+
+    def _scenario_block_core(self, state, inputs, acc, scen, chain_ids,
+                             cohort_arr):
+        """Body of :meth:`_block_step_scan_scenario` with the chain ids
+        and cohort tags as explicit arguments.  ``chain_ids`` is the
+        GLOBAL index of each local chain row (the full iota unsharded; a
+        shard's slice of it under shard_map — shapes size the local
+        accumulators, values key the site selector).  ``cohort_arr`` is
+        the per-chain cohort tag, or None / a 0-d placeholder when the
+        fleet has no cohorts (shard_map cannot pass None)."""
         cfg = self.config
         dtype = self.dtype
         big = jnp.asarray(jnp.finfo(dtype).max, dtype)
         params = self.scenario_fleet_params()
         batch = scen["horizon_s"].shape[0]
+        if cohort_arr is not None and cohort_arr.ndim == 0:
+            cohort_arr = None
         xs, step, cc_carry = self._scan_block_setup(state, inputs)
         facc = jax.tree.map(
             lambda l: jnp.broadcast_to(l, (batch,) + l.shape),
-            flt.init_acc("risk", dtype, cfg.n_chains, params=params))
-        # bounded site selector: chain iota vs the request's site index /
-        # cohort tag.  -1 selects everything (an all-true mask folds the
-        # same values, so whole-fleet replies are unchanged).  Closure
-        # constants are safe here: the scenario jit never runs sharded
-        # (ScenarioEngine always wraps a plain Simulation).
-        iota = jnp.arange(cfg.n_chains, dtype=jnp.int32)
-        cohort_arr = (jnp.asarray(self._fleet.cohort, jnp.int32)
-                      if self._fleet is not None
-                      and self._fleet.n_cohorts > 1 else None)
+            flt.init_acc("risk", dtype, chain_ids.shape[0], params=params))
+        iota = chain_ids
 
         def body(carry, x):
             rc, st, fa = carry

@@ -26,8 +26,7 @@ from typing import Iterator
 
 import numpy as np
 
-from tmhpvsim_tpu.config import Plan, SimConfig, slice_grid
-from tmhpvsim_tpu import fleet as fleet_mod
+from tmhpvsim_tpu.config import Plan, SimConfig
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs.profiler import annotate
 
@@ -55,19 +54,16 @@ class SlabScheduler:
         self.plan = plan
         total = config.n_chains
         slab = plan.slab_chains
+        # the same keyed chain-range carving the multi-host path uses
+        # per process (parallel/distributed.carve_config) — one shared
+        # definition of "chains [off, off+n) of a notional total run"
+        from tmhpvsim_tpu.parallel.distributed import carve_config
+
         self.slab_cfgs = []
         for off in range(0, total, slab):
             n = min(slab, total - off)
-            self.slab_cfgs.append(dataclasses.replace(
-                config,
-                tune="off",  # the plan is already resolved
-                n_chains=n,
-                n_chains_total=total,
-                chain_offset=off,
-                site_grid=slice_grid(config.site_grid, off, n),
-                fleet=(fleet_mod.slice_fleet(config.fleet, off, n)
-                       if config.fleet is not None else None),
-            ))
+            self.slab_cfgs.append(carve_config(config, off, n,
+                                               total=total))
         # merged fleet-analytics total across slabs (None when analytics
         # is off); every risk leaf merges by exact int sum / extremum so
         # the slabbed fleet section is bit-identical to the unslabbed one

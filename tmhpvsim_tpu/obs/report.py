@@ -86,9 +86,18 @@ logger = logging.getLogger(__name__)
 #: digest, mirroring the checkpoint echo).  All additive — a v11
 #: reader of the fleet section's original keys is unaffected, and
 #: documents omitting them mean a homogeneous (fleet-less) run.
+#: v13: adds the optional ``mesh`` section (pod-scale execution,
+#: parallel/mesh.py + parallel/distributed.py ``mesh_doc``): the device
+#: grid's ``shape`` and ``axis_names`` (1D ``["chains"]`` or 2D
+#: ``["chains", "scenario"]``), ``n_devices``, the process topology
+#: (``process_count``/``process_index``) and, when known, the chain
+#: layout (``n_chains``, ``chains_per_device``, this process's
+#: ``chain_start``/``chain_stop``).  Additive — unsharded runs omit it
+#: (None), and a v12 reader ignores the extra key only if it reads
+#: leniently; strict v12 readers should bump.
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 12
+REPORT_SCHEMA_VERSION = 13
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -121,6 +130,7 @@ _TOP_SCHEMA = {
     "precision": (False, _OPT_DICT),
     "probe": (False, _OPT_DICT),
     "cost": (False, _OPT_DICT),
+    "mesh": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -195,6 +205,63 @@ def validate_fleet_section(sec: dict) -> list:
     return errors
 
 
+def validate_mesh_section(sec: dict) -> list:
+    """Shape-check the v13 ``mesh`` section; returns a list of error
+    strings (empty = valid).  Checks internal consistency too: the
+    shape's product must equal ``n_devices`` and pair up with
+    ``axis_names``, and a chain layout (when present) must divide
+    evenly and bound the process's slice."""
+    errors = []
+    shape = sec.get("shape")
+    axes = sec.get("axis_names")
+    if not (isinstance(shape, list) and shape
+            and all(isinstance(s, int) and s >= 1 for s in shape)):
+        errors.append("shape: expected a non-empty list of ints >= 1")
+        shape = None
+    if not (isinstance(axes, list) and axes
+            and all(isinstance(a, str) for a in axes)):
+        errors.append("axis_names: expected a non-empty list of strings")
+        axes = None
+    if shape is not None and axes is not None and len(shape) != len(axes):
+        errors.append(f"shape/axis_names: rank mismatch "
+                      f"({len(shape)} vs {len(axes)})")
+    n_dev = sec.get("n_devices")
+    if not isinstance(n_dev, int) or n_dev < 1:
+        errors.append("n_devices: expected an int >= 1")
+    elif shape is not None:
+        prod = 1
+        for s in shape:
+            prod *= s
+        if prod != n_dev:
+            errors.append(f"n_devices: {n_dev} != product(shape) {prod}")
+    for key in ("process_count", "process_index"):
+        if key in sec and (not isinstance(sec[key], int) or sec[key] < 0):
+            errors.append(f"{key}: expected an int >= 0")
+    if isinstance(sec.get("process_count"), int) and \
+            isinstance(sec.get("process_index"), int) and \
+            sec["process_index"] >= sec["process_count"] >= 1:
+        errors.append("process_index: outside [0, process_count)")
+    nc = sec.get("n_chains")
+    if nc is not None:
+        if not isinstance(nc, int) or nc < 1:
+            errors.append("n_chains: expected an int >= 1 or absent")
+        elif isinstance(n_dev, int) and n_dev >= 1:
+            if nc % n_dev != 0:
+                errors.append(f"n_chains: {nc} not divisible by "
+                              f"n_devices {n_dev}")
+            cpd = sec.get("chains_per_device")
+            if cpd is not None and cpd != nc // n_dev:
+                errors.append(f"chains_per_device: {cpd} != "
+                              f"{nc // n_dev}")
+        lo, hi = sec.get("chain_start"), sec.get("chain_stop")
+        if lo is not None and hi is not None and isinstance(nc, int):
+            if not (isinstance(lo, int) and isinstance(hi, int)
+                    and 0 <= lo <= hi <= nc):
+                errors.append("chain_start/chain_stop: expected "
+                              f"0 <= start <= stop <= n_chains ({nc})")
+    return errors
+
+
 def validate_report(doc) -> dict:
     """Validate ``doc`` against the versioned schema; returns it.
 
@@ -228,6 +295,10 @@ def validate_report(doc) -> dict:
         errors = validate_fleet_section(doc["fleet"])
         if errors:
             raise ValueError("run report fleet: " + "; ".join(errors))
+    if isinstance(doc.get("mesh"), dict):
+        errors = validate_mesh_section(doc["mesh"])
+        if errors:
+            raise ValueError("run report mesh: " + "; ".join(errors))
     try:
         json.dumps(doc)
     except (TypeError, ValueError) as e:
@@ -545,6 +616,10 @@ class RunReport:
         #: ``obs.cost.cost_doc`` by every path that measures a site-s/s
         #: rate (apps/pvsim.py jax wrapper, bench.py, serve shutdown)
         self.cost: Optional[dict] = None
+        #: mesh/topology section (schema v13): set from
+        #: ``parallel.distributed.mesh_doc`` by sharded runs — device
+        #: grid shape + axis names, process topology, chain layout
+        self.mesh: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -648,6 +723,7 @@ class RunReport:
             "precision": self.precision,
             "probe": self.probe,
             "cost": self.cost,
+            "mesh": self.mesh,
         }
         return validate_report(out) if validate else out
 

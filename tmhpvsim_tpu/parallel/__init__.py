@@ -16,7 +16,10 @@ except ImportError:  # jax 0.4.x: experimental home, kwarg is ``check_rep``
 
 
 from tmhpvsim_tpu.parallel.mesh import (  # noqa: E402,F401
+    CHAIN_AXIS,
+    SCENARIO_AXIS,
     ShardedSimulation,
     chain_sharding,
     make_mesh,
+    scenario_sharding,
 )

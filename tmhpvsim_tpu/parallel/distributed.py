@@ -37,28 +37,35 @@ def _already_initialized() -> bool:
     return getattr(state, "client", None) is not None
 
 
-def initialize_from_env() -> bool:
-    """Initialise ``jax.distributed`` when running under a multi-host
-    launcher; no-op (returns False) in single-process runs.
+def initialize(coordinator=None, num_processes=None,
+               process_id=None) -> bool:
+    """Initialise ``jax.distributed`` for a multi-host run; no-op
+    (returns False) when the resolved process count is < 2.
 
-    TPU pod runtimes set the coordinator address and process ids in the
-    environment; GPU/CPU launchers can export ``JAX_COORDINATOR_ADDRESS``,
-    ``JAX_NUM_PROCESSES`` and ``JAX_PROCESS_ID`` explicitly.
+    Explicit arguments (the ``--coordinator/--num-processes/--process-id``
+    CLI flags) take precedence; any left None falls back to its env-var
+    equivalent (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+    ``JAX_PROCESS_ID``) so launchers that export the environment and
+    launchers that template argv both work.
     """
     # NB: the env vars must be inspected BEFORE any jax query that can
     # initialise a backend — even jax.process_count() does, after which
     # jax.distributed.initialize() is forbidden.
     if _already_initialized():
         return True  # already initialised by the runtime/launcher
-    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    addr = (coordinator if coordinator
+            else os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    nproc = (num_processes if num_processes is not None
+             else os.environ.get("JAX_NUM_PROCESSES"))
+    pid = (process_id if process_id is not None
+           else os.environ.get("JAX_PROCESS_ID", "0"))
     try:
         nproc_i = int(nproc) if nproc else 0
-        pid_i = int(os.environ.get("JAX_PROCESS_ID", "0"))
+        pid_i = int(pid)
     except ValueError:
         logger.warning(
-            "malformed JAX_NUM_PROCESSES/JAX_PROCESS_ID (%r/%r); staying "
-            "single-process", nproc, os.environ.get("JAX_PROCESS_ID"),
+            "malformed num_processes/process_id (%r/%r); staying "
+            "single-process", nproc, pid,
         )
         return False
     if not addr or nproc_i <= 1:
@@ -74,6 +81,13 @@ def initialize_from_env() -> bool:
         jax.local_device_count(), jax.device_count(),
     )
     return True
+
+
+def initialize_from_env() -> bool:
+    """Initialise ``jax.distributed`` from the environment alone — the
+    historical entry point; equivalent to :func:`initialize` with no
+    explicit arguments."""
+    return initialize()
 
 
 def local_chain_slice(n_chains: int, mesh) -> slice:
@@ -121,6 +135,67 @@ def chain_layout(n_chains: int, mesh=None) -> dict:
     else:
         lay["n_devices"] = 1
     return lay
+
+
+def carve_config(config, offset: int, n: int, total=None):
+    """Chain-range sub-view [offset, offset+n) of ``config``: the keyed
+    construction that makes slabbed, sharded and multi-host runs EXACT —
+    per-chain keys come from ``split(seed-key, n_chains_total)`` sliced
+    at the offset, and the site grid / fleet pytrees are sliced to the
+    same rows (``slice_grid``/``slice_fleet``).  ``tune`` is pinned off:
+    every carve happens after plan resolution (engine/slab.py per slab,
+    this module per process)."""
+    import dataclasses
+
+    from tmhpvsim_tpu import fleet as fleet_mod
+    from tmhpvsim_tpu.config import slice_grid
+
+    total = config.n_chains if total is None else int(total)
+    return dataclasses.replace(
+        config,
+        tune="off",
+        n_chains=int(n),
+        n_chains_total=total,
+        chain_offset=int(offset),
+        site_grid=slice_grid(config.site_grid, offset, n),
+        fleet=(fleet_mod.slice_fleet(config.fleet, offset, n)
+               if config.fleet is not None else None),
+    )
+
+
+def carve_process_config(config, mesh):
+    """The chain-range sub-view THIS process owns under ``mesh`` — the
+    per-host carving for host-side work (per-host CSV writers, fleet
+    digests, host-local validation).  Device-side state needs no carving
+    (``init_state`` compiles with out_shardings and each host fills only
+    its addressable shards); this is for the host halves of the
+    pipeline.  Single-process meshes return ``config`` unchanged."""
+    if jax.process_count() == 1:
+        return config
+    sl = local_chain_slice(config.n_chains, mesh)
+    return carve_config(config, sl.start, sl.stop - sl.start,
+                        total=config.n_chains)
+
+
+def mesh_doc(mesh, n_chains=None) -> dict:
+    """The run report's ``mesh`` section (obs/report.py schema v13):
+    device-grid shape and axis names, plus the process topology —
+    everything a reader needs to interpret per-host artefacts and the
+    sharded throughput numbers."""
+    doc = {
+        "shape": [int(s) for s in mesh.devices.shape],
+        "axis_names": [str(a) for a in mesh.axis_names],
+        "n_devices": int(mesh.devices.size),
+        "process_count": int(jax.process_count()),
+        "process_index": int(jax.process_index()),
+    }
+    if n_chains is not None:
+        doc["n_chains"] = int(n_chains)
+        doc["chains_per_device"] = int(n_chains) // int(mesh.devices.size)
+        sl = local_chain_slice(int(n_chains), mesh)
+        doc["chain_start"] = int(sl.start)
+        doc["chain_stop"] = int(sl.stop)
+    return doc
 
 
 def host_gather_ensemble(arr) -> np.ndarray:

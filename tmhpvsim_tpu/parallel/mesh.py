@@ -8,8 +8,8 @@ simulation across the chips of a ``jax.sharding.Mesh`` and replaces the
 broker with in-process XLA collectives over ICI:
 
 * every per-chain quantity (sampler arrays, renewal carry, keys, traces)
-  is sharded on the ``chains`` mesh axis — pure data parallelism, zero
-  communication in the hot loop;
+  is sharded on the mesh — pure data parallelism, zero communication in
+  the hot loop;
 * cross-chain *ensemble* statistics (the "grid operator" view: aggregate
   residual load per second over the whole fleet) are one ``psum`` per
   block over ICI — the only collective the workload needs, exactly where
@@ -18,13 +18,26 @@ broker with in-process XLA collectives over ICI:
   ``jax.distributed`` (parallel/distributed.py); each host feeds and
   gathers only its addressable shard.
 
+The mesh is either the historical 1-D ``(chains,)`` layout or a named
+2-D ``(chains, scenario)`` grid (:func:`make_mesh`).  Batch runs treat
+the two mesh axes as one flat data-parallel pool: chain-indexed leaves
+shard over *both* axes (``P((CHAIN_AXIS, SCENARIO_AXIS))``) and every
+collective reduces over the axis-name tuple, so a ``(N, M)`` mesh is
+purely a layout decision — an ``(N, 1)`` mesh compiles to byte-identical
+HLO vs the 1-D path, and ``(N, M)`` results are bit-identical to the
+``(N*M,)`` 1-D mesh (tests/test_parallel.py).  Scenario *serving* is
+where the second axis earns its name: the scenario-batched dispatch
+(``Simulation._block_step_scan_scenario``) maps the request batch onto
+``scenario`` and the chain axis onto ``chains``, so a ``pvsim serve``
+what-if batch parallelises across chips instead of timesharing one.
+
 Tested on 8 virtual CPU devices (tests/conftest.py sets
 ``--xla_force_host_platform_device_count=8``; SURVEY.md §4).
 """
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Sequence
+from typing import Iterator, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -39,23 +52,73 @@ from tmhpvsim_tpu.config import SimConfig
 from tmhpvsim_tpu.engine.simulation import BlockResult, Simulation
 
 CHAIN_AXIS = "chains"
+SCENARIO_AXIS = "scenario"
 
 
-def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
-    """A 1-D mesh over all (or the given) devices, axis name ``chains``.
+def make_mesh(chain_devices: Optional[Sequence] = None,
+              scenario_devices: Union[int, Sequence, None] = None) -> Mesh:
+    """A mesh over all (or the given) devices.
 
-    The workload is embarrassingly parallel over chains, so a flat 1-D mesh
-    is the right topology on any slice shape: XLA maps the single axis onto
-    the physical ICI torus itself, and the one collective we issue (psum of
-    per-second ensemble sums) rides nearest-neighbour rings.
+    ``scenario_devices=None`` (the historical signature) builds the flat
+    1-D ``(chains,)`` mesh: the workload is embarrassingly parallel over
+    chains, XLA maps the single axis onto the physical ICI torus itself,
+    and the one collective we issue (psum of per-second ensemble sums)
+    rides nearest-neighbour rings.
+
+    ``scenario_devices=M`` (an int, or a sequence whose length is taken)
+    builds the named 2-D ``(chains, scenario)`` mesh: the flat device
+    list reshaped C-order to ``(n_devices // M, M)`` — the
+    mesh-construction pattern of SNIPPETS.md [3] — so chains stay
+    contiguous over the flat device list and the per-host slice
+    arithmetic (:func:`~tmhpvsim_tpu.parallel.distributed.local_chain_slice`)
+    is layout-independent.  ``M=1`` is a genuine 2-D mesh that lowers to
+    byte-identical HLO vs the 1-D path (tests/test_parallel.py).
     """
-    devices = list(jax.devices()) if devices is None else list(devices)
-    return Mesh(np.asarray(devices), (CHAIN_AXIS,))
+    devices = (list(jax.devices()) if chain_devices is None
+               else list(chain_devices))
+    if scenario_devices is None:
+        return Mesh(np.asarray(devices), (CHAIN_AXIS,))
+    m = (int(scenario_devices) if isinstance(scenario_devices, int)
+         else len(list(scenario_devices)))
+    if m < 1:
+        raise ValueError(f"scenario_devices={m} must be >= 1")
+    if len(devices) % m != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not divide into a scenario axis "
+            f"of {m}"
+        )
+    grid = np.asarray(devices).reshape(len(devices) // m, m)
+    return Mesh(grid, (CHAIN_AXIS, SCENARIO_AXIS))
+
+
+def data_axes(mesh: Mesh):
+    """The axis-name argument chain-indexed data shards over: the bare
+    ``chains`` name on a 1-D mesh, the ``(chains, scenario)`` tuple on a
+    2-D mesh (batch runs treat both axes as one flat data-parallel
+    pool; ``jax.lax.psum``/``pmin``/``pmax`` accept the tuple form, so
+    the leaf-kind dispatch in ``psum_telemetry``/``psum_fleet`` is
+    reused unchanged)."""
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
 
 
 def chain_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding that splits the leading (chain) axis across the mesh."""
-    return NamedSharding(mesh, P(CHAIN_AXIS))
+    """Sharding that splits the leading (chain) axis across the mesh —
+    over every mesh axis, so a ``(N, M)`` mesh gives ``N*M`` chain
+    shards."""
+    return NamedSharding(mesh, P(data_axes(mesh)))
+
+
+def scenario_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for ``(batch, chains)`` scenario accumulators on a 2-D
+    mesh: batch over ``scenario``, chains over ``chains``.  Requires a
+    mesh built with ``make_mesh(scenario_devices=...)``."""
+    if SCENARIO_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no {SCENARIO_AXIS!r} axis; build "
+            "it with make_mesh(scenario_devices=...)"
+        )
+    return NamedSharding(mesh, P(SCENARIO_AXIS, CHAIN_AXIS))
 
 
 class ShardedSimulation(Simulation):
@@ -64,12 +127,16 @@ class ShardedSimulation(Simulation):
     Differences from the single-chip parent:
 
     * ``init_state()`` lays out every chain-indexed leaf with a
-      ``NamedSharding`` over the ``chains`` axis (n_chains must divide by
-      the mesh size);
+      ``NamedSharding`` over the mesh's data axes (n_chains must divide
+      by the mesh size);
     * the block step runs under ``shard_map``; a separate consumer jit
       reduces the per-second ensemble sums of pv and residual over *all*
       chains with ``psum`` over ICI, replicated on every chip;
-    * BlockResults carry the global ensemble means in ``.ensemble``.
+    * BlockResults carry the global ensemble means in ``.ensemble``;
+    * on a 2-D ``(chains, scenario)`` mesh the scenario-batched serving
+      dispatch (``scenario_step``) maps the request batch onto the
+      ``scenario`` axis and the chains onto ``chains`` — the serve
+      batcher's vmapped scenario axis parallelised across chips.
 
     Numerical contract vs the single-device run: all keys and global
     indices are identical, so the integer RNG streams (meter draws,
@@ -78,8 +145,11 @@ class ShardedSimulation(Simulation):
     the block step for the per-shard batch shape, and its instruction
     selection (fusion order, FMA contraction) is shape-dependent, so
     e.g. a 1-chain shard and an 8-chain batch round differently in the
-    transcendental-heavy solar/PV math.  Deterministic for a fixed mesh
-    shape; there is no cross-chain reduction in the per-chain outputs.
+    transcendental-heavy solar/PV math.  Deterministic for a fixed
+    per-shard shape — which depends only on the mesh SIZE, not its
+    shape, so ``(N, M)`` results are bit-identical to ``(N*M,)``
+    (tests/test_parallel.py); there is no cross-chain reduction in the
+    per-chain outputs.
 
     The scan-restructuring plan axes shard transparently: the
     ``rng_batch='block'`` pre-generated streams are per-chain values
@@ -96,7 +166,20 @@ class ShardedSimulation(Simulation):
 
     def __init__(self, config: SimConfig, mesh: Optional[Mesh] = None,
                  plan=None):
-        mesh = mesh if mesh is not None else make_mesh()
+        mesh = mesh if mesh is not None else make_mesh(
+            scenario_devices=(config.mesh_scenario
+                              if getattr(config, "mesh_scenario", 0) >= 1
+                              else None))
+        if tuple(mesh.axis_names) not in (
+                (CHAIN_AXIS,), (CHAIN_AXIS, SCENARIO_AXIS)):
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} are not "
+                f"({CHAIN_AXIS!r},) or ({CHAIN_AXIS!r}, {SCENARIO_AXIS!r})"
+            )
+        #: axis-name argument of every data spec and collective: the
+        #: bare chain axis on a 1-D mesh, the (chains, scenario) tuple
+        #: on a 2-D one (see data_axes)
+        self._axis = data_axes(mesh)
         if plan is None:
             # per-mesh tuning (engine/autotune.py): probe at the
             # per-device chain shape — that is what each chip executes
@@ -105,7 +188,9 @@ class ShardedSimulation(Simulation):
             # applies here (the mesh partitions the chain axis itself).
             from tmhpvsim_tpu.engine import autotune
 
-            plan = autotune.resolve_plan_for_mesh(config, mesh.devices.size)
+            plan = autotune.resolve_plan_for_mesh(
+                config, mesh.devices.size,
+                mesh_shape=tuple(int(s) for s in mesh.devices.shape))
         super().__init__(config, plan=plan)
         self.allow_slabs = False
         self.mesh = mesh
@@ -170,8 +255,8 @@ class ShardedSimulation(Simulation):
         mapped = shard_map(
             self._block_step,
             mesh=self.mesh,
-            in_specs=(P(CHAIN_AXIS), P()),
-            out_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS), P(CHAIN_AXIS)),
+            in_specs=(P(self._axis), P()),
+            out_specs=(P(self._axis), P(self._axis), P(self._axis)),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=0)
@@ -181,7 +266,7 @@ class ShardedSimulation(Simulation):
         materialised meter/pv arrays into the chain-sharded accumulator.
         Zero collectives in the loop (the psum happens once at the end, in
         ``_build_sharded_ensemble``)."""
-        spec_c, spec_r = P(CHAIN_AXIS), P()
+        spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
             self._block_stats_acc,
             mesh=self.mesh,
@@ -197,7 +282,7 @@ class ShardedSimulation(Simulation):
         """Reduce-mode fused topology under shard_map (see
         SimConfig.stats_fusion): producer + stats + merge per shard in one
         jit, zero collectives, state and accumulator donated."""
-        spec_c, spec_r = P(CHAIN_AXIS), P()
+        spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
             self._step_acc_fused,
             mesh=self.mesh,
@@ -212,7 +297,7 @@ class ShardedSimulation(Simulation):
         SimConfig.block_impl; ``fn`` picks the flat or nested variant):
         the whole per-second pipeline per shard, zero collectives, state
         and accumulator donated."""
-        spec_c, spec_r = P(CHAIN_AXIS), P()
+        spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
             self._block_step_scan_acc if fn is None else fn,
             mesh=self.mesh,
@@ -235,9 +320,9 @@ class ShardedSimulation(Simulation):
 
         def step(state, inputs, acc):
             state, acc, ta = inner(state, inputs, acc)
-            return state, acc, distributed.psum_telemetry(ta, CHAIN_AXIS)
+            return state, acc, distributed.psum_telemetry(ta, self._axis)
 
-        spec_c, spec_r = P(CHAIN_AXIS), P()
+        spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
             step, mesh=self.mesh,
             in_specs=(spec_c, spec_r, spec_c),
@@ -254,11 +339,11 @@ class ShardedSimulation(Simulation):
 
         def fold(meter, pv, t):
             ta = self._wide_telemetry(meter, pv, t)
-            return distributed.psum_telemetry(ta, CHAIN_AXIS)
+            return distributed.psum_telemetry(ta, self._axis)
 
         mapped = shard_map(
             fold, mesh=self.mesh,
-            in_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS), P()),
+            in_specs=(P(self._axis), P(self._axis), P()),
             out_specs=P(),
             check_vma=False,
         )
@@ -277,9 +362,9 @@ class ShardedSimulation(Simulation):
 
         def step(state, inputs, acc):
             state, acc, fa = inner(state, inputs, acc)
-            return state, acc, distributed.psum_fleet(fa, CHAIN_AXIS)
+            return state, acc, distributed.psum_fleet(fa, self._axis)
 
-        spec_c, spec_r = P(CHAIN_AXIS), P()
+        spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
             step, mesh=self.mesh,
             in_specs=(spec_c, spec_r, spec_c),
@@ -299,10 +384,10 @@ class ShardedSimulation(Simulation):
         def step(state, inputs, acc):
             state, acc, ta, fa = inner(state, inputs, acc)
             return (state, acc,
-                    distributed.psum_telemetry(ta, CHAIN_AXIS),
-                    distributed.psum_fleet(fa, CHAIN_AXIS))
+                    distributed.psum_telemetry(ta, self._axis),
+                    distributed.psum_fleet(fa, self._axis))
 
-        spec_c, spec_r = P(CHAIN_AXIS), P()
+        spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
             step, mesh=self.mesh,
             in_specs=(spec_c, spec_r, spec_c),
@@ -322,15 +407,15 @@ class ShardedSimulation(Simulation):
             # the accumulator are shared scatter targets and psum-merge
             def fold(meter, pv, t, cohort):
                 fa = self._wide_fleet(meter, pv, t, cohort)
-                return distributed.psum_fleet(fa, CHAIN_AXIS)
+                return distributed.psum_fleet(fa, self._axis)
 
-            in_specs = (P(CHAIN_AXIS), P(CHAIN_AXIS), P(), P(CHAIN_AXIS))
+            in_specs = (P(self._axis), P(self._axis), P(), P(self._axis))
         else:
             def fold(meter, pv, t):
                 fa = self._wide_fleet(meter, pv, t)
-                return distributed.psum_fleet(fa, CHAIN_AXIS)
+                return distributed.psum_fleet(fa, self._axis)
 
-            in_specs = (P(CHAIN_AXIS), P(CHAIN_AXIS), P())
+            in_specs = (P(self._axis), P(self._axis), P())
 
         mapped = shard_map(
             fold, mesh=self.mesh,
@@ -351,13 +436,13 @@ class ShardedSimulation(Simulation):
 
         def fn(state, inputs):
             state, m_sum, p_sum = series(state, inputs)
-            return (state, jax.lax.psum(m_sum, CHAIN_AXIS),
-                    jax.lax.psum(p_sum, CHAIN_AXIS))
+            return (state, jax.lax.psum(m_sum, self._axis),
+                    jax.lax.psum(p_sum, self._axis))
 
         mapped = shard_map(
             fn, mesh=self.mesh,
-            in_specs=(P(CHAIN_AXIS), P()),
-            out_specs=(P(CHAIN_AXIS), P(), P()),
+            in_specs=(P(self._axis), P()),
+            out_specs=(P(self._axis), P(), P()),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=0)
@@ -371,13 +456,13 @@ class ShardedSimulation(Simulation):
         and ``run_ensemble`` runs sharded unchanged."""
 
         def ens(meter, pv):
-            m_sum = jax.lax.psum(meter.sum(axis=0), CHAIN_AXIS)
-            p_sum = jax.lax.psum(pv.sum(axis=0), CHAIN_AXIS)
+            m_sum = jax.lax.psum(meter.sum(axis=0), self._axis)
+            p_sum = jax.lax.psum(pv.sum(axis=0), self._axis)
             return m_sum, p_sum
 
         mapped = shard_map(
             ens, mesh=self.mesh,
-            in_specs=(P(CHAIN_AXIS), P(CHAIN_AXIS)), out_specs=(P(), P()),
+            in_specs=(P(self._axis), P(self._axis)), out_specs=(P(), P()),
             check_vma=False,
         )
         return jax.jit(mapped)
@@ -408,11 +493,11 @@ class ShardedSimulation(Simulation):
                 idx = 2
                 if tel:
                     extras.append(
-                        distributed.psum_telemetry(out[idx], CHAIN_AXIS))
+                        distributed.psum_telemetry(out[idx], self._axis))
                     idx += 1
                 if fleet:
                     extras.append(
-                        distributed.psum_fleet(out[idx], CHAIN_AXIS))
+                        distributed.psum_fleet(out[idx], self._axis))
                 if extras:
                     return (st, a), (a,) + tuple(extras)
                 return (st, a), a
@@ -420,8 +505,8 @@ class ShardedSimulation(Simulation):
             (state, acc), ys = jax.lax.scan(body, (state, acc), xs)
             return state, acc, ys
 
-        spec_c, spec_r = P(CHAIN_AXIS), P()
-        spec_k = P(None, CHAIN_AXIS)  # (k, chains, ...) stacked snapshots
+        spec_c, spec_r = P(self._axis), P()
+        spec_k = P(None, self._axis)  # (k, chains, ...) stacked snapshots
         n_extras = int(tel) + int(fleet)
         ys_spec = ((spec_k,) + (spec_r,) * n_extras) if n_extras else spec_k
         mapped = shard_map(
@@ -446,15 +531,15 @@ class ShardedSimulation(Simulation):
             def body(st, x):
                 st, a, b = fn(st, self._merge_inputs(x, const))
                 if series:
-                    a = jax.lax.psum(a, CHAIN_AXIS)
-                    b = jax.lax.psum(b, CHAIN_AXIS)
+                    a = jax.lax.psum(a, self._axis)
+                    b = jax.lax.psum(b, self._axis)
                 return st, (a, b)
 
             state, (a_k, b_k) = jax.lax.scan(body, state, xs)
             return state, a_k, b_k
 
-        spec_c = P(CHAIN_AXIS)
-        out_ab = P() if series else P(None, CHAIN_AXIS)
+        spec_c = P(self._axis)
+        out_ab = P() if series else P(None, self._axis)
         mapped = shard_map(
             mega, mesh=self.mesh,
             in_specs=(spec_c, P(), P()),
@@ -462,6 +547,97 @@ class ShardedSimulation(Simulation):
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=0)
+
+    # ------------------------------------------------------------------
+    # scenario-batched serving dispatch on the mesh (serve/)
+    # ------------------------------------------------------------------
+
+    def _has_scenario_axis(self) -> bool:
+        return SCENARIO_AXIS in self.mesh.axis_names
+
+    def init_scenario_acc(self, batch: int, sharding=None):
+        """Scenario accumulator born with the serving layout: batch over
+        ``scenario`` (2-D mesh), chains over ``chains``.  On a 1-D mesh
+        the batch axis is replicated — every chip folds every scenario
+        of its own chain shard, the pre-2-D behaviour."""
+        if sharding is None:
+            sharding = (scenario_sharding(self.mesh)
+                        if self._has_scenario_axis()
+                        else NamedSharding(self.mesh, P(None, CHAIN_AXIS)))
+        return super().init_scenario_acc(batch, sharding=sharding)
+
+    def _get_scenario_jit(self):
+        """The scenario dispatch under shard_map: chains over the
+        ``chains`` axis; the request batch over ``scenario`` when the
+        mesh has one (each chip computes its chain shard's physics once
+        per second and re-reads it through only its scenario column's
+        knobs), replicated otherwise (pure chain parallelism — each chip
+        folds the whole batch for its own chains).  The per-scenario
+        FleetAcc delta psums over the chain axes in-graph
+        (parallel/distributed.psum_fleet — the same leaf-kind dispatch
+        as the batch path), so the host merge reads a complete,
+        bit-identical sketch from any one chain shard."""
+        if self._scenario_jit is None:
+            from tmhpvsim_tpu.parallel import distributed
+
+            two_d = self._has_scenario_axis()
+            spec_c = P(CHAIN_AXIS)
+            spec_b = P(SCENARIO_AXIS) if two_d else P()
+            spec_acc = (P(SCENARIO_AXIS, CHAIN_AXIS) if two_d
+                        else P(None, CHAIN_AXIS))
+
+            def step(state, inputs, acc, scen, chain_ids, cohort):
+                state, acc, fd = self._scenario_block_core(
+                    state, inputs, acc, scen, chain_ids, cohort)
+                # each chain shard folded only its own chains; collapse
+                # the chain axis in-graph so the delta is complete on
+                # every shard (sharded only over the scenario axis)
+                fd = distributed.psum_fleet(fd, CHAIN_AXIS)
+                return state, acc, fd
+
+            mapped = shard_map(
+                step, mesh=self.mesh,
+                in_specs=(spec_c, P(), spec_acc, spec_b, spec_c,
+                          spec_c if self._n_cohorts else P()),
+                out_specs=(spec_c, spec_acc, spec_b),
+                check_vma=False,
+            )
+            inner = jax.jit(mapped, donate_argnums=(0, 2))
+            ids, cohort = self._scenario_consts()
+
+            def call(state, inputs, acc, scen, _jit=inner, _ids=ids,
+                     _cohort=cohort):
+                return _jit(state, inputs, acc, scen, _ids, _cohort)
+
+            call.lower = lambda st, inp, acc, scen, _jit=inner, _ids=ids, \
+                _cohort=cohort: _jit.lower(st, inp, acc, scen, _ids, _cohort)
+            self._scenario_jit = call
+        return self._scenario_jit
+
+    def _scenario_consts(self):
+        """Global chain ids and cohort tags as DEVICE inputs for the
+        sharded scenario dispatch: shard_map slices them with the chain
+        specs, so each shard's rows carry their true global indices —
+        the closure-constant construction of the unsharded path would
+        rebuild the FULL arrays inside every shard."""
+        ids = jnp.arange(self.config.n_chains, dtype=jnp.int32)
+        cohort = (jnp.asarray(self._fleet.cohort, jnp.int32)
+                  if self._n_cohorts
+                  else jnp.zeros((), jnp.int32))
+        sh = NamedSharding(self.mesh, P(CHAIN_AXIS))
+        ids = jax.device_put(ids, sh)
+        if self._n_cohorts:
+            cohort = jax.device_put(cohort, sh)
+        return ids, cohort
+
+    def scenario_batch_align(self) -> int:
+        """The multiple serve batch buckets must round up to so the
+        request batch divides evenly over the ``scenario`` mesh axis
+        (1 on a 1-D mesh — no constraint)."""
+        if not self._has_scenario_axis():
+            return 1
+        return int(self.mesh.devices.shape[
+            self.mesh.axis_names.index(SCENARIO_AXIS)])
 
     def step_reduced(self, state, inputs):
         """One sharded reduce-mode block: ``step_acc`` into a fresh sharded
@@ -482,12 +658,12 @@ class ShardedSimulation(Simulation):
             coll = {"sum": jax.lax.psum, "max": jax.lax.pmax,
                     "min": jax.lax.pmin}
             return {
-                name: coll[kind](local[kind](a[name]), CHAIN_AXIS)
+                name: coll[kind](local[kind](a[name]), self._axis)
                 for name, (kind, _) in REDUCE_STATS.items()
             }
 
         mapped = shard_map(
-            ens, mesh=self.mesh, in_specs=P(CHAIN_AXIS), out_specs=P(),
+            ens, mesh=self.mesh, in_specs=P(self._axis), out_specs=P(),
             check_vma=False,
         )
         return jax.jit(mapped)

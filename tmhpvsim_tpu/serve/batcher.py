@@ -59,12 +59,21 @@ class MicroBatcher:
     def __init__(self, dispatch: Callable[[List[Request]], Sequence],
                  *, window_s: float = 0.010, max_batch: int = 16,
                  queue_limit: int = 1024, registry=None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 batch_align: int = 1):
         if max_batch < 1:
             raise ValueError(f"max_batch {max_batch} must be >= 1")
+        if batch_align < 1:
+            raise ValueError(
+                f"batch_align {batch_align} must be >= 1")
         self._dispatch = dispatch
         self._window_s = float(window_s)
         self._max_batch = int(max_batch)
+        #: soft alignment: at window close, top the batch up to the next
+        #: multiple of this from requests ALREADY queued (non-blocking).
+        #: On a 2-D (chains, scenario) mesh an aligned batch fills the
+        #: scenario shards evenly instead of padding one of them.
+        self._batch_align = int(batch_align)
         #: dispatch circuit breaker: consecutive dispatch failures open
         #: it and submit sheds with typed ``unavailable`` until a probe
         #: batch succeeds (None = never shed)
@@ -174,6 +183,21 @@ class MicroBatcher:
                     nxt = await asyncio.wait_for(self._queue.get(),
                                                  remaining)
                 except asyncio.TimeoutError:
+                    break
+                if nxt is self._STOP:
+                    stop_after = True
+                    break
+                batch.append(nxt)
+            # soft alignment: never wait past the window for it, but if
+            # requests are already sitting in the queue, take just
+            # enough to reach the next multiple of ``batch_align`` (the
+            # padding bucket is the same either way, so this is free)
+            while (not stop_after and self._batch_align > 1
+                   and len(batch) < self._max_batch
+                   and len(batch) % self._batch_align != 0):
+                try:
+                    nxt = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
                     break
                 if nxt is self._STOP:
                     stop_after = True

@@ -131,11 +131,23 @@ class ScenarioEngine:
                  batch_sizes: Sequence[int]):
         from tmhpvsim_tpu.engine.simulation import Simulation
 
-        self.buckets = tuple(sorted({int(b) for b in batch_sizes}))
+        # On a 2-D (chains, scenario) mesh the what-if batch axis is
+        # sharded over the scenario mesh dimension, so every bucket must
+        # divide evenly: round each up to a multiple of M.  Padding rows
+        # are bit-inert (see Simulation._block_step_scan_scenario), so a
+        # rounded-up bucket answers the same requests identically.
+        align = max(1, int(getattr(sim_config, "mesh_scenario", 0) or 1))
+        self.batch_align = align
+        self.buckets = tuple(sorted(
+            {-(-int(b) // align) * align for b in batch_sizes}))
         cfg = dataclasses.replace(
             sim_config, output="reduce",
             serve_batch_sizes=self.buckets)
-        self.sim = Simulation(cfg)
+        if getattr(sim_config, "mesh_scenario", 0) >= 1:
+            from tmhpvsim_tpu.parallel import ShardedSimulation
+            self.sim = ShardedSimulation(cfg)
+        else:
+            self.sim = Simulation(cfg)
         self.dtype = self.sim.dtype
         self.max_horizon_s = cfg.duration_s
         self.params = self.sim.scenario_fleet_params()
@@ -305,6 +317,7 @@ class ScenarioServer:
                 window_s=self.cfg.window_s,
                 max_batch=max(self.engine.buckets),
                 queue_limit=self.cfg.queue_limit,
+                batch_align=self.engine.batch_align,
                 registry=self.registry,
                 breaker=CircuitBreaker(
                     "serve.dispatch",

@@ -38,8 +38,14 @@ exact/f32 row.  The v10 ``cost`` section adds a ``cost`` column — the
 row's north-star fraction (and, parenthesised, its VPU roofline
 fraction when the chip's peaks are known) — and the regression-gate
 verdict reports the newest round's roofline fraction alongside the
-steady-wall comparison.  ``--json`` emits the rows + gate verdict as
-one JSON document for machine consumers.
+steady-wall comparison.  The v13 ``mesh`` section (and ``bench.py
+--hosts`` artifacts) adds ``mesh``/``hosts`` columns — the device-mesh
+shape and process count.  A round's north-star fraction always comes
+from its OWN top-level headline; a cpu-fallback doc's embedded
+``last_tpu_headline`` is a prior round's copy, flagged in the note
+column and never promoted into the row (the BENCH_r05 stale-0.183
+trap).  ``--json`` emits the rows + gate verdict as one JSON document
+for machine consumers.
 
 No third-party imports: runs anywhere the repo checks out.
 """
@@ -176,6 +182,47 @@ def _cost_fields(doc) -> tuple:
             float(vpu) if isinstance(vpu, (int, float)) else None)
 
 
+def _mesh_fields(doc) -> tuple:
+    """(mesh, hosts) of one document: the device-mesh shape as an
+    ``NxM`` string and the process (host) count, from a v13 ``mesh``
+    section — the bare RunReport's, the embedded run_report's, or a
+    ``bench.py --hosts`` artifact's top-level mesh doc.  Pre-v13
+    documents read as (None, None)."""
+    sec = None
+    if doc.get("kind") == REPORT_KIND:
+        sec = doc.get("mesh")
+    elif isinstance(doc.get("mesh"), dict):
+        sec = doc["mesh"]
+    else:
+        rep = doc.get("run_report")
+        if isinstance(rep, dict) and isinstance(rep.get("mesh"), dict):
+            sec = rep["mesh"]
+    hosts = doc.get("hosts") if isinstance(doc.get("hosts"), int) else None
+    if not isinstance(sec, dict):
+        return None, hosts
+    shape = sec.get("shape")
+    mesh = ("x".join(str(int(s)) for s in shape)
+            if isinstance(shape, list) and shape else None)
+    if hosts is None and isinstance(sec.get("process_count"), int):
+        hosts = sec["process_count"]
+    return mesh, hosts
+
+
+def _stale_embedded_note(doc: dict) -> str | None:
+    """A cpu-fallback headline carries the newest REAL-TPU headline as
+    ``last_tpu_headline`` evidence (bench.py _last_tpu_evidence).  That
+    embedded doc is a COPY of a prior round — its north_star_frac must
+    never be read as this round's number (the BENCH_r05 stale-0.183
+    trap).  Returns a flag note when such a copy is embedded."""
+    stale = doc.get("last_tpu_headline")
+    if not isinstance(stale, dict):
+        return None
+    nsf = stale.get("north_star_frac")
+    tag = (f" (north_star_frac={nsf})"
+           if isinstance(nsf, (int, float)) else "")
+    return f"embedded last_tpu_headline{tag} is a prior round's copy"
+
+
 def _levels(cfg) -> tuple:
     """(telemetry, analytics) levels from a config echo; pre-PR-3/PR-6
     documents predate the fields and read as 'off'."""
@@ -194,7 +241,8 @@ def normalize(path: str) -> dict:
            "rng_batch": None, "geom_stride": None,
            "precision_speedup": None, "north_star_frac": None,
            "roofline_frac_vpu": None, "fleet_sites": None,
-           "fleet_ratio": None, "failed": True}
+           "fleet_ratio": None, "mesh": None, "hosts": None,
+           "failed": True}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -231,6 +279,7 @@ def normalize(path: str) -> dict:
         cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
         fs, fr = _fleet_fields(doc)
+        mesh, hosts = _mesh_fields(doc)
         row.update(
             failed=False,
             platform=(doc.get("device") or {}).get("platform"),
@@ -244,18 +293,30 @@ def normalize(path: str) -> dict:
             precision_speedup=prec_speed,
             north_star_frac=nsf, roofline_frac_vpu=vpu,
             fleet_sites=fs, fleet_ratio=fr,
+            mesh=mesh, hosts=hosts,
         )
         return row
 
-    # headline docs, plus serve-only artifacts (bench.py --serve writes
-    # no throughput value — the coalescing ratio IS the headline)
-    if "value" in doc or "variants" in doc or "coalescing" in doc:
+    # headline docs, serve-only artifacts (bench.py --serve writes no
+    # throughput value — the coalescing ratio IS the headline), and
+    # --hosts multi-host mechanics artifacts
+    if "value" in doc or "variants" in doc or "coalescing" in doc \
+            or "hosts" in doc:
         rep = doc.get("run_report")
         tel, ana = _levels(rep.get("config")
                            if isinstance(rep, dict) else None)
         cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
         fs, fr = _fleet_fields(doc)
+        mesh, hosts = _mesh_fields(doc)
+        # the round's OWN top-level headline is authoritative for the
+        # north-star fraction; the cost-section copy is a fallback, and
+        # anything inside an embedded last_tpu_headline is a prior
+        # round's number and must never be promoted (BENCH_r05 carried
+        # a stale 0.183 copy beside its true 0.001)
+        top_nsf = doc.get("north_star_frac")
+        if isinstance(top_nsf, (int, float)):
+            nsf = float(top_nsf)
         row.update(
             failed=False,
             platform=doc.get("platform"),
@@ -269,7 +330,11 @@ def normalize(path: str) -> dict:
             precision_speedup=prec_speed,
             north_star_frac=nsf, roofline_frac_vpu=vpu,
             fleet_sites=fs, fleet_ratio=fr,
+            mesh=mesh, hosts=hosts,
         )
+        stale = _stale_embedded_note(doc)
+        if stale:
+            row["note"] = stale
         return row
 
     row["note"] = "unrecognised document shape"
@@ -391,7 +456,8 @@ def _fmt_cost(r) -> str:
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
-            "cdt", "kimpl", "rb", "gs", "prec", "fleet", "cost", "note")
+            "cdt", "kimpl", "rb", "gs", "prec", "fleet", "cost",
+            "mesh", "hosts", "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
@@ -409,6 +475,8 @@ def print_table(rows: list) -> None:
             "-" if prec is None else f"{prec:.2f}x",
             _fmt_fleet(r),
             _fmt_cost(r),
+            r.get("mesh") or "-",
+            "-" if r.get("hosts") is None else str(r["hosts"]),
             r.get("note", ""),
         ))
     widths = [max(len(str(line[i])) for line in table)

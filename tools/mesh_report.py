@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the ``mesh`` section of run reports.
+
+Accepts any mix of the shapes the repo's tooling writes:
+
+* a bare RunReport JSON (``kind == "tmhpvsim_tpu.run_report"``);
+* a bench doc — one JSON object with an embedded ``run_report`` key
+  (bench.py's per-phase stdout lines / BENCH_*.json), or a ``bench.py
+  --hosts`` artifact carrying the mesh doc at top level;
+* a JSONL stream of either (bench.py batteries append one doc per
+  phase: SWEEP_r05.jsonl and friends).
+
+Every mesh section found (schema v13, parallel/distributed.py
+``mesh_doc``) is checked with ``obs.report.validate_mesh_section`` —
+shape/axis-name consistency, device-count product, process bounds,
+chain-range divisibility — and printed as a one-glance topology line:
+
+    HEADLINE_r06.json: mesh 4x2 (chains, scenario) over 8 devices,
+      host 0/2, chains 0..512 of 1024 (64/device)
+
+Exit code 0 when every *present* mesh section validates — reports
+without one (pre-v13 documents, unsharded runs) are fine and just
+noted, which is how ``run_tpu_round5b.sh`` consumes this non-fatally
+after each bench doc.  Nonzero means a malformed section: the mesh
+plumbing wrote something ``mesh_doc`` never emits.
+
+The only repo import is ``obs.report`` (pure stdlib): runs anywhere
+the repo checks out, no jax required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repo-root import without installation (the tools/ scripts' pattern)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmhpvsim_tpu.obs.report import validate_mesh_section  # noqa: E402
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+
+def print_mesh(sec: dict, label: str) -> None:
+    shape = "x".join(str(s) for s in sec.get("shape", []))
+    axes = ", ".join(sec.get("axis_names", []))
+    line = (f"{label}: mesh {shape} ({axes}) over "
+            f"{sec.get('n_devices')} device(s)")
+    pc = sec.get("process_count")
+    if isinstance(pc, int) and pc > 1:
+        line += f", host {sec.get('process_index')}/{pc}"
+    if sec.get("n_chains") is not None:
+        line += f", chains"
+        if sec.get("chain_start") is not None:
+            line += f" {sec['chain_start']}..{sec['chain_stop']} of"
+        line += f" {sec['n_chains']}"
+        if sec.get("chains_per_device") is not None:
+            line += f" ({sec['chains_per_device']}/device)"
+    print(line)
+
+
+def _iter_docs(path: str):
+    """Parsed JSON documents in ``path``: one whole-file document, or
+    one per line (bench batteries write JSONL)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            yield json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+
+
+def _extract_sections(doc):
+    """(label_suffix, mesh_section) pairs embedded in one parsed doc."""
+    if not isinstance(doc, dict):
+        return
+    if doc.get("kind") == REPORT_KIND:
+        if doc.get("mesh") is not None:
+            yield "", doc["mesh"]
+        return
+    if "parsed" in doc and "cmd" in doc:   # driver round wrapper
+        doc = doc.get("parsed") or {}
+    label = doc.get("phase") or doc.get("variant") or doc.get("config")
+    suffix = f"[{label}]" if label else ""
+    if isinstance(doc.get("mesh"), dict):   # --hosts artifact top level
+        yield suffix, doc["mesh"]
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and rep.get("mesh") is not None:
+        yield f"{suffix}[run_report]" if suffix else "[run_report]", \
+            rep["mesh"]
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate (and print) every mesh section in one file; True when
+    all present sections pass.  A file with none passes trivially."""
+    name = os.path.basename(path)
+    try:
+        docs = list(_iter_docs(path))
+    except OSError as e:
+        print(f"{name}: UNREADABLE ({e})", file=sys.stderr)
+        return False
+    found = 0
+    ok = True
+    for doc in docs:
+        for suffix, sec in _extract_sections(doc):
+            found += 1
+            errors = validate_mesh_section(sec)
+            if errors:
+                ok = False
+                print(f"{name}{suffix}: INVALID mesh section "
+                      f"({len(errors)} error(s))", file=sys.stderr)
+                for e in errors[:10]:
+                    print(f"  {e}", file=sys.stderr)
+                if len(errors) > 10:
+                    print(f"  ... and {len(errors) - 10} more",
+                          file=sys.stderr)
+            elif not quiet:
+                print_mesh(sec, f"{name}{suffix}")
+    if not found and not quiet:
+        print(f"{name}: no mesh section (unsharded run or pre-v13 report)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print RunReport mesh sections "
+                    "(bare reports, bench docs, or JSONL of either)")
+    ap.add_argument("files", nargs="+", help="report/bench files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the topology lines (errors still "
+                         "print)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
