@@ -327,7 +327,7 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
                   headline=None, profile=None, slabs=None,
                   device=None, executor=None,
                   precision=None, checkpoint=None,
-                  cost=None) -> dict | None:
+                  cost=None, pod=None) -> dict | None:
     """A validated obs RunReport document, embedded ADDITIVELY in a bench
     artifact as ``doc["run_report"]`` (the legacy ad-hoc fields stay —
     battery scripts key richness decisions off them).  Never raises: a
@@ -354,6 +354,7 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
         rep.precision = precision
         rep.checkpoint = checkpoint
         rep.cost = cost  # v10 cost-attribution section (obs/cost.py)
+        rep.pod = pod  # v14 pod-observability section (obs/pod.py)
         # every bench artifact records how the backend probe went — the
         # v8 ``probe`` section; None when this path never probed
         rep.probe = _probe_doc()
@@ -2032,7 +2033,7 @@ def serve_bench(clients: int, requests_per_client: int) -> None:
 #: TPU pod slice uses — and the same harness pattern as
 #: tests/test_distributed.py.  Process 0 prints the JSON payload.
 _HOSTS_WORKER_SRC = r"""
-import json, os, time
+import json, os, tempfile, time
 import jax
 
 n_local = int(os.environ["TMHPVSIM_BENCH_LOCAL_DEVICES"])
@@ -2051,7 +2052,14 @@ except (AttributeError, ValueError):
 from tmhpvsim_tpu.parallel.distributed import initialize_from_env, mesh_doc
 assert initialize_from_env(), "coordinator env vars must initialise"
 
+# throwaway per-worker compile cache: enables the AOT warm-up, whose
+# cost_analysis() harvest is the measured cost basis (obs/cost.py)
+from tmhpvsim_tpu.engine import compilecache
+compilecache.configure(tempfile.mkdtemp(prefix="tmhpvsim-hosts-cache-"))
+
 from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.obs import pod as obs_pod
+from tmhpvsim_tpu.obs.profiler import device_trace
 from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
 
 n_chains = int(os.environ.get("TMHPVSIM_BENCH_HOSTS_CHAINS", "256"))
@@ -2059,20 +2067,43 @@ m = int(os.environ.get("TMHPVSIM_BENCH_MESH_SCENARIO", "0"))
 mesh = make_mesh(scenario_devices=m) if m >= 1 else make_mesh()
 cfg = SimConfig(start="2019-09-05 00:00:00", duration_s=3 * 360,
                 n_chains=n_chains, seed=0, block_s=360, dtype="float32",
-                prng_impl="threefry2x32", output="reduce")
+                prng_impl="threefry2x32", output="reduce",
+                pod_obs="on")
 sim = ShardedSimulation(cfg, mesh=mesh)
+trace_dir = tempfile.mkdtemp(prefix="tmhpvsim-hosts-trace-")
 t0 = time.perf_counter()
-red = sim.run_reduced()
+with device_trace(trace_dir, expect_platform="cpu", python_tracer=False):
+    red = sim.run_reduced()
 wall = time.perf_counter() - t0
 ens = sim.ensemble_stats()
 rate = n_chains * cfg.duration_s / wall
+# collective-vs-compute split from this host's jax.profiler trace
+comm = obs_pod.comm_split(trace_dir)
+pod = None
+if sim._pod is not None:
+    if comm:
+        sim._pod.attach_comm(comm)
+    pod = sim._pod.doc()
 if jax.process_index() == 0:
+    from tmhpvsim_tpu.obs import cost as obs_cost
+    plan = sim.plan
+    cost = obs_cost.cost_doc(
+        site_s_per_s=rate,
+        block_impl=plan.block_impl,
+        compute_dtype=getattr(plan, "compute_dtype", None),
+        kernel_impl=getattr(plan, "kernel_impl", None),
+        rng_batch=getattr(plan, "rng_batch", None),
+        geom_stride=getattr(plan, "geom_stride", None),
+        device_kind=jax.devices()[0].device_kind,
+    )
     print(json.dumps({
         "mesh": mesh_doc(mesh, n_chains=n_chains),
         "rate": round(rate, 1),
         "rate_includes_compile": True,
         "wall_s": round(wall, 2),
         "n_seconds": int(ens["n_seconds"]),
+        "pod": pod,
+        "cost": cost,
     }), flush=True)
 print(f"HOSTOK {jax.process_index()}", flush=True)
 """
@@ -2136,6 +2167,12 @@ def hosts_bench(k: int, mesh_scenario: int = 0) -> None:
         tail = (outs[i][2] or "").strip().splitlines()[-5:]
         print(f"# hosts worker {i} failed rc={outs[i][0]}:",
               *tail, sep="\n# ", file=sys.stderr)
+    pod = cost = None
+    if inner:
+        # the pod/cost sections belong in the schema'd run_report, not
+        # the ad-hoc top level
+        pod = inner.pop("pod", None)
+        cost = inner.pop("cost", None)
     doc = {
         "artifact": "multi-host mechanics (gloo, virtual CPU devices)",
         "hosts": k,
@@ -2153,6 +2190,7 @@ def hosts_bench(k: int, mesh_scenario: int = 0) -> None:
                 "mesh_scenario": mesh_scenario},
         headline={"site_seconds_per_s": doc.get("rate")},
         device={"platform": "cpu"},
+        cost=cost, pod=pod,
     )
     _persist_partial({"phase": "hosts", **doc})
     print(json.dumps(doc), flush=True)

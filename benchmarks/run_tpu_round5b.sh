@@ -290,5 +290,17 @@ for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
   python tools/mesh_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- mesh_report: MALFORMED MESH SECTION $bench_doc rc=$?" >> "$LOG"
 done
+# pod sanity (non-fatal), same contract: any doc carrying a v14 'pod'
+# section (obs/pod.py PodMonitor.doc — per-host heartbeat rows, skew
+# stats, straggler totals, comm_frac) must carry a WELL-FORMED one;
+# single-process or pre-v14 docs just note the absence.  Catches a
+# multi-host battery whose pod plane silently produced garbage.
+for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
+                 benchmarks/BENCH_*.json benchmarks/HOSTS_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- pod_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/pod_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- pod_report: MALFORMED POD SECTION $bench_doc rc=$?" >> "$LOG"
+done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

@@ -85,6 +85,7 @@ _SLOW_LANE = {
     # real two-process jax.distributed runs (the smoke test stays fast)
     "test_two_process_sharded_simulation",
     "test_two_process_checkpoint_kill_resume",
+    "test_two_process_straggler_detection",
     # full-depth statistical / golden parity (KS, moments, soak)
     "test_distributional_parity_with_jax_path",
     "test_transition_kernel_parity_with_numpy_golden",
@@ -201,7 +202,8 @@ def _compilecache_isolation():
     # NOT the "listener" key: the jax.monitoring listener is append-only
     # (no unregister API); resetting it to None would make a later
     # configure() register a duplicate and double-count warm/cold events.
-    saved_state = {k: compilecache._state[k] for k in ("dir", "configured")}
+    saved_state = {k: compilecache._state[k]
+                   for k in ("dir", "configured", "cost")}
     saved_cfg = {
         k: getattr(jax.config, k)
         for k in ("jax_compilation_cache_dir",

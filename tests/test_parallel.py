@@ -287,12 +287,20 @@ class TestMesh2D:
         compiled HLO as the historical 1-D mesh, byte for byte.  The
         lowered StableHLO is compared after stripping ``jax.result_info``
         (pure result-naming metadata — the only textual difference);
-        the compiled module must match with no normalisation at all."""
+        the compiled module must match with only debug-location metadata
+        normalised: the persistent compilation cache keys on the module
+        with source locations stripped, so a warm ``compile()`` can
+        return an executable whose ``source_file``/``source_line``
+        stamps came from a byte-identical trace through a DIFFERENT
+        call site (the plain and analytics reduce bodies in
+        engine/simulation.py lower to identical ops), depending on
+        which test populated the entry first."""
         c = _mesh_cfg(duration_s=60)
         sim1 = ShardedSimulation(c, mesh=make_mesh())
         sim2 = ShardedSimulation(c, mesh=make_mesh(scenario_devices=1))
         assert sim2.mesh.devices.shape == (8, 1)
         strip = re.compile(r'jax\.result_info = "[^"]*"')
+        strip_loc = re.compile(r' source_file="[^"]*" source_line=\d+')
         for attr in ("_scan_acc_jit", "_sharded_ensemble"):
             low1 = getattr(sim1, attr)
             low2 = getattr(sim2, attr)
@@ -307,7 +315,8 @@ class TestMesh2D:
             l1, l2 = low1.lower(*a1), low2.lower(*a2)
             assert (strip.sub("", l1.as_text())
                     == strip.sub("", l2.as_text())), attr
-            assert l1.compile().as_text() == l2.compile().as_text(), attr
+            assert (strip_loc.sub("", l1.compile().as_text())
+                    == strip_loc.sub("", l2.compile().as_text())), attr
 
     def test_nm_mesh_matches_1d_and_single(self):
         """(4, 2) vs (8,) vs one device on the default path: the mesh

@@ -1,0 +1,591 @@
+"""Pod-scale observability (obs/pod.py + the v14 ``pod`` report section):
+
+* ``validate_pod_section`` shape rules and the report v14 round-trip
+  (v1–v13 documents still validate; malformed pod sections are refused);
+* :class:`PodMonitor` — the single-process local path, straggler
+  verdicts against a synthetic 2-host gather (WARN + counter), the
+  gather-barrier wall correction, and non-fatal gather failures;
+* ``comm_split`` — collective-vs-compute attribution from a synthetic
+  gzip'd Chrome-trace export (XLA threads, infra/denylist frames);
+* the ``/podmetrics`` exposition and per-process ``/metrics`` labels;
+* the measured cost audit: ``compilecache`` auto-harvests the hot block
+  jit's ``cost_analysis`` at AOT warm-up, ``cost_doc`` turns it into
+  ``basis: "measured"`` + the per-factor ``model_error`` sub-doc with
+  no manual plumbing;
+* the ``block.stall`` chaos chokepoint (runtime/faults.py) — the
+  deterministic straggler injector;
+* HLO byte-identity: ``pod_obs`` on vs off lowers the same graph;
+* the 2-process gloo run (slow lane): one host stalls via the
+  chokepoint, both hosts' reports agree the straggler fired.
+"""
+
+import gzip
+import json
+import logging
+import os
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.engine import Simulation, compilecache
+from tmhpvsim_tpu.obs import cost as obs_cost
+from tmhpvsim_tpu.obs import pod as obs_pod
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.obs.pod import (
+    PodMonitor,
+    comm_split,
+    is_collective,
+    podmetrics_text,
+    process_labels,
+    validate_pod_section,
+)
+from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, validate_report
+from tmhpvsim_tpu.runtime import faults
+from tmhpvsim_tpu.runtime.faults import FaultPlan
+
+from test_distributed import _run_workers  # noqa: E402  (2-proc harness)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def scfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=120,
+        n_chains=4,
+        seed=7,
+        block_s=60,
+        dtype="float32",
+        output="reduce",
+        block_impl="scan",
+        scan_unroll=1,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clean_pod_state():
+    """The latest-snapshot slot feeding /podmetrics is process-global;
+    a leaked snapshot (or chaos plan) must not bleed across tests."""
+    yield
+    obs_pod._set_latest(None)
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# validate_pod_section
+# ---------------------------------------------------------------------------
+
+
+def _valid_sec():
+    return {
+        "process_count": 2,
+        "process_index": 0,
+        "straggler_factor": 2.0,
+        "blocks_observed": 3,
+        "straggler_total": 1,
+        "skew": {"max_over_median": 2.4, "last_over_median": 1.0,
+                 "mean_over_median": 1.2},
+        "hosts": [
+            {"process": 0, "chain_start": 0, "chain_stop": 8, "block": 2,
+             "block_wall_s": 0.11, "blocks_per_s": 9.1,
+             "over_median": 1.0},
+            {"process": 1, "chain_start": 8, "chain_stop": 16, "block": 2,
+             "block_wall_s": 0.26, "blocks_per_s": 3.8,
+             "over_median": 2.4},
+        ],
+        "comm_frac": 0.25,
+    }
+
+
+class TestValidatePodSection:
+    def test_valid_section_passes(self):
+        assert validate_pod_section(_valid_sec()) == []
+
+    def test_not_a_dict(self):
+        errs = validate_pod_section("nope")
+        assert len(errs) == 1 and "expected dict" in errs[0]
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda s: s.update(straggler_total=-1), "straggler_total"),
+        (lambda s: s.update(process_index=2), "process_index"),
+        (lambda s: s.update(straggler_factor=0), "straggler_factor"),
+        (lambda s: s["skew"].update(max_over_median=0), "skew.max"),
+        (lambda s: s.update(hosts=[]), "hosts"),
+        (lambda s: s["hosts"].pop(), "!= process_count"),
+        (lambda s: s["hosts"][0].update(chain_start=9), "chain range"),
+        (lambda s: s.update(comm_frac=1.5), "comm_frac"),
+        (lambda s: s.update(comm="x"), "comm:"),
+    ])
+    def test_mutations_are_caught(self, mutate, needle):
+        sec = _valid_sec()
+        mutate(sec)
+        errs = validate_pod_section(sec)
+        assert errs and any(needle in e for e in errs), errs
+
+    def test_null_comm_frac_is_fine(self):
+        sec = _valid_sec()
+        sec["comm_frac"] = None
+        assert validate_pod_section(sec) == []
+
+
+# ---------------------------------------------------------------------------
+# PodMonitor
+# ---------------------------------------------------------------------------
+
+
+class TestPodMonitor:
+    def test_doc_none_before_any_block(self):
+        mon = PodMonitor(n_chains=4, block_s=60)
+        assert mon.doc() is None
+
+    def test_single_process_observe_block(self):
+        reg = MetricsRegistry()
+        mon = PodMonitor(n_chains=4, block_s=60, registry=reg)
+        snap = mon.observe_block(0, 0.5, 2.0)
+        assert snap is not None
+        assert len(snap["hosts"]) == 1
+        assert snap["stragglers"] == []
+        h = snap["hosts"][0]
+        assert (h["process"], h["chain_start"], h["chain_stop"]) == (0, 0, 4)
+        assert h["block_wall_s"] == pytest.approx(0.5)
+        doc = mon.doc()
+        assert validate_pod_section(doc) == [], validate_pod_section(doc)
+        assert doc["process_count"] == 1
+        assert doc["blocks_observed"] == 1
+        assert doc["straggler_total"] == 0
+        assert doc["comm_frac"] is None
+        g = reg.snapshot()["gauges"]
+        assert g["pod.hosts"] == 1.0
+        assert g["pod.block_wall_median_s"] == pytest.approx(0.5)
+
+    def test_straggler_fires_warn_and_counter(self, monkeypatch, caplog):
+        """A synthetic 2-host gather where host 1's wall is 5x host 0's:
+        the straggler must be flagged (factor 2), logged at WARNING, and
+        counted in pod.straggler_total."""
+        from tmhpvsim_tpu.parallel import distributed
+
+        rows = np.asarray([
+            [0.0, 0.0, 8.0, 1.0, 0.1, 10.0],
+            [1.0, 8.0, 16.0, 1.0, 0.5, 2.0],
+        ])
+        monkeypatch.setattr(distributed, "gather_rows", lambda row: rows)
+        reg = MetricsRegistry()
+        mon = PodMonitor(n_chains=16, block_s=60, registry=reg)
+        mon.process_count, mon.process_index = 2, 0  # as a 2-proc run
+        with caplog.at_level(logging.WARNING, logger="tmhpvsim_tpu.obs.pod"):
+            snap = mon.observe_block(1, 0.1, 10.0)
+        assert snap["stragglers"] == [1]
+        assert mon.straggler_total == 1
+        assert any("pod straggler" in r.message for r in caplog.records)
+        snapshot = reg.snapshot()["counters"]
+        assert snapshot["pod.straggler_total"] == 1.0
+        doc = mon.doc()
+        assert validate_pod_section(doc) == [], validate_pod_section(doc)
+        assert doc["skew"]["max_over_median"] == pytest.approx(5.0)
+        # attribution folds in after the fact (bench captures the trace)
+        mon.attach_comm({"comm_frac": 0.25, "collective_s": 1.0,
+                         "compute_s": 3.0})
+        doc = mon.doc()
+        assert doc["comm_frac"] == 0.25
+        assert doc["comm"]["compute_s"] == 3.0
+        assert validate_pod_section(doc) == []
+        assert reg.snapshot()["gauges"]["device.pod.comm_frac"] == 0.25
+
+    def test_median_low_lets_default_factor_fire_with_two_hosts(self):
+        """The design point: with an interpolating median and 2 hosts the
+        over-median ratio is bounded by 2b/(a+b) < 2 — the default
+        factor 2.0 could mathematically never fire.  median_low compares
+        the straggler against the FAST host instead."""
+        import statistics
+
+        a, b = 0.1, 0.5
+        assert b / statistics.median([a, b]) < 2.0      # the trap
+        assert b / statistics.median_low([a, b]) == 5.0  # the fix
+
+    def test_gather_failure_is_nonfatal(self, monkeypatch):
+        from tmhpvsim_tpu.parallel import distributed
+
+        def boom(row):
+            raise RuntimeError("DCN fell over")
+
+        monkeypatch.setattr(distributed, "gather_rows", boom)
+        mon = PodMonitor(n_chains=4, block_s=60)
+        assert mon.observe_block(0, 0.5, 2.0) is None
+        assert mon.blocks_observed == 0
+        assert mon.doc() is None
+
+    def test_gather_barrier_wait_subtracted_from_next_wall(self):
+        """The heartbeat gather is a barrier: a fast host's wait there
+        lands in its next dispatch-to-dispatch wall.  The monitor times
+        the gather and subtracts it, keeping reported walls genuine."""
+        mon = PodMonitor(n_chains=4, block_s=60)
+        mon._prev_gather_wait_s = 0.4
+        snap = mon.observe_block(0, 0.5, 2.0)
+        assert snap["hosts"][0]["block_wall_s"] == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# comm_split: collective-vs-compute attribution
+# ---------------------------------------------------------------------------
+
+
+def _write_trace(log_dir, events):
+    d = log_dir / "plugins" / "profile" / "2026_08_07"
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / "host0.trace.json.gz"
+    with gzip.open(path, "wt", encoding="utf-8") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _xla_thread_meta(pid=1, tid=2):
+    return [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "python3"}},
+        {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient-0"}},
+    ]
+
+
+class TestCommSplit:
+    def test_is_collective_prefixes(self):
+        assert is_collective("all-reduce.1")
+        assert is_collective("all-gather-start.2")
+        assert is_collective("reduce-scatter")
+        assert not is_collective("fusion.3")
+        assert not is_collective("multiply")
+
+    def test_split_counts_xla_ops_only(self, tmp_path):
+        events = _xla_thread_meta() + [
+            # XLA ops on the executor thread: 300 us collective, 700 compute
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 300,
+             "name": "all-reduce.1"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 300, "dur": 700,
+             "name": "multiply.2"},
+            # infra frames on the same thread: never ops
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 999,
+             "name": "ThunkExecutor::Execute"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 50,
+             "name": "D2D Dispatch"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 10,
+             "name": "$python_frame"},
+            # a host (non-XLA) thread: ignored wholesale
+            {"ph": "X", "pid": 1, "tid": 9, "ts": 0, "dur": 5000,
+             "name": "all-reduce.1"},
+        ]
+        _write_trace(tmp_path, events)
+        out = comm_split(str(tmp_path))
+        assert out is not None
+        assert out["n_events"] == 2
+        assert out["n_collective_events"] == 1
+        assert out["comm_frac"] == pytest.approx(0.3)
+        assert out["collective_s"] == pytest.approx(300e-6)
+        assert out["compute_s"] == pytest.approx(700e-6)
+        assert out["top_collectives"] == {"all-reduce": pytest.approx(300e-6)}
+
+    def test_device_plane_process_name_also_matches(self, tmp_path):
+        """TPU/GPU exports name the device plane via process_name; the
+        thread name alone doesn't mark XLA there."""
+        events = [
+            {"ph": "M", "pid": 7, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 0, "dur": 100,
+             "name": "all-gather.3"},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 100, "dur": 100,
+             "name": "fusion.7"},
+        ]
+        _write_trace(tmp_path, events)
+        out = comm_split(str(tmp_path))
+        assert out["comm_frac"] == pytest.approx(0.5)
+
+    def test_no_trace_returns_none(self, tmp_path):
+        assert comm_split(str(tmp_path)) is None
+
+    def test_garbage_trace_returns_none(self, tmp_path):
+        d = tmp_path / "plugins" / "profile" / "x"
+        d.mkdir(parents=True)
+        (d / "bad.trace.json.gz").write_bytes(b"not gzip at all")
+        assert comm_split(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# /podmetrics exposition + per-process /metrics labels
+# ---------------------------------------------------------------------------
+
+
+class TestFederation:
+    def test_podmetrics_none_without_snapshot(self):
+        obs_pod._set_latest(None)
+        assert podmetrics_text() is None
+
+    def test_podmetrics_renders_latest_snapshot(self):
+        mon = PodMonitor(n_chains=4, block_s=60)
+        mon.observe_block(2, 0.25, 4.0)
+        text = podmetrics_text("tmhpvsim")
+        assert text is not None
+        assert "tmhpvsim_pod_hosts 1" in text
+        assert "tmhpvsim_pod_block 2" in text
+        assert 'tmhpvsim_pod_host_block_wall_seconds{process="0"} 0.25' \
+            in text
+        assert text.endswith("# EOF\n")
+
+    def test_process_labels_empty_single_process(self):
+        assert process_labels() == {}
+
+    def test_openmetrics_labels_stamp_every_sample(self):
+        reg = MetricsRegistry()
+        reg.counter("broker.published").inc(3)
+        reg.gauge("clock.lag_s").set(1.5)
+        plain = reg.openmetrics_text()
+        # None and {} are byte-identical: single-process scrapes are
+        # unchanged by the federation feature
+        assert reg.openmetrics_text(labels={}) == plain
+        labelled = reg.openmetrics_text(labels={"process": "3"})
+        assert 'tmhpvsim_broker_published_total{process="3"} 3' in labelled
+        assert 'tmhpvsim_clock_lag_s{process="3"} 1.5' in labelled
+        assert labelled.endswith("# EOF\n")
+
+
+# ---------------------------------------------------------------------------
+# RunReport v14: engine wiring, round-trip, back-compat
+# ---------------------------------------------------------------------------
+
+#: report version each optional section arrived in — a vN document must
+#: not carry sections newer than N
+_SECTION_SINCE = {
+    "telemetry": 2, "streaming": 3, "executor": 4, "fleet": 5,
+    "serving": 6, "resilience": 7, "precision": 8, "probe": 8,
+    "cost": 10, "mesh": 13, "pod": 14,
+}
+
+
+class TestReportV14:
+    def _run_doc(self):
+        sim = Simulation(scfg(pod_obs="on"))
+        sim.run_reduced()
+        return sim.run_report()
+
+    def test_engine_attaches_pod_section(self):
+        doc = self._run_doc()
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 14
+        pod = doc["pod"]
+        assert pod is not None
+        assert validate_pod_section(pod) == [], validate_pod_section(pod)
+        assert pod["process_count"] == 1
+        assert pod["blocks_observed"] == 2  # 120 s / 60 s blocks
+        assert pod["straggler_total"] == 0
+        assert len(pod["hosts"]) == 1
+        # JSON round-trip revalidates
+        validate_report(json.loads(json.dumps(doc)))
+
+    def test_pod_obs_off_omits_section(self):
+        sim = Simulation(scfg())
+        sim.run_reduced()
+        doc = sim.run_report()
+        assert doc["pod"] is None
+
+    def test_prior_versions_still_validate(self):
+        doc = self._run_doc()
+        for v in range(1, REPORT_SCHEMA_VERSION):
+            old = dict(doc)
+            old["schema_version"] = v
+            for key, since in _SECTION_SINCE.items():
+                if since > v:
+                    old.pop(key, None)
+            validate_report(old)
+
+    def test_malformed_pod_section_is_refused(self):
+        doc = self._run_doc()
+        doc["pod"] = dict(doc["pod"], straggler_total=-5)
+        with pytest.raises(ValueError, match="pod"):
+            validate_report(doc)
+
+
+# ---------------------------------------------------------------------------
+# HLO byte-identity: pod obs is host-side only
+# ---------------------------------------------------------------------------
+
+
+class TestHLOIdentity:
+    @pytest.mark.parametrize("impl", ["scan", "scan2"])
+    def test_block_jit_identical_on_vs_off(self, impl):
+        """Pod observability is heartbeat gathers at block boundaries —
+        the compiled per-block graph must not know it exists."""
+
+        def lowered(pod_obs: str) -> str:
+            sim = Simulation(scfg(block_impl=impl, pod_obs=pod_obs))
+            state = sim.init_state()
+            acc = sim.init_reduce_acc()
+            inputs, _ = sim.host_inputs(0)
+            jit = (sim._scan_acc_jit if impl == "scan"
+                   else sim._scan2_acc_jit)
+            return jit.lower(state, inputs, acc).as_text()
+
+        assert lowered("on") == lowered("off")
+
+
+# ---------------------------------------------------------------------------
+# Measured cost audit: auto-harvest -> basis "measured" -> model_error
+# ---------------------------------------------------------------------------
+
+
+class TestMeasuredCost:
+    def test_warmup_harvests_cost_and_cost_doc_uses_it(self, tmp_path):
+        """The whole audit with NO manual plumbing: configure the warm-
+        start executor, build a Simulation (AOT warm-up compiles the hot
+        block jit and harvests its cost_analysis), then cost_doc picks
+        the measurement up as basis "measured" with the per-factor
+        model_error sub-doc."""
+        cache = os.environ.get("TMHPVSIM_COMPILE_CACHE") \
+            or str(tmp_path / "xla")
+        compilecache.configure(cache)
+        compilecache._state["cost"] = None
+        Simulation(scfg())
+        mc = compilecache.measured_cost()
+        if mc is None:
+            pytest.skip("cost_analysis unavailable on this jax build")
+        assert mc["flops_per_site_s"] > 0
+        assert not mc["target"].startswith(("mega_", "resume_copy",
+                                            "scenario_acc"))
+        doc = obs_cost.cost_doc(site_s_per_s=1e6, block_impl="scan")
+        assert doc["basis"] == "measured"
+        assert doc["measured_flops_per_site_s"] == pytest.approx(
+            mc["flops_per_site_s"], rel=0.01)
+        assert doc["measured_target"] == mc["target"]
+        me = doc["model_error"]
+        assert me["flops_ratio"] == pytest.approx(
+            mc["flops_per_site_s"] / doc["flops_per_site_s"], rel=1e-3)
+        assert set(me["factors"]) == {"block_impl", "compute_dtype",
+                                      "kernel_impl", "rng_batch",
+                                      "geom_stride"}
+        assert obs_cost.validate_cost(doc) == [], obs_cost.validate_cost(doc)
+        # the raw numbers also ride the executor section
+        ex = compilecache.executor_doc()
+        assert ex["cost_analysis"]["flops"] > 0
+
+    def test_without_measurement_basis_stays_model(self, monkeypatch):
+        monkeypatch.setitem(compilecache._state, "cost", None)
+        doc = obs_cost.cost_doc(site_s_per_s=1e6, block_impl="scan")
+        assert doc["basis"] == "model"
+        assert "model_error" not in doc
+        assert obs_cost.validate_cost(doc) == []
+
+    def test_model_error_doc_ratios_and_implied_factors(self):
+        doc = obs_cost.model_cost("scan", "f32", "exact")
+        me = obs_cost.model_error_doc(
+            doc, 2.0 * doc["flops_per_site_s"],
+            0.5 * doc["bytes_per_site_s"])
+        assert me["flops_ratio"] == pytest.approx(2.0)
+        assert me["flops_err_pct"] == pytest.approx(100.0)
+        assert me["bytes_ratio"] == pytest.approx(0.5)
+        assert me["bytes_err_pct"] == pytest.approx(-50.0)
+        row = me["factors"]["kernel_impl"]
+        assert row["value"] == "exact"
+        assert row["implied_flops_factor"] == pytest.approx(2.0)
+        assert row["implied_bytes_factor"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# block.stall chaos chokepoint
+# ---------------------------------------------------------------------------
+
+
+class TestBlockStall:
+    def test_stall_fires_in_run_reduced(self):
+        """`--chaos 'block.stall=delay:...@every2'` is the deterministic
+        straggler: host-side, per block dispatch, never in-graph.  Two
+        blocks -> the every2 trigger fires exactly once."""
+        reg = MetricsRegistry()
+        with use_registry(reg), \
+                faults.active(FaultPlan.parse(
+                    "block.stall=delay:0.01@every2")):
+            Simulation(scfg()).run_reduced()
+        c = reg.snapshot()["counters"]
+        assert c["faults.injected.block.stall"] == 1.0
+        assert c["faults.injected_total"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# 2-process gloo: one host stalls, every report agrees (slow lane)
+# ---------------------------------------------------------------------------
+
+_POD_WORKER = r"""
+import json
+import logging
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # jax < 0.5 spells it as an XLA flag
+    import os as _os
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=4")
+try:  # jax < 0.5: cross-process CPU collectives need the gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass  # newer jax: gloo is the default
+
+logging.basicConfig(level=logging.WARNING)  # pod straggler WARNs -> stderr
+
+from tmhpvsim_tpu.parallel.distributed import initialize_from_env
+
+assert initialize_from_env()
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.obs.pod import validate_pod_section
+from tmhpvsim_tpu.obs.report import validate_report
+from tmhpvsim_tpu.parallel import ShardedSimulation, make_mesh
+from tmhpvsim_tpu.runtime import faults
+
+pid = jax.process_index()
+# ONLY host 1 stalls: 0.75 s before every 2nd block dispatch -- the
+# deterministic straggler the chokepoint exists for.
+if pid == 1:
+    faults.activate(faults.FaultPlan.parse("block.stall=delay:0.75@every2"))
+
+cfg = SimConfig(start="2019-09-05 10:00:00", duration_s=240, n_chains=16,
+                seed=5, block_s=60, dtype="float32", output="reduce",
+                pod_obs="on", pod_straggler_factor=2.0)
+mesh = make_mesh()  # 8 devices across 2 processes
+sim = ShardedSimulation(cfg, mesh=mesh)
+red = sim.run_reduced()
+assert len(red["pv_sum"]) == 8
+
+doc = sim.run_report()
+validate_report(json.loads(json.dumps(doc)))  # v14 round-trips
+pod = doc["pod"]
+assert pod is not None, "pod_obs=on must attach the section"
+errs = validate_pod_section(pod)
+assert not errs, errs
+assert pod["process_count"] == 2
+assert len(pod["hosts"]) == 2
+assert pod["blocks_observed"] == 4, pod["blocks_observed"]
+# the symmetric gather means EVERY host's report agrees on the verdict
+assert pod["straggler_total"] >= 1, pod
+assert pod["skew"]["max_over_median"] > 2.0, pod["skew"]
+print("PODOK %d %d" % (pid, pod["straggler_total"]), flush=True)
+"""
+
+
+def test_two_process_straggler_detection():
+    """End-to-end straggler story on a real 2-process gloo pod: host 1
+    stalls via the block.stall chokepoint, the per-block heartbeat
+    gather flags it on BOTH hosts (same straggler_total in both
+    reports), and the WARN names the straggler."""
+    outs = _run_workers(_POD_WORKER, timeout=600.0)
+    assert "PODOK 0" in outs[0][1]
+    assert "PODOK 1" in outs[1][1]
+    totals = []
+    for rc, out, err in outs:
+        line = next(ln for ln in out.splitlines() if ln.startswith("PODOK"))
+        totals.append(int(line.split()[2]))
+        assert "pod straggler" in err, err[-2000:]
+    assert totals[0] == totals[1] >= 1

@@ -152,7 +152,8 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
                         backend: str = "asyncio",
                         trace: Optional[str] = None,
                         compile_cache: Optional[str] = None,
-                        obs_port: Optional[int] = None) -> None:
+                        obs_port: Optional[int] = None,
+                        obs_bind: str = "127.0.0.1") -> None:
     """App orchestrator (metersim.py:64-77): producer + publisher tasks.
     ``backend='jax'`` swaps the per-second numpy producer for the
     device-batched one; the transport/publisher side is identical.
@@ -172,7 +173,7 @@ async def metersim_main(amqp_url, exchange, realtime, seed=None,
     tracer = Tracer() if trace else None
     if obs_port is not None:
         obs_trace.enable_propagation(True)
-    async with maybe_obs_server(obs_port, tracer=tracer):
+    async with maybe_obs_server(obs_port, host=obs_bind, tracer=tracer):
         await _metersim_run(amqp_url, exchange, realtime, seed,
                             duration_s, start, backend, trace,
                             compile_cache, tracer)

@@ -210,7 +210,8 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
                      trace: Optional[str] = None,
                      metrics_path: Optional[str] = None,
                      run_report_path: Optional[str] = None,
-                     obs_port: Optional[int] = None) -> None:
+                     obs_port: Optional[int] = None,
+                     obs_bind: str = "127.0.0.1") -> None:
     """App orchestrator (pvsim.py:86-101).
 
     Streaming observability (obs/): ``trace`` records the consume →
@@ -231,7 +232,7 @@ async def pvsim_main(file, amqp_url, exchange, realtime, seed=None,
     if obs_port is not None:
         obs_trace.enable_propagation(True)
     tracer0 = Tracer() if trace else None
-    async with maybe_obs_server(obs_port, tracer=tracer0):
+    async with maybe_obs_server(obs_port, host=obs_bind, tracer=tracer0):
         await _pvsim_stream_run(file, amqp_url, exchange, realtime, seed,
                                 duration_s, start, trace, metrics_path,
                                 run_report_path, tracer0)
@@ -439,7 +440,10 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               checkpoint_keep: int = 3,
               checkpoint_async: str = "off",
               preempt_grace_s: float = 0.0,
-              obs_port: Optional[int] = None) -> None:
+              obs_port: Optional[int] = None,
+              obs_bind: str = "127.0.0.1",
+              pod_obs: str = "off",
+              pod_straggler_factor: float = 2.0) -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -535,7 +539,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
 
         obs_trace.enable_propagation(True)
         obs_server = ObsServer(
-            obs_port, registry=registry, tracer=tracer,
+            obs_port, obs_bind, registry=registry, tracer=tracer,
             ready=lambda: (ready_state["warm"], dict(ready_state)))
         obs_server.start_threaded()  # bind errors surface here, pre-run
     # the Simulation binds the process-default registry at construction,
@@ -564,6 +568,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 checkpoint_keep=checkpoint_keep,
                 checkpoint_async=checkpoint_async,
                 preempt_grace_s=preempt_grace_s,
+                pod_obs=pod_obs,
+                pod_straggler_factor=pod_straggler_factor,
                 ready_state=ready_state,
             )
         except (Exception, KeyboardInterrupt):
@@ -622,6 +628,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
         from tmhpvsim_tpu.parallel.distributed import mesh_doc
 
         rep.mesh = mesh_doc(sim.mesh, n_chains=sim.config.n_chains)
+    if getattr(sim, "_pod", None) is not None:
+        rep.pod = sim._pod.doc()
     if jax.process_count() > 1:
         from tmhpvsim_tpu.parallel.distributed import gather_metrics
 
@@ -664,6 +672,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    checkpoint_keep: int = 3,
                    checkpoint_async: str = "off",
                    preempt_grace_s: float = 0.0,
+                   pod_obs: str = "off",
+                   pod_straggler_factor: float = 2.0,
                    ready_state: Optional[dict] = None):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
     the wrapper can assemble the run report from its config/plan/timer.
@@ -770,6 +780,8 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         checkpoint_async=checkpoint_async,
         preempt_grace_s=preempt_grace_s,
         mesh_scenario=mesh_scenario,
+        pod_obs=pod_obs,
+        pod_straggler_factor=pod_straggler_factor,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation

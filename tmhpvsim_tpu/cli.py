@@ -76,12 +76,20 @@ def _chaos_options(f):
 def _obs_port_option(f):
     f = click.option(
         "--obs-port", "obs_port", type=int, default=None, metavar="PORT",
-        help="Bind the live ops plane on 127.0.0.1:PORT (0 picks a free "
-             "one): /metrics (OpenMetrics), /healthz, /readyz, /flight "
-             "— and turn on cross-process trace propagation "
-             "(trace_id/span_id riding every message's out-of-band "
-             "meta).  Unset: no socket is bound and no stamps are "
-             "added anywhere (obs/live.py)")(f)
+        help="Bind the live ops plane on --obs-bind:PORT (0 picks a "
+             "free one): /metrics (OpenMetrics), /podmetrics, /healthz, "
+             "/readyz, /flight — and turn on cross-process trace "
+             "propagation (trace_id/span_id riding every message's "
+             "out-of-band meta).  Unset: no socket is bound and no "
+             "stamps are added anywhere (obs/live.py)")(f)
+    f = click.option(
+        "--obs-bind", "obs_bind", default="127.0.0.1", show_default=True,
+        metavar="HOST",
+        help="Interface the live ops plane binds (with --obs-port): the "
+             "loopback default keeps it host-local; 0.0.0.0 (or a "
+             "specific interface) makes every pod worker's /metrics — "
+             "and process 0's /podmetrics fleet view — scrapeable "
+             "across hosts")(f)
     return f
 
 
@@ -198,7 +206,8 @@ def fanoutbroker(host, port, max_backlog, verbose):
 @_obs_port_option
 @_chaos_options
 def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
-             trace, backend, compile_cache, obs_port, chaos, chaos_seed):
+             trace, backend, compile_cache, obs_port, obs_bind, chaos,
+             chaos_seed):
     """1 Hz electricity-demand producer (reference metersim.py:79-95)."""
     from tmhpvsim_tpu.apps.metersim import metersim_main
 
@@ -209,7 +218,7 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
     asyncrun(metersim_main(amqp_url, exchange, realtime, seed, duration_s,
                            _parse_start(start), backend=backend,
                            trace=trace, compile_cache=compile_cache,
-                           obs_port=obs_port))
+                           obs_port=obs_port, obs_bind=obs_bind))
 
 
 @click.command()
@@ -420,6 +429,22 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
                    "snapshot and exits cleanly; with --supervise the "
                    "supervisor SIGKILLs a child still alive S seconds "
                    "after the stop signal.  0 = SIGTERM dies immediately")
+@click.option("--pod-obs", "pod_obs", type=click.Choice(["off", "on"]),
+              default="off", show_default=True,
+              help="Pod-scale observability (jax backend): at every block "
+                   "boundary of a multi-process run, gather per-host "
+                   "heartbeats (one small process_allgather), compute "
+                   "skew/straggler verdicts (WARN + pod.straggler_total "
+                   "when a host's block wall exceeds the pod median by "
+                   "--pod-straggler-factor) and emit the RunReport 'pod' "
+                   "section; the live ops plane additionally serves "
+                   "/podmetrics.  off pays nothing: no gathers, no "
+                   "stamps, byte-identical HLO (obs/pod.py)")
+@click.option("--pod-straggler-factor", "pod_straggler_factor", type=float,
+              default=2.0, show_default=True, metavar="X",
+              help="Straggler threshold for --pod-obs: a host whose block "
+                   "wall exceeds the pod median by this factor is flagged "
+                   "(config.SimConfig.pod_straggler_factor)")
 @click.option("--supervise", "supervise", type=int, default=0,
               metavar="N",
               help="Run as a supervised child and warm-restart it on a "
@@ -438,7 +463,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           blocks_per_dispatch, compute_dtype, kernel_impl, rng_batch,
           geom_stride, output_overlap,
           checkpoint_keep, checkpoint_async, preempt_grace,
-          supervise, obs_port, chaos, chaos_seed):
+          pod_obs, pod_straggler_factor,
+          supervise, obs_port, obs_bind, chaos, chaos_seed):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
     _maybe_supervise("pvsim", supervise,
@@ -508,6 +534,10 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--checkpoint-async requires --backend=jax")
     if preempt_grace != 0.0 and backend != "jax":
         raise click.UsageError("--preempt-grace requires --backend=jax")
+    if pod_obs != "off" and backend != "jax":
+        raise click.UsageError("--pod-obs requires --backend=jax")
+    if pod_straggler_factor <= 0:
+        raise click.UsageError("--pod-straggler-factor must be > 0")
     if checkpoint_keep < 1:
         raise click.UsageError("--checkpoint-keep must be >= 1")
     if preempt_grace < 0:
@@ -576,7 +606,9 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   checkpoint_keep=checkpoint_keep,
                   checkpoint_async=checkpoint_async,
                   preempt_grace_s=preempt_grace,
-                  obs_port=obs_port)
+                  pod_obs=pod_obs,
+                  pod_straggler_factor=pod_straggler_factor,
+                  obs_port=obs_port, obs_bind=obs_bind)
         return
 
     from tmhpvsim_tpu.apps.pvsim import pvsim_main
@@ -585,7 +617,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                         _parse_start(start), trace=trace,
                         metrics_path=metrics_path,
                         run_report_path=run_report_path,
-                        obs_port=obs_port))
+                        obs_port=obs_port, obs_bind=obs_bind))
 
 
 @click.command()
@@ -682,7 +714,7 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
           block_s, block_impl, tune, mesh_scenario, window_ms, max_batch,
           batch_sizes, queue_limit, timeout_s, drain_timeout_s, supervise,
           trace, metrics_path, run_report_path, compile_cache, obs_port,
-          chaos, chaos_seed):
+          obs_bind, chaos, chaos_seed):
     """Long-lived scenario server: a warm simulation answering "what-if"
     queries over the broker (serve/).  Each request perturbs bounded
     scenario knobs (demand scale/shift, DC-capacity scale, weather
@@ -718,7 +750,7 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
     asyncrun(serve_main(cfg, compile_cache=compile_cache, trace=trace,
                         metrics_path=metrics_path,
                         run_report_path=run_report_path,
-                        obs_port=obs_port))
+                        obs_port=obs_port, obs_bind=obs_bind))
 
 
 @click.group()

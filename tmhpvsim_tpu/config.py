@@ -568,6 +568,24 @@ class SimConfig:
     #: 1/8..7/8 fractions of meter_max_w
     analytics_thresholds: Optional[tuple] = None
 
+    #: pod-scale observability (obs/pod.py): 'off' (the default — no
+    #: monitor constructed, no heartbeat gathers, nothing stamped; the
+    #: lowered HLO is byte-identical to a build without the axis, like
+    #: telemetry/analytics) or 'on' (every block boundary gathers a
+    #: per-host heartbeat row over process_allgather, computes pod-wide
+    #: skew, and WARNs + counts ``pod.straggler_total`` when a host's
+    #: block wall exceeds ``pod_straggler_factor`` × the pod median;
+    #: surfaces as the RunReport v14 ``pod`` section and the
+    #: ``pod.*`` metrics).  Host-side numpy only — never enters the
+    #: traced graph.  Single-process runs gather locally (no
+    #: collective), so 'on' is safe everywhere.
+    pod_obs: str = "off"
+
+    #: straggler threshold: a host whose block wall exceeds this factor
+    #: times the pod-median block wall is flagged (WARN +
+    #: ``pod.straggler_total``)
+    pod_straggler_factor: float = 2.0
+
     #: streaming-trace output path (obs/trace.py): per-block host-side
     #: instants land in the tracer ring and export as Chrome-trace JSON
     #: here on exit.  Pure host-side observability — never enters the

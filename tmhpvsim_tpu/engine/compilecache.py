@@ -23,6 +23,11 @@ first:
   plain library use pays nothing.
 * :func:`executor_doc` snapshots the counters into the run report's
   ``executor`` section (schema v4, obs/report.py).
+* :func:`warm_up` also harvests the first hot per-block executable's
+  ``cost_analysis()`` flops/bytes as the *measured* cost basis
+  (:func:`measured_cost`) — ``obs/cost.py`` consumes it as
+  ``basis: "measured"`` with no manual plumbing, and the executor doc
+  carries the raw numbers.
 
 Cache-dir precedence: explicit argument > ``TMHPVSIM_COMPILE_CACHE`` >
 ``$XDG_CACHE_HOME/tmhpvsim_tpu/xla`` (``~/.cache`` fallback).  The
@@ -47,8 +52,16 @@ ENV_VAR = "TMHPVSIM_COMPILE_CACHE"
 OFF_VALUES = frozenset({"off", "none", "0", ""})
 
 # process-global state: the persistent cache is a jax.config property,
-# so there is exactly one active cache dir per process
-_state = {"dir": None, "configured": False, "listener": None}
+# so there is exactly one active cache dir per process.  ``cost`` is
+# the auto-harvested ``compiled.cost_analysis()`` of the hot per-block
+# jit (set by warm_up, read by obs/cost.py as the measured basis).
+_state = {"dir": None, "configured": False, "listener": None,
+          "cost": None}
+
+#: aot_targets whose cost_analysis is NOT the hot per-block dispatch
+#: (mega jits fold K blocks, resume copies are identity, scenario
+#: batches are the serving path) — the harvest skips them
+_COST_SKIP_PREFIXES = ("mega_", "resume_copy", "scenario_acc")
 
 
 def default_dir() -> str:
@@ -196,11 +209,13 @@ def warm_up(sim) -> dict:
         errors += 1
     for name, fn, args in targets:
         try:
-            fn.lower(*args).compile()
+            exe = fn.lower(*args).compile()
             compiled += 1
         except Exception as e:
             errors += 1
             logger.warning("AOT warm-up of %s failed: %s", name, e)
+            continue
+        _harvest_cost(sim, name, exe)
     wall = time.perf_counter() - t0
     if compiled:
         reg.counter("executor.aot_warmup_total").inc(compiled)
@@ -213,6 +228,72 @@ def warm_up(sim) -> dict:
         "errors": errors,
         "wall_s": wall,
     }
+
+
+def _harvest_cost(sim, name: str, compiled) -> None:
+    """Attach the FIRST hot per-block target's XLA ``cost_analysis()``
+    flops/bytes to the process state — the measured basis the cost
+    audit consumes (obs/cost.py), with NO manual plumbing: every AOT
+    warm-up harvests it for free.
+
+    The per-dispatch figures are normalised by the dispatch's simulated
+    site-seconds (``n_chains × block_s`` — the skip list keeps multi-
+    block mega jits and non-dispatch targets out), so the stored
+    ``flops_per_site_s`` / ``bytes_per_site_s`` compare directly with
+    the static-v1 model's anchors.  ``cost_analysis`` returns a dict on
+    current jax and a one-element list of dicts on older releases; the
+    HBM-traffic key is spelled ``"bytes accessed"``.  Harvest failures
+    are silent by design — measurement is an upgrade, never a gate.
+    """
+    if _state.get("cost") is not None:
+        return
+    if name.startswith(_COST_SKIP_PREFIXES):
+        return
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:
+        logger.debug("cost_analysis unavailable for %s: %s", name, e)
+        return
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed")
+    if not isinstance(flops, (int, float)) or flops <= 0:
+        return
+    try:
+        site_s = float(sim.config.n_chains) * float(sim.config.block_s)
+    except Exception:
+        return
+    if site_s <= 0:
+        return
+    cost = {
+        "target": name,
+        "site_s_per_dispatch": site_s,
+        "flops": float(flops),
+        "flops_per_site_s": float(flops) / site_s,
+    }
+    if isinstance(nbytes, (int, float)) and nbytes > 0:
+        cost["bytes_accessed"] = float(nbytes)
+        cost["bytes_per_site_s"] = float(nbytes) / site_s
+    tr = ca.get("transcendentals")
+    if isinstance(tr, (int, float)) and tr > 0:
+        cost["transcendentals"] = float(tr)
+    _state["cost"] = cost
+    logger.info(
+        "measured cost basis from %s: %.1f flops / %.1f bytes per "
+        "site-second", name, cost["flops_per_site_s"],
+        cost.get("bytes_per_site_s", 0.0),
+    )
+
+
+def measured_cost() -> Optional[dict]:
+    """The auto-harvested XLA ``cost_analysis`` of the hot per-block
+    jit, normalised per site-second (None until an AOT warm-up compiled
+    one in this process).  ``obs.cost.cost_doc`` reads this as the
+    ``basis: "measured"`` input."""
+    return _state.get("cost")
 
 
 def executor_doc(registry=None) -> Optional[dict]:
@@ -232,4 +313,6 @@ def executor_doc(registry=None) -> Optional[dict]:
     doc.setdefault("compile_warm", 0)
     doc.setdefault("compile_cold", 0)
     doc["cache_dir"] = _state["dir"]
+    if _state.get("cost") is not None:
+        doc["cost_analysis"] = dict(_state["cost"])
     return doc

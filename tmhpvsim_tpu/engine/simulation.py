@@ -61,6 +61,7 @@ from tmhpvsim_tpu.models import renewal
 from tmhpvsim_tpu.models import solar
 from tmhpvsim_tpu.models import tables as _tables
 from tmhpvsim_tpu.models.timegrid import TimeGridSpec
+from tmhpvsim_tpu.runtime import faults
 
 
 @dataclasses.dataclass
@@ -447,6 +448,13 @@ class Simulation:
         self._m_dispatch = self.metrics.counter("executor.dispatches_total")
         self.metrics.gauge("executor.blocks_per_dispatch").set(
             self._k_dispatch)
+        #: pod observability (obs/pod.py): the monitor is constructed
+        #: lazily at the FIRST block boundary (the sharded subclass's
+        #: mesh exists by then) and only when the axis is on — 'off'
+        #: builds nothing, gathers nothing, stamps nothing, so the
+        #: lowered HLO is byte-identical (tests/test_pod_obs.py)
+        self._pod = None
+        self._pod_on = getattr(config, "pod_obs", "off") != "off"
         if not getattr(self, "_defer_warm_start", False):
             self._warm_start()
 
@@ -2287,6 +2295,8 @@ class Simulation:
             if k == 1 and self._output_overlap:
                 pend = None  # previous block's un-gathered device outputs
                 for bi in range(start_block, self.n_blocks):
+                    if faults.ACTIVE is not None:
+                        faults.fire("block.stall", block=bi)
                     inputs, epoch = pf.get(bi)
                     with annotate("tmhpvsim/block_step"):
                         self.state, a, b = jit(self.state, inputs)
@@ -2301,6 +2311,8 @@ class Simulation:
             bi = start_block
             while bi < self.n_blocks:
                 kk = min(k, self.n_blocks - bi)
+                if faults.ACTIVE is not None:
+                    faults.fire("block.stall", block=bi)
                 if kk == 1:
                     inputs, epoch = pf.get(bi)
                     with annotate("tmhpvsim/block_step"):
@@ -2315,6 +2327,8 @@ class Simulation:
                     self.timer.tick()
                     self._m_blocks.inc()
                     self._m_dispatch.inc()
+                    if self._pod_on:
+                        self._observe_pod(bi)
                     yield result
                 else:
                     got = [pf.get(b) for b in range(bi, bi + kk)]
@@ -2335,6 +2349,9 @@ class Simulation:
                     self.timer.tick(n_blocks=kk)
                     self._m_blocks.inc(kk)
                     self._m_dispatch.inc()
+                    if self._pod_on:
+                        for j in range(kk):
+                            self._observe_pod(bi + j)
                     yield from results
                 bi += kk
         finally:
@@ -2353,6 +2370,8 @@ class Simulation:
                              n_valid)
         self.timer.tick()
         self._m_blocks.inc()
+        if self._pod_on:
+            self._observe_pod(bi)
         return result
 
     def _trace_result(self, off, epoch, meter, pv, n_valid) -> BlockResult:
@@ -2436,6 +2455,11 @@ class Simulation:
             bi = start_block
             while bi < self.n_blocks:
                 kk = min(k, self.n_blocks - bi)
+                # host-side chaos chokepoint: a scheduled delay here is
+                # the deterministic straggler the pod monitor detects
+                # (never in-graph — the compiled HLO is untouched)
+                if faults.ACTIVE is not None:
+                    faults.fire("block.stall", block=bi)
                 if kk == 1:
                     inputs, _ = pf.get(bi)
                     with annotate("tmhpvsim/block_step"):
@@ -2480,12 +2504,46 @@ class Simulation:
                             self._fleet_last = jax.tree.map(
                                 lambda a, _j=j: a[_j], fleets)
                         self._observe_fleet(bj)
+                    if self._pod_on:
+                        self._observe_pod(bj)
                     if on_block is not None:
                         on_block(bj, self.state, acc_j)
                 bi += kk
         finally:
             pf.close()
         return {k: self._host_view(v) for k, v in acc.items()}
+
+    def _observe_pod(self, bi: int) -> None:
+        """Per-block pod heartbeat (obs/pod.py): gather every host's
+        block wall, compute skew/straggler verdicts, and keep the pod
+        section current.  COLLECTIVE under multi-process jax — every
+        run path calls it from the per-block tail that executes
+        identically on all hosts (the sharded dispatch already
+        synchronised the pod at this boundary).  The monitor is built
+        lazily here so the sharded subclass's ``self.mesh`` exists."""
+        if self._pod is None:
+            from tmhpvsim_tpu.obs.pod import PodMonitor
+            from tmhpvsim_tpu.parallel.distributed import local_chain_slice
+
+            cfg = self.config
+            start, stop = 0, cfg.n_chains
+            mesh = getattr(self, "mesh", None)
+            try:
+                multi = jax.process_count() > 1
+            except Exception:
+                multi = False
+            if mesh is not None and multi:
+                sl = local_chain_slice(cfg.n_chains, mesh)
+                start, stop = sl.start, sl.stop
+            self._pod = PodMonitor(
+                n_chains=cfg.n_chains, block_s=cfg.block_s,
+                straggler_factor=getattr(cfg, "pod_straggler_factor",
+                                         2.0),
+                registry=self.metrics, chain_start=start,
+                chain_stop=stop)
+        wall = self.timer.last_block_s()
+        self._pod.observe_block(bi, wall,
+                                (1.0 / wall) if wall > 0 else 0.0)
 
     def _observe_telemetry(self, bi: int) -> None:
         """Per-block telemetry flush: fetch the block's ~30 accumulator
@@ -2684,6 +2742,8 @@ class Simulation:
         prec = self.precision_doc()
         if prec is not None:
             rep.precision = prec
+        if self._pod is not None:
+            rep.pod = self._pod.doc()
         rep.headline = headline if headline is not None else {
             "site_seconds_per_s": summary["site_seconds_per_s"],
         }

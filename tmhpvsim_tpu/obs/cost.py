@@ -158,9 +158,31 @@ def cost_doc(*, site_s_per_s: Optional[float],
     GFLOP/s, GB/s, north-star fraction), plus roofline fractions when
     the device kind has published peaks.  Measured XLA per-site costs,
     when provided, take precedence over the static prediction for the
-    achieved rates; the prediction stays in the doc either way."""
+    achieved rates; the prediction stays in the doc either way.
+
+    When the caller passes no measurement, the auto-harvested basis
+    from the AOT warm-up is used (engine/compilecache.py
+    ``measured_cost()`` — ``compiled.cost_analysis()`` of the hot
+    per-block jit, normalised per site-second).  That is what makes
+    ``basis: "measured"`` appear with NO manual plumbing on every run
+    that warmed the compile cache.  Under a measured basis the doc also
+    carries the ``model_error`` sub-doc (:func:`model_error_doc`):
+    each static-v1 factor priced against the measurement."""
     doc = model_cost(block_impl, compute_dtype, kernel_impl,
                      rng_batch, geom_stride)
+    if measured_flops_per_site_s is None and \
+            measured_bytes_per_site_s is None:
+        try:
+            from tmhpvsim_tpu.engine.compilecache import measured_cost
+
+            mc = measured_cost()
+        except Exception:
+            mc = None
+        if mc:
+            measured_flops_per_site_s = mc.get("flops_per_site_s")
+            measured_bytes_per_site_s = mc.get("bytes_per_site_s")
+            if measured_flops_per_site_s and mc.get("target"):
+                doc["measured_target"] = str(mc["target"])
     flops_ss = (measured_flops_per_site_s
                 if measured_flops_per_site_s else doc["flops_per_site_s"])
     bytes_ss = (measured_bytes_per_site_s
@@ -172,6 +194,9 @@ def cost_doc(*, site_s_per_s: Optional[float],
         doc["measured_bytes_per_site_s"] = round(
             float(measured_bytes_per_site_s), 2)
     doc["basis"] = "measured" if measured_flops_per_site_s else "model"
+    if doc["basis"] == "measured":
+        doc["model_error"] = model_error_doc(
+            doc, measured_flops_per_site_s, measured_bytes_per_site_s)
     if site_s_per_s:
         rate = float(site_s_per_s)
         doc["site_s_per_s"] = round(rate, 1)
@@ -187,6 +212,53 @@ def cost_doc(*, site_s_per_s: Optional[float],
                 doc["achieved_gbs"] / peaks["hbm_gbs"], 5)
             doc["peaks"] = dict(peaks)
     return doc
+
+
+def model_error_doc(doc: dict,
+                    measured_flops_per_site_s: Optional[float],
+                    measured_bytes_per_site_s: Optional[float]) -> dict:
+    """Price each static-v1 factor against measurement — ROADMAP item
+    2's "say which factor model terms were wrong", computable only
+    under a measured basis.
+
+    ``flops_ratio`` / ``bytes_ratio`` are measured ÷ static (1.0 =
+    perfect model); the ``_err_pct`` twins are the same as signed
+    percentages.  ``factors`` then carries, per plan axis, the factor
+    the static table actually used and the *implied* factor — the
+    value that axis would need for the model to match measurement if
+    IT alone absorbed the whole error.  An implied factor far from its
+    table entry on exactly one axis names the term to re-anchor."""
+    out = {}
+    sf = float(doc["flops_per_site_s"])
+    fr = (float(measured_flops_per_site_s) / sf
+          if measured_flops_per_site_s and sf else None)
+    sb = float(doc["bytes_per_site_s"])
+    br = (float(measured_bytes_per_site_s) / sb
+          if measured_bytes_per_site_s and sb else None)
+    out["flops_ratio"] = round(fr, 4) if fr is not None else None
+    out["flops_err_pct"] = (round((fr - 1.0) * 100.0, 2)
+                            if fr is not None else None)
+    out["bytes_ratio"] = round(br, 4) if br is not None else None
+    out["bytes_err_pct"] = (round((br - 1.0) * 100.0, 2)
+                            if br is not None else None)
+    factors = {}
+    for axis, table, key in (
+        ("block_impl", _BLOCK_IMPL_FACTORS, doc["block_impl"]),
+        ("compute_dtype", _DTYPE_FACTORS, doc["compute_dtype"]),
+        ("kernel_impl", _KERNEL_FACTORS, doc["kernel_impl"]),
+        ("rng_batch", _RNG_BATCH_FACTORS, doc.get("rng_batch", "scan")),
+        ("geom_stride", _GEOM_STRIDE_FACTORS,
+         str(doc.get("geom_stride", 1))),
+    ):
+        f, b = table.get(key, (1.0, 1.0))
+        row = {"value": str(key), "flops_factor": f, "bytes_factor": b}
+        if fr is not None:
+            row["implied_flops_factor"] = round(f * fr, 4)
+        if br is not None:
+            row["implied_bytes_factor"] = round(b * br, 4)
+        factors[axis] = row
+    out["factors"] = factors
+    return out
 
 
 #: the gauge keys publish_gauges mirrors out of a cost doc (numeric
@@ -241,6 +313,37 @@ def validate_cost(doc) -> list:
                       f"{doc['basis']!r}")
     if "peaks" in doc and not isinstance(doc["peaks"], dict):
         errors.append("cost.peaks: expected dict")
+    # v14 additions — optional, so pre-v14 documents keep validating
+    if "measured_target" in doc and \
+            not isinstance(doc["measured_target"], str):
+        errors.append("cost.measured_target: expected str")
+    me = doc.get("model_error")
+    if "model_error" in doc and me is not None:
+        if not isinstance(me, dict):
+            errors.append(f"cost.model_error: expected object or null, "
+                          f"got {type(me).__name__}")
+        else:
+            for key in ("flops_ratio", "flops_err_pct", "bytes_ratio",
+                        "bytes_err_pct"):
+                v = me.get(key)
+                if v is not None and not isinstance(v, (int, float)):
+                    errors.append(f"cost.model_error.{key}: expected "
+                                  "number or null")
+            fx = me.get("factors")
+            if fx is not None and not isinstance(fx, dict):
+                errors.append("cost.model_error.factors: expected "
+                              "object or null")
+            elif isinstance(fx, dict):
+                for axis, row in fx.items():
+                    if not isinstance(row, dict):
+                        errors.append(f"cost.model_error.factors."
+                                      f"{axis}: expected object")
+                        continue
+                    for key in ("flops_factor", "bytes_factor"):
+                        if not isinstance(row.get(key), (int, float)):
+                            errors.append(
+                                f"cost.model_error.factors.{axis}."
+                                f"{key}: expected number")
     frac = doc.get("north_star_frac")
     if isinstance(frac, (int, float)) and frac < 0:
         errors.append(f"cost.north_star_frac: negative ({frac})")

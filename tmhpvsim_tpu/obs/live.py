@@ -7,7 +7,16 @@ default) that ``pvsim``, ``pvsim serve`` and ``metersim`` embed, serving
 
 * ``GET /metrics`` — the run's :class:`~..obs.metrics.MetricsRegistry`
   in OpenMetrics 1.0 text exposition (device telemetry / fleet gauges
-  update at block granularity mid-run, so a scrape sees the live run);
+  update at block granularity mid-run, so a scrape sees the live run).
+  Under multi-process jax every sample carries a ``process="<idx>"``
+  label (obs/pod.py ``process_labels``) so a federated scrape of all
+  hosts stays distinguishable; single-process output is byte-identical
+  to the unlabelled exposition;
+* ``GET /podmetrics`` — the pod-wide view (obs/pod.py): aggregates
+  (host count, median block wall, straggler total) next to per-host
+  rows from the latest heartbeat gather, so ONE scrape of process 0
+  sees the whole fleet; 404 until a multi-process run with
+  ``pod_obs='on'`` reaches a block boundary;
 * ``GET /healthz`` — liveness: 200 whenever the event loop turns;
 * ``GET /readyz`` — readiness wired to real state via an injectable
   callable (serve: AOT warm-up done AND not draining AND circuit breaker
@@ -96,8 +105,8 @@ class ObsServer:
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
-        logger.info("obs endpoint on http://%s:%d (/metrics /healthz "
-                    "/readyz /flight)", self.host, self.port)
+        logger.info("obs endpoint on http://%s:%d (/metrics /podmetrics "
+                    "/healthz /readyz /flight)", self.host, self.port)
         return self
 
     async def stop(self) -> None:
@@ -188,7 +197,21 @@ class ObsServer:
         reg = self.registry
         reg.counter("obs.live.requests").inc()
         if path == "/metrics":
-            text = reg.openmetrics_text(prefix=self.prefix)
+            # labels resolved at scrape time: jax.distributed may not
+            # be initialised yet when the server is constructed
+            from tmhpvsim_tpu.obs.pod import process_labels
+
+            text = reg.openmetrics_text(prefix=self.prefix,
+                                        labels=process_labels())
+            return 200, OPENMETRICS_CONTENT_TYPE, text.encode("utf-8")
+        if path == "/podmetrics":
+            from tmhpvsim_tpu.obs.pod import podmetrics_text
+
+            text = podmetrics_text(self.prefix)
+            if text is None:
+                return 404, "text/plain; charset=utf-8", \
+                    b"no pod snapshot (pod observability off, or no " \
+                    b"block boundary gathered yet)\n"
             return 200, OPENMETRICS_CONTENT_TYPE, text.encode("utf-8")
         if path == "/healthz":
             return 200, "text/plain; charset=utf-8", b"ok\n"

@@ -303,33 +303,46 @@ class MetricsRegistry:
                 lines.append(f"{pname}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
 
-    def openmetrics_text(self, prefix: str = "tmhpvsim") -> str:
+    def openmetrics_text(self, prefix: str = "tmhpvsim",
+                         labels: Optional[dict] = None) -> str:
         """The registry in OpenMetrics 1.0 text exposition format (what
         ``obs/live.py`` serves at ``/metrics``).  Differs from
         :meth:`prometheus_text` exactly where the specs diverge: counter
         samples carry the ``_total`` suffix and the exposition ends with
-        the mandatory ``# EOF`` terminator."""
+        the mandatory ``# EOF`` terminator.
+
+        ``labels`` stamps every sample with a constant label set —
+        obs/live.py passes ``{"process": "<idx>"}`` under multi-process
+        jax so federated pod scrapes can tell the hosts apart
+        (obs/pod.py ``process_labels``).  Histogram buckets merge the
+        extra labels after ``le``.  None/empty keeps the output
+        byte-identical to the unlabelled exposition."""
+        extra = ",".join(f'{k}="{v}"'
+                         for k, v in sorted((labels or {}).items()))
+        lbl = "{" + extra + "}" if extra else ""
         lines = []
         for name, m in sorted(self._metrics.items()):
             pname = _prom_name(f"{prefix}_{name}" if prefix else name)
             if isinstance(m, Counter):
                 lines += [f"# TYPE {pname} counter",
-                          f"{pname}_total {_prom_num(m.value)}"]
+                          f"{pname}_total{lbl} {_prom_num(m.value)}"]
             elif isinstance(m, Gauge):
                 lines += [f"# TYPE {pname} gauge",
-                          f"{pname} {_prom_num(m.value)}"]
+                          f"{pname}{lbl} {_prom_num(m.value)}"]
             else:
                 lines.append(f"# TYPE {pname} histogram")
+                bext = ("," + extra) if extra else ""
                 running = 0
                 for bound, n in zip(m.bounds, m.bucket_counts):
                     running += n
                     lines.append(
-                        f'{pname}_bucket{{le="{_prom_num(bound)}"}} '
-                        f"{running}"
+                        f'{pname}_bucket{{le="{_prom_num(bound)}"'
+                        f"{bext}}} {running}"
                     )
-                lines.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
-                lines.append(f"{pname}_sum {_prom_num(m.sum)}")
-                lines.append(f"{pname}_count {m.count}")
+                lines.append(f'{pname}_bucket{{le="+Inf"{bext}}} '
+                             f"{m.count}")
+                lines.append(f"{pname}_sum{lbl} {_prom_num(m.sum)}")
+                lines.append(f"{pname}_count{lbl} {m.count}")
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 

@@ -122,6 +122,15 @@ class BlockTimer:
             )
         return dt
 
+    def last_block_s(self) -> float:
+        """The most recent per-block(-equivalent) wall: the latest
+        steady entry, else the compile-inclusive first block, else 0.0
+        before any tick.  What the pod heartbeat reports as this
+        host's block wall (obs/pod.py)."""
+        if self.block_times:
+            return self.block_times[-1]
+        return self._first_dt or 0.0
+
     def rate(self) -> float:
         """Current site-s/s throughput, quiet — same preference order as
         :meth:`summary` (steady blocks, else the compile-inclusive
@@ -201,9 +210,50 @@ def read_manifest(log_dir: str) -> Optional[dict]:
         return None
 
 
+def _start_trace(log_dir: str, python_tracer: bool) -> None:
+    """``jax.profiler.start_trace``, optionally with the Python-frame
+    tracer disabled.
+
+    The Chrome-trace export caps at ~1M events; over a minutes-long
+    capture the Python tracer's per-frame events alone exceed the cap
+    and the XLA op events — the part ``obs.pod.comm_split`` needs — are
+    the ones dropped.  jax's public ``start_trace`` hardcodes default
+    profiler options, so the opt-out builds the ``ProfilerSession``
+    with ``python_tracer_level=0`` through the same profile-state slot
+    ``stop_trace`` reads; any internals mismatch (other jax versions)
+    falls back to the public path, which is always correct, just
+    noisier."""
+    import jax
+
+    if python_tracer:
+        jax.profiler.start_trace(log_dir)
+        return
+    try:
+        from jax._src.lib import xla_client
+        from jax._src.profiler import _profile_state
+
+        with _profile_state.lock:
+            if _profile_state.profile_session is not None:
+                raise RuntimeError("Profile has already been started. "
+                                   "Only one profile may be run at a time.")
+            opts = xla_client.profiler.ProfileOptions()
+            opts.python_tracer_level = 0
+            _profile_state.profile_session = \
+                xla_client.profiler.ProfilerSession(opts)
+            _profile_state.create_perfetto_link = False
+            _profile_state.create_perfetto_trace = False
+            _profile_state.log_dir = str(log_dir)
+    except RuntimeError:
+        raise
+    except Exception as e:
+        logger.warning("python-tracer opt-out unavailable on this jax "
+                       "(%s); capturing with default options", e)
+        jax.profiler.start_trace(log_dir)
+
+
 @contextlib.contextmanager
 def device_trace(log_dir: str, expect_platform: Optional[str] = None,
-                 strict: bool = False):
+                 strict: bool = False, python_tracer: bool = True):
     """``jax.profiler`` trace scope with a platform-guarded sidecar.
 
     On exit, ``trace_manifest.json`` in ``log_dir`` records the backend
@@ -214,6 +264,12 @@ def device_trace(log_dir: str, expect_platform: Optional[str] = None,
     trace unnoticed.  ``expect_platform`` defaults to the
     ``TMHPVSIM_EXPECT_PLATFORM`` env var; None/unset disables the guard
     (the platform is still recorded).
+
+    ``python_tracer=False`` drops Python-frame events from the capture
+    (see :func:`_start_trace`) — pass it when the trace feeds op-level
+    analysis (``obs.pod.comm_split``) rather than a human timeline, or
+    when the capture spans minutes (frame events otherwise crowd the
+    XLA ops out of the ~1M-event export cap).
     """
     import jax
 
@@ -221,7 +277,7 @@ def device_trace(log_dir: str, expect_platform: Optional[str] = None,
         expect_platform = os.environ.get(EXPECT_ENV) or None
     t0 = time.perf_counter()
     started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    jax.profiler.start_trace(log_dir)
+    _start_trace(log_dir, python_tracer)
     body_ok = True
     try:
         yield

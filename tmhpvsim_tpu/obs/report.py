@@ -95,9 +95,20 @@ logger = logging.getLogger(__name__)
 #: ``chain_start``/``chain_stop``).  Additive — unsharded runs omit it
 #: (None), and a v12 reader ignores the extra key only if it reads
 #: leniently; strict v12 readers should bump.
+#: v14: adds the optional ``pod`` section (pod-scale observability,
+#: obs/pod.py ``PodMonitor.doc()``): per-host heartbeat rows gathered
+#: at block boundaries (process, chain range, block index, block wall,
+#: blocks/s), skew statistics against the pod-median block wall,
+#: ``straggler_total`` (block walls exceeding ``straggler_factor`` ×
+#: the pod median), and the collective-vs-compute device-time split's
+#: ``comm_frac`` when a device trace was captured.  The ``cost``
+#: section gains the optional ``model_error`` sub-doc (obs/cost.py):
+#: measured-vs-static flops/bytes ratios and per-factor implied
+#: corrections, present only under ``basis: "measured"``.  All
+#: additive — unsharded/off runs omit the section (None).
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 13
+REPORT_SCHEMA_VERSION = 14
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -131,6 +142,7 @@ _TOP_SCHEMA = {
     "probe": (False, _OPT_DICT),
     "cost": (False, _OPT_DICT),
     "mesh": (False, _OPT_DICT),
+    "pod": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -299,6 +311,12 @@ def validate_report(doc) -> dict:
         errors = validate_mesh_section(doc["mesh"])
         if errors:
             raise ValueError("run report mesh: " + "; ".join(errors))
+    if isinstance(doc.get("pod"), dict):
+        from tmhpvsim_tpu.obs.pod import validate_pod_section
+
+        errors = validate_pod_section(doc["pod"])
+        if errors:
+            raise ValueError("run report pod: " + "; ".join(errors))
     try:
         json.dumps(doc)
     except (TypeError, ValueError) as e:
@@ -620,6 +638,10 @@ class RunReport:
         #: ``parallel.distributed.mesh_doc`` by sharded runs — device
         #: grid shape + axis names, process topology, chain layout
         self.mesh: Optional[dict] = None
+        #: pod observability section (schema v14): set from
+        #: ``obs.pod.PodMonitor.doc()`` — per-host heartbeat rows, skew
+        #: stats, straggler counts, collective-vs-compute comm_frac
+        self.pod: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -724,6 +746,7 @@ class RunReport:
             "probe": self.probe,
             "cost": self.cost,
             "mesh": self.mesh,
+            "pod": self.pod,
         }
         return validate_report(out) if validate else out
 

@@ -233,6 +233,28 @@ def psum_fleet(fa: dict, axis_name: str) -> dict:
     return {k: coll[kinds[k]](v, axis_name) for k, v in fa.items()}
 
 
+def gather_rows(row: np.ndarray) -> np.ndarray:
+    """Every process's fixed-width float64 row, stacked in
+    process-index order: ``(process_count, len(row))``.
+
+    COLLECTIVE under multi-process jax — all processes must call it
+    with the same row width (the pod heartbeat path calls it at block
+    boundaries, where the sharded dispatch already synchronised
+    everyone).  Single-process runs return ``row[None]`` without
+    touching any collective, so callers never need their own guard.
+    Unlike :func:`gather_metrics` there is no length negotiation: one
+    ``process_allgather`` round per call, which is what makes it cheap
+    enough for per-block heartbeats.
+    """
+    row = np.asarray(row, dtype=np.float64).ravel()
+    if jax.process_count() == 1:
+        return row[None]
+    from jax.experimental import multihost_utils
+
+    out = np.asarray(multihost_utils.process_allgather(row))
+    return out.reshape(jax.process_count(), row.size)
+
+
 def gather_metrics(snapshot: dict) -> list:
     """Every process's metrics snapshot, in process-index order.
 

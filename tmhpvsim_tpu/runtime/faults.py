@@ -14,7 +14,7 @@ Spec grammar (``--chaos SPEC`` / ``TMHPVSIM_CHAOS``)::
     POINT   := broker.connect | broker.publish | broker.deliver
              | tcp.partition | funnel.stall | serve.dispatch
              | checkpoint.write | checkpoint.corrupt
-             | checkpoint.committed | signal.preempt
+             | checkpoint.committed | signal.preempt | block.stall
     ACTION  := raise | delay:SECONDS | drop | dup | kill
              | truncate:BYTES
     TRIGGER := 'n'K        fire on the K-th call (1-based); 'x'C extends
@@ -31,6 +31,8 @@ Examples::
     checkpoint.committed=kill@n2     SIGKILL right after the 2nd commit
     checkpoint.corrupt=truncate:120@n2   tear the 2nd checkpoint write
     signal.preempt=raise@n3          preemption notice on the 3rd block
+    block.stall=delay:0.5@every2     every 2nd block dispatch stalls
+                                     0.5 s (deterministic straggler)
 
 Actions: ``raise`` raises :class:`FaultInjected` (a ``ConnectionError``,
 so transport retry paths treat it as transient), ``delay:S`` sleeps,
@@ -75,6 +77,10 @@ POINTS = (
     "checkpoint.corrupt",
     "checkpoint.committed",
     "signal.preempt",
+    # host-side stall before a block dispatch (engine/simulation.py
+    # per-block loops — NEVER in-graph), the deterministic straggler
+    # for pod-skew tests: --chaos 'block.stall=delay:0.5@every2'
+    "block.stall",
 )
 
 ACTIONS = ("raise", "delay", "drop", "dup", "kill", "truncate")

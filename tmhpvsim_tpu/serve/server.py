@@ -675,6 +675,7 @@ async def serve_main(cfg: ServeConfig, *,
                      metrics_path: Optional[str] = None,
                      run_report_path: Optional[str] = None,
                      obs_port: Optional[int] = None,
+                     obs_bind: str = "127.0.0.1",
                      install_signals: bool = True) -> None:
     """App orchestrator behind ``pvsim serve``: per-run registry +
     compile cache + flight recorder + run report, around one
@@ -693,7 +694,7 @@ async def serve_main(cfg: ServeConfig, *,
     server = ScenarioServer(cfg, registry=registry, tracer=tracer)
     if obs_port is not None:
         obs_trace.enable_propagation(True)
-    async with maybe_obs_server(obs_port, registry=registry,
+    async with maybe_obs_server(obs_port, host=obs_bind, registry=registry,
                                 tracer=tracer, ready=server.readiness):
         await _serve_main_inner(cfg, server, registry, sink, tracer,
                                 compile_cache, trace, run_report_path,

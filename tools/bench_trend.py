@@ -208,6 +208,29 @@ def _mesh_fields(doc) -> tuple:
     return mesh, hosts
 
 
+def _pod_fields(doc) -> tuple:
+    """(comm_frac, cost_err_pct) of one document: the collective time
+    fraction from a v14 ``pod`` section and the signed flops model
+    error from the v14 ``cost.model_error`` sub-doc — the bare
+    RunReport's or the embedded run_report's.  Pre-v14 documents read
+    as (None, None) and render as ``-``."""
+    pod = cost = None
+    if doc.get("kind") == REPORT_KIND:
+        pod, cost = doc.get("pod"), doc.get("cost")
+    else:
+        rep = doc.get("run_report")
+        if isinstance(rep, dict):
+            pod, cost = rep.get("pod"), rep.get("cost")
+    cf = pod.get("comm_frac") if isinstance(pod, dict) else None
+    err = None
+    if isinstance(cost, dict) and isinstance(cost.get("model_error"),
+                                             dict):
+        e = cost["model_error"].get("flops_err_pct")
+        if isinstance(e, (int, float)):
+            err = float(e)
+    return (float(cf) if isinstance(cf, (int, float)) else None, err)
+
+
 def _stale_embedded_note(doc: dict) -> str | None:
     """A cpu-fallback headline carries the newest REAL-TPU headline as
     ``last_tpu_headline`` evidence (bench.py _last_tpu_evidence).  That
@@ -242,6 +265,7 @@ def normalize(path: str) -> dict:
            "precision_speedup": None, "north_star_frac": None,
            "roofline_frac_vpu": None, "fleet_sites": None,
            "fleet_ratio": None, "mesh": None, "hosts": None,
+           "comm_frac": None, "cost_err_pct": None,
            "failed": True}
     try:
         with open(path) as f:
@@ -280,6 +304,7 @@ def normalize(path: str) -> dict:
         nsf, vpu = _cost_fields(doc)
         fs, fr = _fleet_fields(doc)
         mesh, hosts = _mesh_fields(doc)
+        cf, cerr = _pod_fields(doc)
         row.update(
             failed=False,
             platform=(doc.get("device") or {}).get("platform"),
@@ -294,6 +319,7 @@ def normalize(path: str) -> dict:
             north_star_frac=nsf, roofline_frac_vpu=vpu,
             fleet_sites=fs, fleet_ratio=fr,
             mesh=mesh, hosts=hosts,
+            comm_frac=cf, cost_err_pct=cerr,
         )
         return row
 
@@ -309,6 +335,7 @@ def normalize(path: str) -> dict:
         nsf, vpu = _cost_fields(doc)
         fs, fr = _fleet_fields(doc)
         mesh, hosts = _mesh_fields(doc)
+        cf, cerr = _pod_fields(doc)
         # the round's OWN top-level headline is authoritative for the
         # north-star fraction; the cost-section copy is a fallback, and
         # anything inside an embedded last_tpu_headline is a prior
@@ -331,6 +358,7 @@ def normalize(path: str) -> dict:
             north_star_frac=nsf, roofline_frac_vpu=vpu,
             fleet_sites=fs, fleet_ratio=fr,
             mesh=mesh, hosts=hosts,
+            comm_frac=cf, cost_err_pct=cerr,
         )
         stale = _stale_embedded_note(doc)
         if stale:
@@ -457,12 +485,14 @@ def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
             "cdt", "kimpl", "rb", "gs", "prec", "fleet", "cost",
-            "mesh", "hosts", "note")
+            "mesh", "hosts", "comm%", "cost-err", "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
         srv = r.get("serve")
         prec = r.get("precision_speedup")
+        cf = r.get("comm_frac")
+        cerr = r.get("cost_err_pct")
         table.append((
             r["name"], r["platform"] or "-", _fmt(r["value"]),
             _fmt(r["compile_s"]), _fmt(r["steady_block_s"]),
@@ -477,6 +507,8 @@ def print_table(rows: list) -> None:
             _fmt_cost(r),
             r.get("mesh") or "-",
             "-" if r.get("hosts") is None else str(r["hosts"]),
+            "-" if cf is None else f"{cf * 100:.1f}",
+            "-" if cerr is None else f"{cerr:+.1f}%",
             r.get("note", ""),
         ))
     widths = [max(len(str(line[i])) for line in table)

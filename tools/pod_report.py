@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the ``pod`` section of run reports.
+
+Accepts any mix of the shapes the repo's tooling writes (the same
+contract as tools/mesh_report.py):
+
+* a bare RunReport JSON (``kind == "tmhpvsim_tpu.run_report"``);
+* a bench doc — one JSON object with an embedded ``run_report`` key
+  (bench.py's per-phase stdout lines / BENCH_*.json, in particular the
+  ``bench.py --hosts K`` artifact);
+* a JSONL stream of either (bench.py batteries append one doc per
+  phase: SWEEP_r05.jsonl and friends).
+
+Every pod section found (schema v14, obs/pod.py ``PodMonitor.doc``) is
+checked with ``obs.pod.validate_pod_section`` — process bounds, host
+rows vs process count, skew positivity, comm_frac range — and printed
+as a one-glance fleet line:
+
+    HOSTS2.json[hosts][run_report]: pod 2 host(s), 3 block(s),
+      skew max 1.42x, stragglers 0, comm 7.3%
+
+Exit code 0 when every *present* pod section validates — reports
+without one (pre-v14 documents, single-process runs, pod obs off) are
+fine and just noted, which is how ``run_tpu_round5b.sh`` consumes this
+non-fatally after each bench doc.  Nonzero means a malformed section:
+the pod plumbing wrote something ``PodMonitor.doc`` never emits.
+
+The only repo import is ``obs.pod`` (pure stdlib at import time): runs
+anywhere the repo checks out, no jax required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repo-root import without installation (the tools/ scripts' pattern)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmhpvsim_tpu.obs.pod import validate_pod_section  # noqa: E402
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+
+def print_pod(sec: dict, label: str) -> None:
+    skew = sec.get("skew") or {}
+    line = (f"{label}: pod {sec.get('process_count')} host(s), "
+            f"{sec.get('blocks_observed')} block(s), "
+            f"skew max {skew.get('max_over_median')}x, "
+            f"stragglers {sec.get('straggler_total')}")
+    cf = sec.get("comm_frac")
+    if isinstance(cf, (int, float)):
+        line += f", comm {100.0 * cf:.1f}%"
+    print(line)
+
+
+def _iter_docs(path: str):
+    """Parsed JSON documents in ``path``: one whole-file document, or
+    one per line (bench batteries write JSONL)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            yield json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+
+
+def _extract_sections(doc):
+    """(label_suffix, pod_section) pairs embedded in one parsed doc."""
+    if not isinstance(doc, dict):
+        return
+    if doc.get("kind") == REPORT_KIND:
+        if doc.get("pod") is not None:
+            yield "", doc["pod"]
+        return
+    if "parsed" in doc and "cmd" in doc:   # driver round wrapper
+        doc = doc.get("parsed") or {}
+    label = doc.get("phase") or doc.get("variant") or doc.get("config")
+    suffix = f"[{label}]" if label else ""
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and rep.get("pod") is not None:
+        yield f"{suffix}[run_report]" if suffix else "[run_report]", \
+            rep["pod"]
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate (and print) every pod section in one file; True when
+    all present sections pass.  A file with none passes trivially."""
+    name = os.path.basename(path)
+    try:
+        docs = list(_iter_docs(path))
+    except OSError as e:
+        print(f"{name}: UNREADABLE ({e})", file=sys.stderr)
+        return False
+    found = 0
+    ok = True
+    for doc in docs:
+        for suffix, sec in _extract_sections(doc):
+            found += 1
+            errors = validate_pod_section(sec)
+            if errors:
+                ok = False
+                print(f"{name}{suffix}: INVALID pod section "
+                      f"({len(errors)} error(s))", file=sys.stderr)
+                for e in errors[:10]:
+                    print(f"  {e}", file=sys.stderr)
+                if len(errors) > 10:
+                    print(f"  ... and {len(errors) - 10} more",
+                          file=sys.stderr)
+            elif not quiet:
+                print_pod(sec, f"{name}{suffix}")
+    if not found and not quiet:
+        print(f"{name}: no pod section (single-process run, pod obs "
+              f"off, or pre-v14 report)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print RunReport pod sections "
+                    "(bare reports, bench docs, or JSONL of either)")
+    ap.add_argument("files", nargs="+", help="report/bench files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the fleet lines (errors still print)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
