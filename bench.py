@@ -264,6 +264,11 @@ TELEMETRY = "off"
 #: (--analytics; obs/analytics.py).
 ANALYTICS = "off"
 
+#: process-wide phase-scope level, same contract as TELEMETRY
+#: (--phase-obs; obs/profiler.py phase_scope).  Default off lowers to
+#: byte-identical HLO; --attr mode turns it on per-capture regardless.
+PHASE_OBS = "off"
+
 
 def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
               **kw):
@@ -284,6 +289,7 @@ def _make_cfg(n_chains: int, n_blocks_total: int, block_s: int = BLOCK_S,
         block_impl="auto",      # scan-fused on accelerators
         telemetry=TELEMETRY,
         analytics=ANALYTICS,
+        phase_obs=PHASE_OBS,
     )
     base.update(kw)
     return SimConfig(**base)
@@ -327,7 +333,7 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
                   headline=None, profile=None, slabs=None,
                   device=None, executor=None,
                   precision=None, checkpoint=None,
-                  cost=None, pod=None) -> dict | None:
+                  cost=None, pod=None, attribution=None) -> dict | None:
     """A validated obs RunReport document, embedded ADDITIVELY in a bench
     artifact as ``doc["run_report"]`` (the legacy ad-hoc fields stay —
     battery scripts key richness decisions off them).  Never raises: a
@@ -355,6 +361,8 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
         rep.checkpoint = checkpoint
         rep.cost = cost  # v10 cost-attribution section (obs/cost.py)
         rep.pod = pod  # v14 pod-observability section (obs/pod.py)
+        # v15 phase-attribution section (obs/attribution.py)
+        rep.attribution = attribution
         # every bench artifact records how the backend probe went — the
         # v8 ``probe`` section; None when this path never probed
         rep.probe = _probe_doc()
@@ -364,9 +372,13 @@ def _bench_report(app: str, *, config=None, plan=None, timing=None,
         return None
 
 
-def _config_cost(plan, rate, device_kind) -> dict | None:
+def _config_cost(plan, rate, device_kind,
+                 phase_fractions=None) -> dict | None:
     """Static-model cost doc (obs/cost.py) for a config artifact's
-    resolved plan × measured per-chip rate.  Never raises."""
+    resolved plan × measured per-chip rate.  Never raises.
+    ``phase_fractions`` — measured per-phase device-time shares from a
+    scoped trace (obs/attribution.py), threaded into the v15
+    ``model_error`` phase checks when the basis is measured."""
     try:
         from tmhpvsim_tpu.obs import cost as obs_cost
 
@@ -376,7 +388,8 @@ def _config_cost(plan, rate, device_kind) -> dict | None:
             compute_dtype=p.get("compute_dtype"),
             kernel_impl=p.get("kernel_impl"),
             rng_batch=p.get("rng_batch"),
-            geom_stride=p.get("geom_stride"), device_kind=device_kind)
+            geom_stride=p.get("geom_stride"), device_kind=device_kind,
+            phase_fractions=phase_fractions)
     except Exception as e:
         print(f"# cost doc failed: {e}", file=sys.stderr)
         return None
@@ -1777,6 +1790,124 @@ def profile(out_dir: str) -> None:
         sys.exit(4)
 
 
+#: attribution-bench block length: long enough that the per-minute scan
+#: body gets real weight against the per-block markov window draw (at
+#: 240 s the markov rejection whiles flooded the profiler's 1M-event
+#: cap and the scan body fell off the end of the trace), short enough
+#: that four scoped variants land in a few minutes on the CPU fallback
+ATTR_BLOCK_S = 600
+
+#: the attribution matrix: the all-defaults scan2 baseline plus one
+#: variant per static-v1 lever axis obs/cost.py prices, so every
+#: factor's claimed phase gets checked by a measured diff
+ATTR_BASELINE = "scan2-threefry"
+ATTR_LEVERS = ("scan2-stride60", "scan2-rngblock", "scan2-table")
+
+
+def _attr_capture(name: str, base_dir: str, grid,
+                  n_dispatches: int = 1) -> dict | None:
+    """One variant's scoped capture (engine attribution_capture): a
+    phase_obs='on' sim on the site grid (per-site device geometry — the
+    shared-site path hoists geometry to the host, leaving nothing for
+    geom_stride to move), warm-up compile OUTSIDE the trace, traced
+    dispatches of the SAME compiled executable, the phase map written
+    from that executable's HLO, and the attribution doc.  Returns None
+    on failure — one variant dying must not cost the others' phase
+    splits."""
+    from tmhpvsim_tpu.engine import Simulation
+
+    kw = {k: v for k, v in VARIANT_CFGS[name].items() if k != "_probe"}
+    d = os.path.join(base_dir, name)
+    try:
+        sim = Simulation(_make_cfg(len(grid), 3, block_s=ATTR_BLOCK_S,
+                                   site_grid=grid, phase_obs="on", **kw))
+        doc, stats = sim.attribution_capture(d, n_dispatches=n_dispatches)
+        rate = (len(grid) * ATTR_BLOCK_S * stats["n_dispatches"]
+                / stats["traced_wall_s"])
+        return {"sim": sim, "compile_s": stats["compile_s"],
+                "steady_s": stats["traced_wall_s"],
+                "rate": rate, "attribution": doc}
+    except Exception as e:
+        print(f"# attr variant {name} failed: {e}", file=sys.stderr)
+        return None
+
+
+def attribution_bench(out_dir: str) -> None:
+    """Semantic phase attribution over the priced lever matrix.
+
+    For the all-defaults scan2 baseline and one variant per static-v1
+    lever axis (ATTR_LEVERS), capture a short phase-scoped device
+    trace, split device time across the semantic phases
+    (obs/attribution.py), and emit per-lever diffs against the
+    baseline — "scan2-stride60 cut geometry share from X% to Y%".
+    The artifact embeds a v15 run_report whose ``attribution`` section
+    is the baseline's phase split and whose ``cost.model_error``
+    factor rows (when the basis is measured) carry the measured share
+    of each axis's claimed phase."""
+    import jax
+
+    from tmhpvsim_tpu.config import SiteGrid
+    from tmhpvsim_tpu.obs import attribution
+
+    platform, fallback = _probe_or_fallback()
+    # CPU traces emit one event per while-body thunk per iteration, so
+    # the shape must stay under the profiler's 1M-event cap; TPU traces
+    # are far sparser and afford the full-width grid + a second dispatch
+    side, n_disp = (64, 2) if platform == "tpu" else (8, 1)
+    grid = SiteGrid.regular((45.0, 55.0), (5.0, 15.0), side, side)
+    results = {}
+    for name in (ATTR_BASELINE,) + ATTR_LEVERS:
+        r = _attr_capture(name, out_dir, grid, n_dispatches=n_disp)
+        if r is not None:
+            results[name] = r
+            a = r["attribution"]
+            _persist_partial({
+                "phase": "attr", "variant": name, "platform": platform,
+                "rate": round(r["rate"], 1),
+                "basis": a.get("basis") if a else None,
+            })
+    doc = {
+        "artifact": "phase attribution", "dir": out_dir,
+        "platform": platform, "n_sites": len(grid),
+        "block_s": ATTR_BLOCK_S, "baseline": ATTR_BASELINE,
+        "variants": {}, "diffs": {}, "notes": [],
+    }
+    base = results.get(ATTR_BASELINE)
+    base_attr = base["attribution"] if base else None
+    for name, r in results.items():
+        a = r["attribution"]
+        doc["variants"][name] = {
+            "rate": round(r["rate"], 1),
+            "compile_s": round(r["compile_s"], 1),
+            "attribution": a,
+        }
+        if name == ATTR_BASELINE or a is None or base_attr is None:
+            continue
+        diff = attribution.diff_attribution(base_attr, a)
+        if diff is not None:
+            doc["diffs"][name] = diff
+            doc["notes"].extend(
+                attribution.describe_diff(name, diff, min_delta=0.005))
+    if base is not None:
+        sim = base["sim"]
+        fracs = attribution.phase_fractions(base_attr)
+        doc["run_report"] = _bench_report(
+            "bench.attribution", config=sim.config,
+            plan=_plan_doc(sim.plan),
+            timing=_bench_timing(base["compile_s"], base["steady_s"], 2,
+                                 base["rate"]),
+            headline={"site_seconds_per_s": round(base["rate"], 1),
+                      "baseline": ATTR_BASELINE},
+            cost=_config_cost(sim.plan, base["rate"],
+                              jax.devices()[0].device_kind,
+                              phase_fractions=fracs),
+            attribution=base_attr,
+        )
+    print(json.dumps(doc), flush=True)
+    for note in doc["notes"]:
+        print(f"# {note}", file=sys.stderr)
+
+
 def repro(k: int) -> None:
     """Compile-variance probe: run the headline config (scan-threefry,
     N_CHAINS x BLOCK_S, default unroll) K times, each in a FRESH
@@ -2207,6 +2338,13 @@ def main() -> None:
     ap.add_argument("--scaling", action="store_true")
     ap.add_argument("--sweep", action="store_true")
     ap.add_argument("--profile", metavar="DIR")
+    ap.add_argument("--attr", metavar="DIR",
+                    help="semantic phase attribution: short phase-scoped "
+                         "traces (SimConfig.phase_obs) of the scan2 "
+                         "baseline + one variant per priced lever axis, "
+                         "per-phase device-time split and per-lever diffs "
+                         "vs baseline (obs/attribution.py); traces and "
+                         "phase maps land under DIR")
     ap.add_argument("--repro", type=int, metavar="K",
                     help="distribution mode: K fresh-process timed runs "
                          "of the headline variant, one seed per run; "
@@ -2242,6 +2380,13 @@ def main() -> None:
                     help="on-device fleet-analytics level for every config "
                          "this invocation runs (obs/analytics.py; default "
                          "off keeps the headline hot path untouched)")
+    ap.add_argument("--phase-obs", choices=["off", "on"], default="off",
+                    help="semantic phase scopes (obs/profiler.py "
+                         "phase_scope) in every config this invocation "
+                         "runs, so any device trace it captures is "
+                         "attributable per phase; default off lowers to "
+                         "byte-identical HLO.  --attr turns scopes on for "
+                         "its own captures regardless")
     ap.add_argument("--compile-cache", metavar="DIR", default=None,
                     help="persistent XLA compilation-cache base dir (a "
                          "per-device-kind subdir is created under it; "
@@ -2265,9 +2410,10 @@ def main() -> None:
                     help="with --hosts: scenario-axis width of the 2-D "
                          "(chains, scenario) mesh (0 = flat 1-D mesh)")
     args = ap.parse_args()
-    global TELEMETRY, ANALYTICS, ASSUME_TPU
+    global TELEMETRY, ANALYTICS, PHASE_OBS, ASSUME_TPU
     TELEMETRY = args.telemetry
     ANALYTICS = args.analytics
+    PHASE_OBS = args.phase_obs
     ASSUME_TPU = args.assume_tpu
     # default ON: every mode after the first run starts cache-warm, and
     # the v4 run_report executor section records warm vs cold compiles.
@@ -2284,6 +2430,8 @@ def main() -> None:
         sweep()
     elif args.profile:
         profile(args.profile)
+    elif args.attr:
+        attribution_bench(args.attr)
     elif args.repro is not None:
         repro(args.repro)
     elif args.one_variant:

@@ -173,6 +173,20 @@ if [ "$prof_rc" -eq 4 ]; then
 elif [ "$prof_rc" -ne 0 ]; then
   echo "--- profile: failed rc=$prof_rc" >> "$LOG"
 fi
+# phase attribution (non-fatal): short phase-scoped traces of the
+# all-defaults scan2 baseline plus one variant per static-v1 lever
+# axis; the doc carries per-phase device-time fractions, the
+# per-lever attribution diffs, and a v15 run_report whose cost
+# model_error rows gain measured_phase_frac.  Traces + phase maps
+# land under benchmarks/attr_r05/ (gitignored trace payloads); the
+# JSON doc is the committed evidence.
+echo "--- attr start $(date -u +%FT%TZ)" >> "$LOG"
+if python bench.py --attr benchmarks/attr_r05 \
+     > benchmarks/ATTR_r05.json.tmp 2>> "$LOG"; then
+  mv benchmarks/ATTR_r05.json.tmp benchmarks/ATTR_r05.json
+else
+  echo "--- attr: failed rc=$?" >> "$LOG"
+fi
 # sweep late: the tuning matrix is the committed evidence for the
 # fast-regime point (take 1's 13 TPU entries lived only in the
 # gitignored journal and died with the checkout) and now includes the
@@ -301,6 +315,19 @@ for bench_doc in benchmarks/HEADLINE_*.json benchmarks/SERVE_*.json \
   echo "--- pod_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
   python tools/pod_report.py "$bench_doc" >> "$LOG" 2>&1 \
     || echo "--- pod_report: MALFORMED POD SECTION $bench_doc rc=$?" >> "$LOG"
+done
+# attribution sanity (non-fatal), same contract: any doc carrying a v15
+# 'attribution' section (obs/attribution.py attribute — per-phase
+# device seconds/fractions from the scoped trace, basis, unattributed
+# residual) must carry a WELL-FORMED one, including the --attr doc's
+# per-variant sections; pre-v15 or phase_obs-off docs just note the
+# absence.  Catches a capture whose trace-to-HLO join silently broke.
+for bench_doc in benchmarks/ATTR_*.json benchmarks/HEADLINE_*.json \
+                 benchmarks/BENCH_*.json; do
+  [ -f "$bench_doc" ] || continue
+  echo "--- attr_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
+  python tools/attr_report.py "$bench_doc" >> "$LOG" 2>&1 \
+    || echo "--- attr_report: MALFORMED ATTRIBUTION SECTION $bench_doc rc=$?" >> "$LOG"
 done
 echo "=== battery-2 done $(date -u +%FT%TZ)" >> "$LOG"
 touch benchmarks/BATTERY_DONE

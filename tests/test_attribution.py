@@ -1,0 +1,577 @@
+"""Semantic phase attribution (obs/attribution.py + the v15 report
+section):
+
+* scope-path and op-name phase classification, including the
+  transform-wrapped paths (``vmap(ph__markov)/while/body/...``) real
+  scanned graphs produce;
+* ``parse_hlo_phase_map`` — metadata extraction from compiled-HLO text,
+  fusion majority inheritance, the computation-unanimity rule for
+  unscoped plumbing, and the mixed-computation honesty carve-out;
+* ``attribute`` on synthetic Chrome-trace fixtures — gzip'd and plain
+  exports, scoped joins via the ``phase_map.json`` sidecar, mixed
+  XLA/host threads, the container-op exclusion, the
+  fractions-sum-plus-residual-≤-1 invariant, and the graceful
+  degradation ladder (scope → opname-heuristic → unavailable+WARN);
+* lever diffs (``diff_attribution`` / ``describe_diff``);
+* ``validate_attribution_section`` shape rules and the report v15
+  round-trip;
+* the cost model's v15 phase checks (``model_error`` factor rows gain
+  ``phases`` + ``measured_phase_frac``);
+* HLO byte-identity: ``phase_obs`` off vs default (and on — the scopes
+  live in location metadata, not the lowered text) for scan and scan2,
+  with the compiled text carrying ``ph__`` metadata only when on;
+* the CPU end-to-end capture: ``Simulation.attribution_capture`` on a
+  device-geometry site grid yields a ``basis: "scope"`` split whose
+  geometry share strictly drops under ``geom_stride=60``;
+* the tools: ``attr_report.py`` validation/degradation and
+  ``bench_trend.py``'s ``phases`` column + ``fallback`` marker.
+"""
+
+import gzip
+import json
+import logging
+import pathlib
+import sys
+
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig, SiteGrid
+from tmhpvsim_tpu.engine import Simulation
+from tmhpvsim_tpu.obs import attribution as attr
+from tmhpvsim_tpu.obs import cost as obs_cost
+from tmhpvsim_tpu.obs.attribution import (
+    PHASES,
+    attribute,
+    describe_diff,
+    diff_attribution,
+    parse_hlo_phase_map,
+    phase_fractions,
+    phase_of_op_name,
+    phase_of_scope_path,
+    read_phase_map,
+    validate_attribution_section,
+    write_phase_map,
+)
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry
+from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, validate_report
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def scfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=120,
+        n_chains=4,
+        seed=7,
+        block_s=60,
+        dtype="float32",
+        output="reduce",
+        block_impl="scan",
+        scan_unroll=1,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# phase classification
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseClassification:
+    def test_plain_scope_path(self):
+        assert phase_of_scope_path(
+            "jit(f)/jit(main)/ph__geometry/sin") == "geometry"
+
+    def test_transform_wrapped_scope(self):
+        """Under vmap/while the scope name is wrapped in the transform
+        component — substring matching, not path components."""
+        assert phase_of_scope_path(
+            "jit(f)/jit(main)/vmap(ph__markov)/while/body/add") == "markov"
+
+    def test_innermost_scope_wins(self):
+        assert phase_of_scope_path(
+            "jit(f)/ph__physics/vmap(ph__rng)/mul") == "rng"
+
+    def test_no_scope_is_none(self):
+        assert phase_of_scope_path("jit(f)/jit(main)/while/body/add") is None
+
+    def test_op_name_heuristics(self):
+        assert phase_of_op_name("all-reduce.1") == "collectives"
+        assert phase_of_op_name("reduce-scatter.2") == "collectives"
+        assert phase_of_op_name("threefry2x32.7") == "rng"
+        assert phase_of_op_name("fusion.3") is None
+
+
+# ---------------------------------------------------------------------------
+# parse_hlo_phase_map
+# ---------------------------------------------------------------------------
+
+
+_HLO_TEXT = """\
+HloModule jit_step
+
+%markov_body (p.0: f32[]) -> f32[] {
+  %p.0 = f32[] parameter(0)
+  %add.1 = f32[] add(%p.0, %p.0), metadata={op_name="jit(f)/vmap(ph__markov)/while/body/add"}
+  %mul.2 = f32[] multiply(%add.1, %add.1), metadata={op_name="jit(f)/vmap(ph__markov)/while/body/mul"}
+  ROOT %copy.3 = f32[] copy(%mul.2)
+}
+
+%mixed_body (p.1: f32[]) -> f32[] {
+  %p.1 = f32[] parameter(0)
+  %sine.4 = f32[] sine(%p.1), metadata={op_name="jit(f)/ph__geometry/sin"}
+  %exp.5 = f32[] exponential(%sine.4), metadata={op_name="jit(f)/ph__physics/exp"}
+  ROOT %copy.6 = f32[] copy(%exp.5)
+}
+
+%geom_comp (p.2: f32[]) -> f32[] {
+  %p.2 = f32[] parameter(0)
+  %cosine.7 = f32[] cosine(%p.2), metadata={op_name="jit(f)/ph__geometry/cos"}
+  ROOT %tan.8 = f32[] tan(%cosine.7), metadata={op_name="jit(f)/ph__geometry/tan"}
+}
+
+ENTRY %main (arg.0: f32[]) -> f32[] {
+  %arg.0 = f32[] parameter(0)
+  %fusion.9 = f32[] fusion(%arg.0), kind=kLoop, calls=%geom_comp
+  %add.10 = f32[] add(%fusion.9, %fusion.9), metadata={op_name="jit(f)/ph__rng/threefry"}
+  ROOT %convert.11 = f32[] convert(%add.10)
+}
+"""
+
+
+class TestParseHloPhaseMap:
+    def test_scoped_instructions_and_unanimity_inheritance(self):
+        pm = parse_hlo_phase_map(_HLO_TEXT)
+        assert pm["add.1"] == "markov"
+        assert pm["mul.2"] == "markov"
+        # unanimity rule: the unscoped while-body carry copy inherits
+        # the computation's single phase (the >60%-of-device-time class)
+        assert pm["copy.3"] == "markov"
+        # parameters never inherit
+        assert "p.0" not in pm and "arg.0" not in pm
+
+    def test_mixed_computation_plumbing_stays_unattributed(self):
+        pm = parse_hlo_phase_map(_HLO_TEXT)
+        assert pm["sine.4"] == "geometry"
+        assert pm["exp.5"] == "physics"
+        assert "copy.6" not in pm  # mixed phases: no inheritance
+        # ENTRY is mixed too (rng + inherited geometry): no inheritance
+        assert "convert.11" not in pm
+
+    def test_fusion_inherits_called_computation_majority(self):
+        pm = parse_hlo_phase_map(_HLO_TEXT)
+        assert pm["fusion.9"] == "geometry"
+        assert pm["add.10"] == "rng"
+
+    def test_sidecar_round_trip(self, tmp_path):
+        merged = write_phase_map(str(tmp_path), [_HLO_TEXT])
+        assert read_phase_map(str(tmp_path)) == merged
+        assert read_phase_map(str(tmp_path / "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# attribute: trace fixtures
+# ---------------------------------------------------------------------------
+
+
+def _write_trace_gz(log_dir, events, host="host0"):
+    d = log_dir / "plugins" / "profile" / "2026_08_07"
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / f"{host}.trace.json.gz"
+    with gzip.open(path, "wt", encoding="utf-8") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _write_trace_plain(log_dir, events, name="extra.trace.json"):
+    path = log_dir / name
+    path.write_text(json.dumps({"traceEvents": events}))
+    return path
+
+
+def _xla_thread_meta(pid=1, tid=2):
+    return [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "python3"}},
+        {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient-0"}},
+    ]
+
+
+def _op(name, dur, ts=0, hlo_op=None, pid=1, tid=2):
+    ev = {"ph": "X", "pid": pid, "tid": tid, "ts": ts, "dur": dur,
+          "name": name}
+    if hlo_op:
+        ev["args"] = {"hlo_op": hlo_op}
+    return ev
+
+
+class TestAttribute:
+    def test_scoped_join_mixed_gzip_and_plain(self, tmp_path, caplog):
+        """gzip + plain exports in one dir, scoped joins by hlo_op and by
+        event name, host threads ignored, container ops excluded, and
+        the fractions-sum invariant."""
+        write_phase_map(str(tmp_path), [_HLO_TEXT])
+        _write_trace_gz(tmp_path, _xla_thread_meta() + [
+            # joined via args.hlo_op (TPU-style export)
+            _op("fusion", 400, hlo_op="fusion.9"),           # geometry
+            # joined via the event name itself (CPU-style export)
+            _op("add.1", 300, ts=400),                       # markov
+            # a while container re-spans its body: excluded, not counted
+            _op("while", 9999, hlo_op="while.77"),
+            # host thread: ignored wholesale
+            _op("add.1", 5000, tid=9),
+        ])
+        _write_trace_plain(tmp_path, _xla_thread_meta(pid=3, tid=4) + [
+            _op("copy.3", 200, pid=3, tid=4),                # markov (inherited)
+            _op("convert.99", 100, pid=3, tid=4),            # residual
+        ])
+        with caplog.at_level(logging.WARNING):
+            out = attribute(str(tmp_path))
+        assert out is not None and out["basis"] == "scope"
+        assert out["n_events"] == 4
+        assert out["total_device_s"] == pytest.approx(1000e-6)
+        assert out["phases"]["markov"]["seconds"] == pytest.approx(500e-6)
+        assert out["phases"]["geometry"]["frac"] == pytest.approx(0.4)
+        assert out["unattributed_frac"] == pytest.approx(0.1)
+        fr = sum(p["frac"] for p in out["phases"].values())
+        assert fr + out["unattributed_frac"] == pytest.approx(1.0, abs=1e-4)
+        assert validate_attribution_section(out) == []
+        assert not caplog.records  # a scoped join warns about nothing
+
+    def test_no_map_degrades_to_opname_heuristic(self, tmp_path):
+        _write_trace_gz(tmp_path, _xla_thread_meta() + [
+            _op("threefry2x32.1", 250),
+            _op("all-reduce.2", 250, ts=250),
+            _op("fusion.3", 500, ts=500),
+        ])
+        out = attribute(str(tmp_path))
+        assert out["basis"] == "opname-heuristic"
+        assert out["phases"]["rng"]["frac"] == pytest.approx(0.25)
+        assert out["phases"]["collectives"]["frac"] == pytest.approx(0.25)
+        assert out["unattributed_frac"] == pytest.approx(0.5)
+        assert validate_attribution_section(out) == []
+
+    def test_nothing_attributable_is_unavailable_with_warn(
+            self, tmp_path, caplog):
+        """Scope-less trace of unrecognisable ops: basis 'unavailable',
+        one rate-limited WARN, never an exception — and the section
+        still validates (satellite: graceful degrade)."""
+        _write_trace_gz(tmp_path, _xla_thread_meta() + [
+            _op("fusion.1", 600),
+            _op("convert.2", 400, ts=600),
+        ])
+        attr._last_warn[0] = -1e9  # reset the rate limiter
+        with caplog.at_level(logging.WARNING,
+                             logger="tmhpvsim_tpu.obs.attribution"):
+            out = attribute(str(tmp_path))
+        assert out["basis"] == "unavailable"
+        assert out["phases"] == {}
+        assert out["unattributed_frac"] == pytest.approx(1.0)
+        assert validate_attribution_section(out) == []
+        warns = [r for r in caplog.records
+                 if "attribution unavailable" in r.getMessage()]
+        assert len(warns) == 1
+        # rate-limited: an immediate second call stays quiet
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="tmhpvsim_tpu.obs.attribution"):
+            attribute(str(tmp_path))
+        assert not [r for r in caplog.records
+                    if "attribution unavailable" in r.getMessage()]
+        # and phase_fractions refuses to feed it downstream
+        assert phase_fractions(out) is None
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert attribute(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# lever diffs
+# ---------------------------------------------------------------------------
+
+
+def _attr_doc(fracs, basis="scope"):
+    total = 1.0
+    phases = {n: {"seconds": f, "frac": f} for n, f in fracs.items()}
+    resid = round(total - sum(fracs.values()), 6)
+    return {"schema_version": 1, "basis": basis, "total_device_s": total,
+            "n_events": 10, "n_scope_events": 8, "phases": phases,
+            "unattributed_s": resid, "unattributed_frac": resid}
+
+
+class TestDiff:
+    def test_diff_and_describe(self):
+        base = _attr_doc({"geometry": 0.3, "markov": 0.5})
+        variant = _attr_doc({"geometry": 0.05, "markov": 0.7})
+        d = diff_attribution(base, variant)
+        assert d["basis"] == "scope"
+        assert d["phases"]["geometry"]["delta_frac"] == pytest.approx(-0.25)
+        lines = describe_diff("stride60", d, min_delta=0.01)
+        assert any("stride60 cut geometry share from 30.0% to 5.0%" in ln
+                   for ln in lines)
+        assert any("raised markov" in ln for ln in lines)
+
+    def test_unavailable_side_kills_the_diff(self):
+        base = _attr_doc({"geometry": 0.3})
+        assert diff_attribution(base, None) is None
+        assert diff_attribution(
+            base, _attr_doc({}, basis="unavailable")) is None
+        assert describe_diff("x", None) == []
+
+
+# ---------------------------------------------------------------------------
+# validate_attribution_section
+# ---------------------------------------------------------------------------
+
+
+class TestValidateSection:
+    def test_valid_passes(self):
+        assert validate_attribution_section(
+            _attr_doc({"rng": 0.2, "physics": 0.7})) == []
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda s: s.update(basis="vibes"), "basis"),
+        (lambda s: s.update(total_device_s=-1), "total_device_s"),
+        (lambda s: s.update(n_events=1.5), "n_events"),
+        (lambda s: s.update(phases="x"), "phases"),
+        (lambda s: s["phases"]["rng"].update(frac=1.5), "> 1"),
+        (lambda s: s.update(unattributed_frac=0.9), "sum to"),
+    ])
+    def test_mutations_are_caught(self, mutate, needle):
+        sec = _attr_doc({"rng": 0.2, "physics": 0.7})
+        mutate(sec)
+        errs = validate_attribution_section(sec)
+        assert errs and any(needle in e for e in errs), errs
+
+    def test_not_a_dict(self):
+        errs = validate_attribution_section([1, 2])
+        assert len(errs) == 1 and "expected dict" in errs[0]
+
+
+# ---------------------------------------------------------------------------
+# RunReport v15 round-trip + cost phase checks
+# ---------------------------------------------------------------------------
+
+
+class TestReportV15:
+    def test_attribution_round_trips(self):
+        sim = Simulation(scfg())
+        sim.run_reduced()
+        doc = sim.run_report()
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["attribution"] is None  # no capture ran
+        doc["attribution"] = _attr_doc({"markov": 0.6, "physics": 0.3})
+        validate_report(json.loads(json.dumps(doc)))
+
+    def test_malformed_attribution_is_refused(self):
+        sim = Simulation(scfg())
+        sim.run_reduced()
+        doc = sim.run_report()
+        doc["attribution"] = {"basis": "vibes", "phases": {}}
+        with pytest.raises(ValueError, match="attribution"):
+            validate_report(doc)
+
+    def test_cost_model_error_phase_checks(self):
+        doc = obs_cost.cost_doc(site_s_per_s=1e6, block_impl="scan")
+        me = obs_cost.model_error_doc(
+            doc, doc["flops_per_site_s"] * 1.5, None,
+            phase_fractions={"geometry": 0.3, "rng": 0.1,
+                             "physics": 0.4, "csi": 0.05})
+        gs = me["factors"]["geom_stride"]
+        assert gs["phases"] == ["geometry"]
+        assert gs["measured_phase_frac"] == pytest.approx(0.3)
+        cd = me["factors"]["compute_dtype"]
+        assert set(cd["phases"]) == {"physics", "csi"}
+        assert cd["measured_phase_frac"] == pytest.approx(0.45)
+        assert me["factors"]["block_impl"]["phases"] == []
+        # the keys are optional: a v14-style call still validates
+        plain = obs_cost.model_error_doc(doc, doc["flops_per_site_s"], None)
+        assert "phases" not in plain["factors"]["geom_stride"]
+        doc["model_error"] = me
+        assert obs_cost.validate_cost(doc) == [], obs_cost.validate_cost(doc)
+
+    def test_publish_phase_gauges(self):
+        reg = MetricsRegistry()
+        attr.publish_phase_gauges(reg, _attr_doc({"markov": 0.6}))
+        text = reg.openmetrics_text()
+        assert "device_phase_markov_frac 0.6" in text
+        # unavailable docs publish nothing
+        reg2 = MetricsRegistry()
+        attr.publish_phase_gauges(reg2, _attr_doc({}, basis="unavailable"))
+        assert "device_phase" not in reg2.openmetrics_text()
+
+
+# ---------------------------------------------------------------------------
+# HLO byte-identity + compiled-metadata sanity
+# ---------------------------------------------------------------------------
+
+
+class TestHLOIdentity:
+    @pytest.mark.parametrize("impl", ["scan", "scan2"])
+    def test_lowered_identical_off_vs_default_vs_on(self, impl):
+        """phase_obs must cost nothing off (the acceptance bar), and the
+        scopes live in location metadata — so even on, the lowered
+        TEXT is unchanged; only the compiled module's op_name metadata
+        differs (next test)."""
+
+        def lowered(**kw) -> str:
+            sim = Simulation(scfg(block_impl=impl, **kw))
+            state = sim.init_state()
+            acc = sim.init_reduce_acc()
+            inputs, _ = sim.host_inputs(0)
+            jit = (sim._scan_acc_jit if impl == "scan"
+                   else sim._scan2_acc_jit)
+            return jit.lower(state, inputs, acc).as_text()
+
+        off = lowered(phase_obs="off")
+        assert lowered() == off
+        assert lowered(phase_obs="on") == off
+
+    def test_scopes_reach_compiled_metadata_only_when_on(self):
+        import jax
+
+        from tmhpvsim_tpu.engine import compilecache
+
+        # the persistent XLA cache's key ignores location metadata, so a
+        # warm cache would serve a scope-free executable for the
+        # byte-identical "on" program (and vice versa) — compile both
+        # uncached.  A bare config update is not enough: jax memoises
+        # the is-cache-used decision and the live cache object per
+        # process, so the singleton must be reset too.  configure("off")
+        # additionally stops Simulation AOT warm-up from seeding the
+        # cache; the conftest isolation fixture restores all of it.
+        compilecache.configure("off")
+        jax.config.update("jax_compilation_cache_dir", None)
+        compilecache._reset_cache_singleton()
+        on = "".join(Simulation(
+            scfg(block_impl="scan2",
+                 phase_obs="on")).attribution_hlo_texts())
+        off = "".join(Simulation(
+            scfg(block_impl="scan2")).attribution_hlo_texts())
+        assert "ph__" in on and "ph__" not in off
+        pm = parse_hlo_phase_map(on)
+        assert pm and set(pm.values()) <= set(PHASES)
+        assert {"rng", "markov", "csi", "physics"} <= set(pm.values())
+
+
+# ---------------------------------------------------------------------------
+# CPU end-to-end capture + geom_stride lever diff
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureEndToEnd:
+    def test_scoped_capture_and_stride_cuts_geometry(self, tmp_path):
+        """The full protocol on a device-geometry site grid: AOT-compile,
+        trace the same executables, join — basis 'scope', bounded
+        residual — then the geom_stride=60 variant's geometry share
+        strictly drops (the acceptance-criteria diff)."""
+        grid = SiteGrid.regular((45.0, 55.0), (5.0, 15.0), 2, 2)
+
+        def capture(sub, **kw):
+            cfg = scfg(duration_s=240, block_s=120, block_impl="scan2",
+                       site_grid=grid, phase_obs="on", **kw)
+            sim = Simulation(cfg)
+            doc, stats = sim.attribution_capture(str(tmp_path / sub),
+                                                 n_dispatches=1)
+            assert stats["n_dispatches"] == 1
+            return doc
+
+        base = capture("base")
+        assert base is not None and base["basis"] == "scope"
+        fr = sum(p["frac"] for p in base["phases"].values())
+        assert fr + base["unattributed_frac"] <= 1 + 1e-6
+        assert base["unattributed_frac"] <= 0.5  # bounded residual
+        assert validate_attribution_section(base) == []
+
+        strided = capture("stride", geom_stride=60)
+        bf, vf = phase_fractions(base), phase_fractions(strided)
+        assert bf.get("geometry", 0.0) > 0.01  # device geometry is real
+        assert vf.get("geometry", 0.0) < bf["geometry"]
+        d = diff_attribution(base, strided)
+        assert d["basis"] == "scope"
+        assert d["phases"]["geometry"]["delta_frac"] < 0
+
+
+# ---------------------------------------------------------------------------
+# tools: attr_report + bench_trend columns
+# ---------------------------------------------------------------------------
+
+
+class TestAttrReportTool:
+    def _report_doc(self, sec):
+        return {"kind": "tmhpvsim_tpu.run_report",
+                "schema_version": 15, "attribution": sec}
+
+    def test_valid_sections_print_and_pass(self, tmp_path, capsys):
+        import attr_report
+        p = tmp_path / "rep.json"
+        p.write_text(json.dumps(self._report_doc(
+            _attr_doc({"markov": 0.6, "physics": 0.3}))))
+        assert attr_report.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "attribution scope" in out and "markov 60.0%" in out
+
+    def test_attr_artifact_variants_are_checked(self, tmp_path, capsys):
+        import attr_report
+        doc = {"artifact": "phase attribution", "baseline": "b",
+               "variants": {"b": {"attribution": _attr_doc({"rng": 0.9})}}}
+        p = tmp_path / "attr.json"
+        p.write_text(json.dumps(doc))
+        assert attr_report.main([str(p)]) == 0
+        assert "[b]" in capsys.readouterr().out
+
+    def test_absent_section_is_fine(self, tmp_path, capsys):
+        import attr_report
+        p = tmp_path / "old.json"
+        p.write_text(json.dumps({"value": 1.0, "platform": "tpu"}))
+        assert attr_report.main([str(p)]) == 0
+        assert "no attribution section" in capsys.readouterr().out
+
+    def test_malformed_section_fails(self, tmp_path):
+        import attr_report
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps(self._report_doc(
+            {"basis": "vibes", "phases": {}})))
+        assert attr_report.main([str(p)]) == 1
+
+
+class TestBenchTrendColumns:
+    def test_fallback_marker(self, tmp_path):
+        import bench_trend
+        p = tmp_path / "fb.json"
+        p.write_text(json.dumps({
+            "value": 1.0, "platform": "cpu-fallback",
+            "salvaged_after_tpu_failure": True}))
+        row = bench_trend.normalize(str(p))
+        assert row["fallback"] is True
+        assert row["note"].startswith("fallback")
+        # a real TPU doc carries no marker
+        p2 = tmp_path / "tpu.json"
+        p2.write_text(json.dumps({"value": 2.0, "platform": "tpu"}))
+        row2 = bench_trend.normalize(str(p2))
+        assert row2["fallback"] is False and "note" not in row2
+
+    def test_phases_column_from_attribution(self, tmp_path):
+        import bench_trend
+        sec = _attr_doc({"markov": 0.48, "physics": 0.34})
+        p = tmp_path / "attr.json"
+        p.write_text(json.dumps({
+            "value": 1.0, "platform": "cpu", "baseline": "b",
+            "variants": {"b": {"attribution": sec, "rate": 1.0}}}))
+        row = bench_trend.normalize(str(p))
+        assert row["attr"] == "markov:48%"
+        # pre-v15 docs render '-' (attr None)
+        p2 = tmp_path / "old.json"
+        p2.write_text(json.dumps({"value": 1.0, "platform": "tpu"}))
+        assert bench_trend.normalize(str(p2))["attr"] is None
+        # unavailable basis never fills the column
+        p3 = tmp_path / "unavail.json"
+        p3.write_text(json.dumps({
+            "value": 1.0, "platform": "cpu", "baseline": "b",
+            "variants": {"b": {"attribution":
+                               _attr_doc({}, basis="unavailable")}}}))
+        assert bench_trend.normalize(str(p3))["attr"] is None

@@ -237,7 +237,7 @@ class TestSigkillWarmRecovery:
         assert part.read_bytes() == whole.read_bytes()
 
         doc = validate_report(json.loads(report.read_text()))
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 14
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
         res = doc["resilience"]
         assert res["resumes"] == 1
         assert res["restarts"] == 1

@@ -361,7 +361,7 @@ class TestFederation:
 _SECTION_SINCE = {
     "telemetry": 2, "streaming": 3, "executor": 4, "fleet": 5,
     "serving": 6, "resilience": 7, "precision": 8, "probe": 8,
-    "cost": 10, "mesh": 13, "pod": 14,
+    "cost": 10, "mesh": 13, "pod": 14, "attribution": 15,
 }
 
 
@@ -373,7 +373,7 @@ class TestReportV14:
 
     def test_engine_attaches_pod_section(self):
         doc = self._run_doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 14
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
         pod = doc["pod"]
         assert pod is not None
         assert validate_pod_section(pod) == [], validate_pod_section(pod)

@@ -613,7 +613,7 @@ class TestServingReport:
         rep = RunReport("pvsim.serve")
         rep.attach_metrics(_serving_registry())
         doc = rep.doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 14
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
         validate_report(doc)
         doc2 = json.loads(json.dumps(doc))
         validate_report(doc2)

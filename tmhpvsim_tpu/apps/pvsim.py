@@ -443,7 +443,8 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
               obs_port: Optional[int] = None,
               obs_bind: str = "127.0.0.1",
               pod_obs: str = "off",
-              pod_straggler_factor: float = 2.0) -> None:
+              pod_straggler_factor: float = 2.0,
+              phase_obs: str = "off") -> None:
     """The JAX backend: blockwise device simulation straight to CSV.
 
     With ``checkpoint``, state is saved after every block and an existing
@@ -570,6 +571,7 @@ def pvsim_jax(file, duration_s: int, n_chains: int, seed: int,
                 preempt_grace_s=preempt_grace_s,
                 pod_obs=pod_obs,
                 pod_straggler_factor=pod_straggler_factor,
+                phase_obs=phase_obs,
                 ready_state=ready_state,
             )
         except (Exception, KeyboardInterrupt):
@@ -674,6 +676,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
                    preempt_grace_s: float = 0.0,
                    pod_obs: str = "off",
                    pod_straggler_factor: float = 2.0,
+                   phase_obs: str = "off",
                    ready_state: Optional[dict] = None):
     """The run body behind :func:`pvsim_jax`; returns the Simulation so
     the wrapper can assemble the run report from its config/plan/timer.
@@ -782,6 +785,7 @@ def _pvsim_jax_run(file, duration_s: int, n_chains: int, seed: int,
         mesh_scenario=mesh_scenario,
         pod_obs=pod_obs,
         pod_straggler_factor=pod_straggler_factor,
+        phase_obs=phase_obs,
     )
     if sharded:
         from tmhpvsim_tpu.parallel import ShardedSimulation

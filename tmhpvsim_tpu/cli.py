@@ -445,6 +445,14 @@ def metersim(amqp_url, exchange, verbose, realtime, seed, duration_s, start,
               help="Straggler threshold for --pod-obs: a host whose block "
                    "wall exceeds the pod median by this factor is flagged "
                    "(config.SimConfig.pod_straggler_factor)")
+@click.option("--phase-obs", "phase_obs", type=click.Choice(["off", "on"]),
+              default="off", show_default=True,
+              help="Semantic phase scopes (jax backend): wrap the block "
+                   "step's stages (rng, markov, csi, geometry, physics, "
+                   "...) in jax.named_scope frames so any device trace "
+                   "captured with --profile is attributable per phase "
+                   "(obs/attribution.py; RunReport 'attribution' "
+                   "section).  off lowers to byte-identical HLO")
 @click.option("--supervise", "supervise", type=int, default=0,
               metavar="N",
               help="Run as a supervised child and warm-restart it on a "
@@ -463,7 +471,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
           blocks_per_dispatch, compute_dtype, kernel_impl, rng_batch,
           geom_stride, output_overlap,
           checkpoint_keep, checkpoint_async, preempt_grace,
-          pod_obs, pod_straggler_factor,
+          pod_obs, pod_straggler_factor, phase_obs,
           supervise, obs_port, obs_bind, chaos, chaos_seed):
     """PV simulation + meter join -> CSV (reference pvsim.py:103-121)."""
     _setup_logging(verbose)
@@ -536,6 +544,8 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
         raise click.UsageError("--preempt-grace requires --backend=jax")
     if pod_obs != "off" and backend != "jax":
         raise click.UsageError("--pod-obs requires --backend=jax")
+    if phase_obs != "off" and backend != "jax":
+        raise click.UsageError("--phase-obs requires --backend=jax")
     if pod_straggler_factor <= 0:
         raise click.UsageError("--pod-straggler-factor must be > 0")
     if checkpoint_keep < 1:
@@ -608,6 +618,7 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                   preempt_grace_s=preempt_grace,
                   pod_obs=pod_obs,
                   pod_straggler_factor=pod_straggler_factor,
+                  phase_obs=phase_obs,
                   obs_port=obs_port, obs_bind=obs_bind)
         return
 

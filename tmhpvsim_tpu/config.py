@@ -586,6 +586,20 @@ class SimConfig:
     #: ``pod.straggler_total``)
     pod_straggler_factor: float = 2.0
 
+    #: semantic phase attribution (obs/attribution.py): 'off' (the
+    #: default — no ``jax.named_scope`` entered anywhere, so the lowered
+    #: HLO is byte-identical to a build without the axis, the same
+    #: discipline as telemetry/analytics/pod_obs) or 'on' (the ~9
+    #: semantic stages of the per-second chain — rng, markov, csi,
+    #: geometry, physics, fleet, telemetry, analytics, collectives —
+    #: are traced inside ``ph__<name>`` scopes, which land in every
+    #: HLO op's ``op_name`` metadata; a device trace captured from such
+    #: a build can then be split into per-phase device-time fractions
+    #: and surfaced as the RunReport v15 ``attribution`` section and
+    #: the ``device.phase.*`` gauges).  Purely metadata: numerics and
+    #: op graphs are unchanged either way.
+    phase_obs: str = "off"
+
     #: streaming-trace output path (obs/trace.py): per-block host-side
     #: instants land in the tracer ring and export as Chrome-trace JSON
     #: here on exit.  Pure host-side observability — never enters the

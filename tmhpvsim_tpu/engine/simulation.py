@@ -40,6 +40,7 @@ keyed meter draws -> residual; the only host traffic is the result gather
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import datetime as _dt
 from typing import Iterator
@@ -53,7 +54,7 @@ from tmhpvsim_tpu.data import SANDIA_INVERTER, SAPM_MODULE
 from tmhpvsim_tpu.obs import analytics as flt
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs import telemetry as tel
-from tmhpvsim_tpu.obs.profiler import BlockTimer, annotate
+from tmhpvsim_tpu.obs.profiler import BlockTimer, annotate, phase_scope
 from tmhpvsim_tpu.models import clearsky_index as ci
 from tmhpvsim_tpu.models import markov_hourly as mh
 from tmhpvsim_tpu.models import pv as pvmod
@@ -281,6 +282,13 @@ class Simulation:
             raise ValueError(
                 f"geom_stride {self._geom_stride} must divide "
                 f"block_s {config.block_s}")
+        #: semantic phase scopes (SimConfig.phase_obs, obs/attribution):
+        #: a PER-INSTANCE host-static flag — ``_phase`` consults it at
+        #: trace time, so 'off' enters no ``jax.named_scope`` anywhere
+        #: and the lowered HLO stays byte-identical
+        #: (tests/test_attribution.py), while a module-global flag would
+        #: leak scopes into other sims' lazily-retraced jits
+        self._phase_obs = getattr(config, "phase_obs", "off") != "off"
         # rbg trap (benchmarks/PERF_ANALYSIS.md §7a): rbg/unsafe_rbg
         # keys serialize the vmapped per-chain draws on current TPU
         # backends — a measured ~76x block-step regression vs threefry.
@@ -455,6 +463,11 @@ class Simulation:
         #: lowered HLO is byte-identical (tests/test_pod_obs.py)
         self._pod = None
         self._pod_on = getattr(config, "pod_obs", "off") != "off"
+        #: per-phase device-time split (obs/attribution.py): host-set by
+        #: whoever captured + attributed a scoped trace of this sim
+        #: (bench.py's attribution mode); run_report() embeds it as the
+        #: v15 ``attribution`` section and publishes ``device.phase.*``
+        self.attribution = None
         if not getattr(self, "_defer_warm_start", False):
             self._warm_start()
 
@@ -757,6 +770,18 @@ class Simulation:
     # device block step (jitted once; shapes constant across blocks)
     # ------------------------------------------------------------------
 
+    def _phase(self, name: str):
+        """Semantic-phase scope for trace-time code (obs/attribution):
+        a ``jax.named_scope('ph__<name>')`` when ``phase_obs`` is on,
+        else a nullcontext — the off path enters nothing, so its
+        lowered HLO is byte-identical to a build without the axis.
+        Also passed into the models entry points (solar/pv/
+        clearsky_index ``scope=`` kwarg) so the stages a model owns are
+        scoped where they are computed."""
+        if self._phase_obs:
+            return phase_scope(name)
+        return contextlib.nullcontext()
+
     def _windows_one_chain(self, chain, inputs):
         """Regenerate ONE chain's sampler windows for one block (traced).
 
@@ -775,27 +800,31 @@ class Simulation:
         # heterogeneous weather regimes: gather this chain's Markov step
         # table from the stacked regime leaves (one (R, 6)->(6,) take per
         # leaf under the chain vmap); None traces the historical graph
-        params = (mh.select_regime(self._regime_params,
-                                   chain["fleet"]["regime"])
-                  if self._het_regime else None)
-        cc_w, _ = ci.cc_window(k_cc, win["hour_lo"], self._w_hours,
-                               chain["cc_carry"], cfg.options, dtype,
-                               params=params)
-        nxt, lo = win["hour_next_lo"], win["hour_lo"]
-        adv = jnp.clip(nxt - lo - 1, 0, self._w_hours - 1)
-        cc_carry = jnp.where(nxt == lo, chain["cc_carry"], cc_w[adv])
+        with self._phase("markov"):
+            params = (mh.select_regime(self._regime_params,
+                                       chain["fleet"]["regime"])
+                      if self._het_regime else None)
+            cc_w, _ = ci.cc_window(k_cc, win["hour_lo"], self._w_hours,
+                                   chain["cc_carry"], cfg.options, dtype,
+                                   params=params)
+            nxt, lo = win["hour_next_lo"], win["hour_lo"]
+            adv = jnp.clip(nxt - lo - 1, 0, self._w_hours - 1)
+            cc_carry = jnp.where(nxt == lo, chain["cc_carry"], cc_w[adv])
 
-        arrays = {
-            "cc": cc_w,
-            "cloudy": ci.cloudy_window(k_cloudy, lo, self._w_hours, cc_w,
-                                       lo, chain["cc0"], dtype),
-            "clear_day": ci.clear_day_window(k_day, win["cd_lo"],
-                                             self._w_cd, dtype),
-            "ws": ci.ws_window(k_ws, win["day_lo"], self._w_days, dtype),
-        }
-        mvals = ci.minute_noise_values_device(
-            chain["k_min"], cc_w, inputs["mlo"], inputs["mfeats"], dtype
-        )
+            arrays = {
+                "cc": cc_w,
+                "cloudy": ci.cloudy_window(k_cloudy, lo, self._w_hours,
+                                           cc_w, lo, chain["cc0"], dtype),
+                "clear_day": ci.clear_day_window(k_day, win["cd_lo"],
+                                                 self._w_cd, dtype),
+                "ws": ci.ws_window(k_ws, win["day_lo"], self._w_days,
+                                   dtype),
+            }
+        with self._phase("rng"):
+            mvals = ci.minute_noise_values_device(
+                chain["k_min"], cc_w, inputs["mlo"], inputs["mfeats"],
+                dtype
+            )
         return arrays, mvals, cc_carry
 
     def _narrow_geom(self, geom):
@@ -857,31 +886,33 @@ class Simulation:
                     site["latitude"], site["longitude"], site["altitude"],
                     site["surface_tilt"], site["surface_azimuth"],
                     site["albedo"], turbidity, xp=jnp,
-                    kernels=self._kernels,
+                    kernels=self._kernels, scope=self._phase,
                 )
                 geom = self._narrow_geom(geom)
                 if strided:
                     # sample-grid evaluation above, lerp back to 1 Hz;
                     # doy stays the exact per-second value and the site
                     # scalars ride through (already compute-dtype)
-                    g = solar.interp_sampled(geom, gi, gf, xp=jnp)
+                    g = solar.interp_sampled(geom, gi, gf, xp=jnp,
+                                             scope=self._phase)
                     g["doy"] = jnp.asarray(ts["doy"])
                     g["surface_tilt"] = geom["surface_tilt"]
                     g["albedo"] = geom["albedo"]
                     geom = g
             arrays, mvals, cc_carry = self._windows_one_chain(chain, inputs)
-            carry, csi, _covered = ci.csi_scan_block(
-                chain["k_scan"], arrays, mvals, mlo,
-                chain["carry"], block_idx, cfg.options, dtype,
-                unroll=self._unroll,
-                cloudy_pair=chain["cloudy_pair"],
-                draws=None if pre is None else (pre["u"], pre["z"]),
-            )
-            if self._mixed:
-                csi = csi.astype(self._compute_dtype)
+            with self._phase("csi"):
+                carry, csi, _covered = ci.csi_scan_block(
+                    chain["k_scan"], arrays, mvals, mlo,
+                    chain["carry"], block_idx, cfg.options, dtype,
+                    unroll=self._unroll,
+                    cloudy_pair=chain["cloudy_pair"],
+                    draws=None if pre is None else (pre["u"], pre["z"]),
+                )
+                if self._mixed:
+                    csi = csi.astype(self._compute_dtype)
             ac = pvmod.power_from_csi(
                 csi, geom, SAPM_MODULE, SANDIA_INVERTER, xp=jnp,
-                kernels=self._kernels,
+                kernels=self._kernels, scope=self._phase,
             )
             if self._mixed:
                 # back to the carry/accumulator dtype: every downstream
@@ -889,18 +920,22 @@ class Simulation:
                 ac = ac.astype(dtype)
             # one hash per global minute + counter-mode 60-draws: see
             # ci.csi_scan_block on why (threefry cost dominates the block)
-            meter = (pre["meter"] if pre is not None else ci.meter_block(
-                chain["k_meter"], block_idx["t"], cfg.meter_max_w, dtype
-            ))
+            with self._phase("rng"):
+                meter = (pre["meter"] if pre is not None
+                         else ci.meter_block(chain["k_meter"],
+                                             block_idx["t"],
+                                             cfg.meter_max_w, dtype))
             # heterogeneous per-site transforms (fleet/params.py): DC
             # capacity scale + inverter AC clip on pv, demand scale/shift
             # on the meter — traced only when the column is heterogeneous
-            if self._het_power:
-                fl = chain["fleet"]
-                ac = jnp.minimum(ac * fl["pv_scale"], fl["ac_limit_w"])
-            if self._het_demand:
-                fl = chain["fleet"]
-                meter = meter * fl["demand_scale"] + fl["demand_shift_w"]
+            with self._phase("fleet"):
+                if self._het_power:
+                    fl = chain["fleet"]
+                    ac = jnp.minimum(ac * fl["pv_scale"], fl["ac_limit_w"])
+                if self._het_demand:
+                    fl = chain["fleet"]
+                    meter = (meter * fl["demand_scale"]
+                             + fl["demand_shift_w"])
             return dict(chain, carry=carry, cc_carry=cc_carry), meter, ac
 
         pre = None
@@ -911,11 +946,12 @@ class Simulation:
             # (tests/test_rng_batch.py).  pre=None (the default) has no
             # pytree leaves, so the 'scan' graph stays byte-identical.
             t = block_idx["t"]
-            u_all, z_all = jax.vmap(
-                lambda k: ci.block_draws(k, t, dtype))(state["k_scan"])
-            meter_all = jax.vmap(
-                lambda k: ci.meter_block(k, t, cfg.meter_max_w, dtype)
-            )(state["k_meter"])
+            with self._phase("rng"):
+                u_all, z_all = jax.vmap(
+                    lambda k: ci.block_draws(k, t, dtype))(state["k_scan"])
+                meter_all = jax.vmap(
+                    lambda k: ci.meter_block(k, t, cfg.meter_max_w, dtype)
+                )(state["k_meter"])
             pre = {"u": u_all, "z": z_all, "meter": meter_all}
         return jax.vmap(one_chain)(state, pre)
 
@@ -1090,11 +1126,13 @@ class Simulation:
             # The meter stream stays f32: its ensemble mean is checked
             # against a tight analytic band (obs/sentinel.py) that a
             # quantised uniform could escape.
-            u_T, z_T = ci.scan_draws_tmajor(state["k_scan"], g0, n_groups,
-                                            self._compute_dtype)
-            meter_T = ci.meter_block_tmajor(
-                state["k_meter"], g0, n_groups, cfg.meter_max_w, dtype
-            )
+            with self._phase("rng"):
+                u_T, z_T = ci.scan_draws_tmajor(state["k_scan"], g0,
+                                                n_groups,
+                                                self._compute_dtype)
+                meter_T = ci.meter_block_tmajor(
+                    state["k_meter"], g0, n_groups, cfg.meter_max_w, dtype
+                )
 
         geom_samp = None
         if shared_geom is None:
@@ -1118,7 +1156,7 @@ class Simulation:
                     site["latitude"], site["longitude"], site["altitude"],
                     site["surface_tilt"], site["surface_azimuth"],
                     site["albedo"], turbidity, xp=jnp,
-                    kernels=self._kernels,
+                    kernels=self._kernels, scope=self._phase,
                 )
                 geom_samp = self._narrow_geom(geom_samp)
                 geom_xs = {"doy": ts["doy"], "gi": inputs["gs"]["i"],
@@ -1150,13 +1188,15 @@ class Simulation:
         fl_demand = fl if self._het_demand else None
 
         def step(rc, x):
-            rc, csi, covered = ci.csi_compose_step(
-                tables, x, rc, opts, dtype
-            )
+            with self._phase("csi"):
+                rc, csi, covered = ci.csi_compose_step(
+                    tables, x, rc, opts, dtype
+                )
             if shared_geom is None:
                 if geom_samp is not None:
                     g = solar.interp_sampled(geom_samp, x["geom"]["gi"],
-                                             x["geom"]["gf"], xp=jnp)
+                                             x["geom"]["gf"], xp=jnp,
+                                             scope=self._phase)
                     g["doy"] = x["geom"]["doy"]
                     g["surface_tilt"] = geom_samp["surface_tilt"]
                     g["albedo"] = geom_samp["albedo"]
@@ -1168,7 +1208,7 @@ class Simulation:
                         site["altitude"],
                         site["surface_tilt"], site["surface_azimuth"],
                         site["albedo"], turbidity, xp=jnp,
-                        kernels=self._kernels,
+                        kernels=self._kernels, scope=self._phase,
                     )
                     g = self._narrow_geom(g)
             else:
@@ -1182,19 +1222,20 @@ class Simulation:
             # and the mixed path's widening back to the carry dtype
             ac = pvmod.power_from_csi(
                 csi_c, g, SAPM_MODULE, SANDIA_INVERTER, xp=jnp,
-                kernels=self._kernels,
+                kernels=self._kernels, scope=self._phase,
             ).astype(dtype)
             meter = x["meter"].astype(dtype)
             # heterogeneous per-site transforms: (n_chains,) fleet leaves
             # bound at setup, elementwise against the per-second vectors;
             # neither branch traces anything when the fleet is absent or
             # the column homogeneous (byte-identical scan body)
-            if fl_power is not None:
-                ac = jnp.minimum(ac * fl_power["pv_scale"],
-                                 fl_power["ac_limit_w"])
-            if fl_demand is not None:
-                meter = (meter * fl_demand["demand_scale"]
-                         + fl_demand["demand_shift_w"])
+            with self._phase("fleet"):
+                if fl_power is not None:
+                    ac = jnp.minimum(ac * fl_power["pv_scale"],
+                                     fl_power["ac_limit_w"])
+                if fl_demand is not None:
+                    meter = (meter * fl_demand["demand_scale"]
+                             + fl_demand["demand_shift_w"])
             if with_extras:
                 return (rc, meter, ac,
                         {"csi": csi, "covered": covered})
@@ -1283,10 +1324,12 @@ class Simulation:
                                             jnp.where(valid, residual, -big)),
                 "n_seconds": st["n_seconds"] + valid.astype(jnp.int32),
             }
-            ta = tel.fold_second(
-                ta, level, meter=meter, pv=ac, csi=extras["csi"],
-                residual=residual, covered=extras["covered"], valid=valid,
-            )
+            with self._phase("telemetry"):
+                ta = tel.fold_second(
+                    ta, level, meter=meter, pv=ac, csi=extras["csi"],
+                    residual=residual, covered=extras["covered"],
+                    valid=valid,
+                )
             return ((rc, st), ta), None
 
         return body
@@ -1336,8 +1379,9 @@ class Simulation:
         (meter/pv/residual only: the wide producer never materialises
         csi, which ``tel.summarize`` reports as unobserved)."""
         ta = tel.init_acc(self._telemetry, self.dtype)
-        return tel.fold_wide(ta, self._telemetry, meter=meter, pv=pv,
-                             t=t, duration_s=self.config.duration_s)
+        with self._phase("telemetry"):
+            return tel.fold_wide(ta, self._telemetry, meter=meter, pv=pv,
+                                 t=t, duration_s=self.config.duration_s)
 
     def _cohort_ids(self, state):
         """The (n_chains,) int32 cohort-id vector for the analytics
@@ -1379,11 +1423,12 @@ class Simulation:
                                             jnp.where(valid, residual, -big)),
                 "n_seconds": st["n_seconds"] + valid.astype(jnp.int32),
             }
-            fa = flt.fold_second(
-                fa, level, params, meter=meter, pv=ac, residual=residual,
-                covered=extras["covered"], t=x["t"], valid=valid,
-                cohort=cohort,
-            )
+            with self._phase("analytics"):
+                fa = flt.fold_second(
+                    fa, level, params, meter=meter, pv=ac,
+                    residual=residual, covered=extras["covered"],
+                    t=x["t"], valid=valid, cohort=cohort,
+                )
             return ((rc, st), fa), None
 
         return body
@@ -1417,15 +1462,18 @@ class Simulation:
                                             jnp.where(valid, residual, -big)),
                 "n_seconds": st["n_seconds"] + valid.astype(jnp.int32),
             }
-            ta = tel.fold_second(
-                ta, tel_level, meter=meter, pv=ac, csi=extras["csi"],
-                residual=residual, covered=extras["covered"], valid=valid,
-            )
-            fa = flt.fold_second(
-                fa, level, params, meter=meter, pv=ac, residual=residual,
-                covered=extras["covered"], t=x["t"], valid=valid,
-                cohort=cohort,
-            )
+            with self._phase("telemetry"):
+                ta = tel.fold_second(
+                    ta, tel_level, meter=meter, pv=ac, csi=extras["csi"],
+                    residual=residual, covered=extras["covered"],
+                    valid=valid,
+                )
+            with self._phase("analytics"):
+                fa = flt.fold_second(
+                    fa, level, params, meter=meter, pv=ac,
+                    residual=residual, covered=extras["covered"],
+                    t=x["t"], valid=valid, cohort=cohort,
+                )
             return ((rc, st), ta, fa), None
 
         return body
@@ -1522,10 +1570,11 @@ class Simulation:
         fa = flt.init_acc(self._analytics, self.dtype,
                           params=self._fleet_params,
                           cohorts=self._n_cohorts)
-        return flt.fold_wide(fa, self._analytics, self._fleet_params,
-                             meter=meter, pv=pv, t=t,
-                             duration_s=self.config.duration_s,
-                             cohort=cohort)
+        with self._phase("analytics"):
+            return flt.fold_wide(fa, self._analytics, self._fleet_params,
+                                 meter=meter, pv=pv, t=t,
+                                 duration_s=self.config.duration_s,
+                                 cohort=cohort)
 
     def _scan2_outer(self, state, xs, inner, carry0):
         """The nested ('scan2') outer scan, shared by the reduce and
@@ -1578,12 +1627,13 @@ class Simulation:
                                       cdt)
                 return u, z
 
-            u, z = jax.vmap(draws, out_axes=1)(k_scan)       # (60, chains)
-            mu = jax.vmap(
-                lambda k: jax.random.uniform(jax.random.fold_in(k, g),
-                                             (60,), dtype),
-                out_axes=1,
-            )(k_meter)
+            with self._phase("rng"):
+                u, z = jax.vmap(draws, out_axes=1)(k_scan)   # (60, chains)
+                mu = jax.vmap(
+                    lambda k: jax.random.uniform(jax.random.fold_in(k, g),
+                                                 (60,), dtype),
+                    out_axes=1,
+                )(k_meter)
             xs_inner = dict(xm, u=u, z=z, meter=max_w * mu)
             return inner(carry, xs_inner)
 
@@ -2722,6 +2772,118 @@ class Simulation:
             "output_overlap": bool(self._output_overlap),
         }
 
+    def _attribution_jits(self) -> list:
+        """``[(jit, make_args)]`` for the active reduce-mode block
+        dispatch.  Each ``make_args()`` builds FRESH concrete arguments
+        (block-0 inputs, new state/accumulator buffers) — the jits
+        donate state and accumulator, so every dispatch of an
+        ahead-of-time compiled executable needs live inputs."""
+        if self._impl in ("scan", "scan2"):
+            s2 = self._impl == "scan2"
+            if self._analytics != "off":
+                if self._telemetry != "off":
+                    j = (self._scan2_acc_tel_fleet_jit if s2
+                         else self._scan_acc_tel_fleet_jit)
+                else:
+                    j = (self._scan2_acc_fleet_jit if s2
+                         else self._scan_acc_fleet_jit)
+            elif self._telemetry != "off":
+                j = self._scan2_acc_tel_jit if s2 else self._scan_acc_tel_jit
+            else:
+                j = self._scan2_acc_jit if s2 else self._scan_acc_jit
+        elif self._use_fused and self._telemetry == "off" \
+                and self._analytics == "off":
+            j = self._fused_acc_jit
+        else:
+            # wide split path: producer + stats consumer are separate
+            # jits; the consumer runs on zero-filled block arrays (the
+            # numbers are irrelevant to op-time attribution)
+            def block_args():
+                inputs, _ = self.host_inputs(0)
+                return (self.init_state(), inputs)
+
+            def stats_args():
+                inputs, _ = self.host_inputs(0)
+                meter = jnp.zeros(
+                    (self.config.n_chains, self.config.block_s),
+                    self.dtype)
+                return (meter, meter, inputs["block_idx"]["t"],
+                        self.init_reduce_acc())
+
+            return [(self._block_jit, block_args),
+                    (self._stats_acc_jit, stats_args)]
+
+        def acc_args():
+            inputs, _ = self.host_inputs(0)
+            return (self.init_state(), inputs, self.init_reduce_acc())
+
+        return [(j, acc_args)]
+
+    def attribution_hlo_texts(self) -> list:
+        """Compiled (optimized) HLO text(s) of the active reduce-mode
+        block dispatch — what ``obs.attribution.write_phase_map`` parses
+        into the op-name → phase join basis.  Meaningful phase scopes
+        appear only when ``phase_obs`` is on.
+
+        CAVEAT: XLA instruction numbering is NOT stable across separate
+        compilations of the same graph, so these texts only join against
+        a trace of the very executables compiled here — use
+        :meth:`attribution_capture`, which traces the same compiled
+        objects, rather than pairing this with an independently captured
+        trace."""
+        return [j.lower(*args()).compile().as_text()
+                for j, args in self._attribution_jits()]
+
+    def attribution_capture(self, log_dir: str, n_dispatches: int = 2):
+        """The whole scoped-capture protocol, self-contained: AOT-compile
+        the active reduce-mode dispatch, warm up OUTSIDE the trace, run
+        ``n_dispatches`` traced dispatches of the SAME executables,
+        write the phase map parsed from those executables' optimized
+        HLO, and attribute the trace (obs/attribution.py).
+
+        The phase map must come from the very executables the trace
+        recorded: instruction numbering differs between separate
+        compilations of one graph, and a fresh ``lower().compile()`` at
+        analysis time joins ~0% of the traced device time.  Sets and
+        returns ``self.attribution`` (None when the trace yielded no
+        attributable events); returns a ``(doc, stats)`` pair where
+        stats carries ``compile_s`` / ``traced_wall_s`` /
+        ``n_dispatches`` for the caller's timing sections."""
+        import time as _time
+
+        from tmhpvsim_tpu.obs import attribution as _attr
+        from tmhpvsim_tpu.obs.profiler import device_trace
+
+        t0 = _time.perf_counter()
+        compiled = [(j.lower(*args()).compile(), args)
+                    for j, args in self._attribution_jits()]
+        texts = [c.as_text() for c, _ in compiled]
+        for c, args in compiled:  # warm-up dispatch outside the trace
+            jax.block_until_ready(c(*args()))
+        compile_s = _time.perf_counter() - t0
+        # args are built OUTSIDE the trace too — state/acc init runs its
+        # own device ops, which would land in the trace as unattributed
+        # noise (the jits donate, so each dispatch needs fresh buffers)
+        staged = [[(c, args()) for c, args in compiled]
+                  for _ in range(n_dispatches)]
+        # force the staged buffers NOW: dispatch is async, and letting
+        # the init computations execute inside the trace window floods
+        # the profiler's event cap with jit_build ops (measured: they
+        # drowned the real dispatch to a ~0.6% join)
+        jax.block_until_ready([a for batch in staged for _, a in batch])
+        t1 = _time.perf_counter()
+        with device_trace(log_dir, python_tracer=False):
+            for batch in staged:
+                for c, a in batch:
+                    jax.block_until_ready(c(*a))
+        traced_wall_s = _time.perf_counter() - t1
+        _attr.write_phase_map(log_dir, texts)
+        self.attribution = _attr.attribute(log_dir)
+        return self.attribution, {
+            "compile_s": compile_s, "traced_wall_s": traced_wall_s,
+            "n_dispatches": n_dispatches,
+        }
+
     def run_report(self, app: str = "engine", path=None, headline=None):
         """The run's :class:`~tmhpvsim_tpu.obs.report.RunReport`: config,
         the resolved plan, the internal timer's compile/steady split, and
@@ -2733,6 +2895,12 @@ class Simulation:
         rep = RunReport(app, config=self.config, plan=self.plan)
         summary = self.timer.summary()
         rep.set_timing(summary)
+        if self.attribution is not None:
+            # publish BEFORE the metrics dump so the gauges land in it
+            from tmhpvsim_tpu.obs.attribution import publish_phase_gauges
+
+            publish_phase_gauges(self.metrics, self.attribution)
+            rep.attribution = self.attribution
         rep.attach_metrics(self.metrics)
         if self.sentinel is not None:
             rep.telemetry = self.sentinel.report()

@@ -19,6 +19,8 @@ jit cleanly with coefficients baked in as constants.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -131,7 +133,8 @@ def sandia_inverter_ac(v_dc, p_dc, inverter, xp=jnp):
     return xp.where(p_dc < inverter["Pso"], -xp.abs(inverter["Pnt"]), ac)
 
 
-def power_from_csi(csi, geom, module, inverter, xp=jnp, kernels=None):
+def power_from_csi(csi, geom, module, inverter, xp=jnp, kernels=None,
+                   scope=None):
     """Clear-sky index -> AC watts, given precomputed block geometry.
 
     The chain-dependent half of the reference's ``populate_cache``
@@ -145,25 +148,32 @@ def power_from_csi(csi, geom, module, inverter, xp=jnp, kernels=None):
 
     ``kernels`` selects the transcendental implementation for the whole
     chain (models/tables.py); ``None`` traces the raw ``xp`` ops.
+    ``scope``: optional phase-scope factory (the engine's gated
+    ``_phase``, obs/attribution.py) — traces the whole irradiance→power
+    chain inside the ``physics`` phase; None changes nothing.
     """
     from tmhpvsim_tpu.models import solar
 
-    csi = xp.minimum(csi, geom["csi_cap"])
-    ghi = csi * geom["ghi_clear"]
-    dni = solar.disc_dni(ghi, geom["zenith"], geom["doy"], xp=xp,
-                         kernels=kernels)
-    dhi = xp.maximum(ghi - dni * geom["cos_zenith"], 0.0)
+    ctx = scope("physics") if scope is not None else \
+        contextlib.nullcontext()
+    with ctx:
+        csi = xp.minimum(csi, geom["csi_cap"])
+        ghi = csi * geom["ghi_clear"]
+        dni = solar.disc_dni(ghi, geom["zenith"], geom["doy"], xp=xp,
+                             kernels=kernels)
+        dhi = xp.maximum(ghi - dni * geom["cos_zenith"], 0.0)
 
-    poa = solar.haydavies_poa(
-        geom["surface_tilt"], geom["cos_aoi"], geom["apparent_zenith"],
-        ghi, dni, dhi, geom["dni_extra"], albedo=geom["albedo"], xp=xp,
-        kernels=kernels,
-    )
-    t_cell = sapm_cell_temp(poa["poa_global"], module, xp=xp, kernels=kernels)
-    ee = sapm_effective_irradiance(
-        poa["poa_direct"], poa["poa_diffuse"], geom["airmass_abs"],
-        geom["cos_aoi"], module, xp=xp, kernels=kernels,
-    )
-    dc = sapm_dc(ee, t_cell, module, xp=xp, kernels=kernels)
-    ac = sandia_inverter_ac(dc["v_mp"], dc["p_mp"], inverter, xp=xp)
-    return xp.maximum(ac, 0.0)
+        poa = solar.haydavies_poa(
+            geom["surface_tilt"], geom["cos_aoi"], geom["apparent_zenith"],
+            ghi, dni, dhi, geom["dni_extra"], albedo=geom["albedo"], xp=xp,
+            kernels=kernels,
+        )
+        t_cell = sapm_cell_temp(poa["poa_global"], module, xp=xp,
+                                kernels=kernels)
+        ee = sapm_effective_irradiance(
+            poa["poa_direct"], poa["poa_diffuse"], geom["airmass_abs"],
+            geom["cos_aoi"], module, xp=xp, kernels=kernels,
+        )
+        dc = sapm_dc(ee, t_cell, module, xp=xp, kernels=kernels)
+        ac = sandia_inverter_ac(dc["v_mp"], dc["p_mp"], inverter, xp=xp)
+        return xp.maximum(ac, 0.0)

@@ -33,6 +33,8 @@ All angles in radians unless suffixed ``_deg``; irradiances in W/m^2.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -431,45 +433,57 @@ def haydavies_poa(surface_tilt_deg, cos_aoi, zenith, ghi, dni, dhi,
 
 def device_geometry(day2000, sec_of_day, doy, latitude_deg, longitude_deg,
                     altitude_m, surface_tilt_deg, surface_azimuth_deg,
-                    albedo, turbidity_monthly, xp=jnp, kernels=None):
+                    albedo, turbidity_monthly, xp=jnp, kernels=None,
+                    scope=None):
     """All geometry features from split time + scalar site parameters —
     float32-safe, jit/vmap-friendly (the per-chain site-grid path).
 
     Site parameters are scalars (vmap them over a grid); time arrays are
     shared.  Returns the same dict as :func:`block_geometry`.
+
+    ``scope``: optional phase-scope factory (the engine's gated
+    ``_phase`` helper, obs/attribution.py) — when given, the whole
+    transcendental chain traces inside the ``geometry`` phase so device
+    traces can price it; None (the default, and every host/numpy
+    caller) changes nothing.
     """
-    pos = sun_position_split(day2000, sec_of_day, latitude_deg,
-                             longitude_deg, xp=xp, kernels=kernels)
-    pressure = alt2pres(altitude_m)
-    app_elev = apparent_elevation(pos["zenith"], pressure, xp=xp,
-                                  kernels=kernels)
-    app_zen = np.pi / 2.0 - app_elev
+    ctx = scope("geometry") if scope is not None else \
+        contextlib.nullcontext()
+    with ctx:
+        pos = sun_position_split(day2000, sec_of_day, latitude_deg,
+                                 longitude_deg, xp=xp, kernels=kernels)
+        pressure = alt2pres(altitude_m)
+        app_elev = apparent_elevation(pos["zenith"], pressure, xp=xp,
+                                      kernels=kernels)
+        app_zen = np.pi / 2.0 - app_elev
 
-    am_rel = relative_airmass_kasten_young(app_zen, xp=xp, kernels=kernels)
-    am_abs = am_rel * pressure / STD_PRESSURE
+        am_rel = relative_airmass_kasten_young(app_zen, xp=xp,
+                                               kernels=kernels)
+        am_abs = am_rel * pressure / STD_PRESSURE
 
-    dni_extra = extra_radiation_spencer(doy, xp=xp, kernels=kernels)
-    tl = linke_turbidity(doy, turbidity_monthly, xp=xp)
-    ghi_clear = ineichen_ghi(app_zen, am_abs, tl, altitude_m, dni_extra,
-                             xp=xp, kernels=kernels)
-    cos_aoi = angle_of_incidence_cos(
-        surface_tilt_deg, surface_azimuth_deg, app_zen, pos["azimuth"], xp=xp,
-        kernels=kernels
-    )
-    return {
-        "zenith": pos["zenith"],
-        "cos_zenith": pos["cos_zenith"],
-        "apparent_zenith": app_zen,
-        "azimuth": pos["azimuth"],
-        "csi_cap": csi_zenith_cap(pos["zenith"], xp=xp, kernels=kernels),
-        "ghi_clear": ghi_clear,
-        "dni_extra": dni_extra,
-        "airmass_abs": am_abs,
-        "cos_aoi": cos_aoi,
-        "doy": xp.asarray(doy),
-        "surface_tilt": surface_tilt_deg,
-        "albedo": albedo,
-    }
+        dni_extra = extra_radiation_spencer(doy, xp=xp, kernels=kernels)
+        tl = linke_turbidity(doy, turbidity_monthly, xp=xp)
+        ghi_clear = ineichen_ghi(app_zen, am_abs, tl, altitude_m,
+                                 dni_extra, xp=xp, kernels=kernels)
+        cos_aoi = angle_of_incidence_cos(
+            surface_tilt_deg, surface_azimuth_deg, app_zen, pos["azimuth"],
+            xp=xp, kernels=kernels
+        )
+        return {
+            "zenith": pos["zenith"],
+            "cos_zenith": pos["cos_zenith"],
+            "apparent_zenith": app_zen,
+            "azimuth": pos["azimuth"],
+            "csi_cap": csi_zenith_cap(pos["zenith"], xp=xp,
+                                      kernels=kernels),
+            "ghi_clear": ghi_clear,
+            "dni_extra": dni_extra,
+            "airmass_abs": am_abs,
+            "cos_aoi": cos_aoi,
+            "doy": xp.asarray(doy),
+            "surface_tilt": surface_tilt_deg,
+            "albedo": albedo,
+        }
 
 
 def block_geometry(epoch_s, doy, site, xp=jnp, kernels=None):
@@ -570,7 +584,7 @@ STRIDE_MAX_ABS_ERR = {
 STRIDES = (1, 30, 60)
 
 
-def interp_sampled(sampled, i, f, xp=jnp):
+def interp_sampled(sampled, i, f, xp=jnp, scope=None):
     """Lerp the :data:`STRIDE_LERP_FIELDS` of a stride-sampled geometry
     dict at sample index ``i`` + fraction ``f`` in [0, 1).
 
@@ -578,16 +592,21 @@ def interp_sampled(sampled, i, f, xp=jnp):
     ``n_samples = T//stride + 1``; ``i``/``f`` may be scalars (the
     in-scan per-second case) or arrays (the batched host / wide case).
     Returns only the interpolated fields — callers add back the exact
-    per-second ``doy`` and the site scalars."""
-    out = {}
-    for k in STRIDE_LERP_FIELDS:
-        v = sampled[k]
-        lo = v[i]
-        fa = xp.asarray(f)
-        if lo.ndim > fa.ndim:
-            fa = fa.reshape(fa.shape + (1,) * (lo.ndim - fa.ndim))
-        out[k] = lo * (1.0 - fa) + v[i + 1] * fa
-    return out
+    per-second ``doy`` and the site scalars.  ``scope``: optional phase
+    scope factory; the lerp is geometry work (see
+    :func:`device_geometry`)."""
+    ctx = scope("geometry") if scope is not None else \
+        contextlib.nullcontext()
+    with ctx:
+        out = {}
+        for k in STRIDE_LERP_FIELDS:
+            v = sampled[k]
+            lo = v[i]
+            fa = xp.asarray(f)
+            if lo.ndim > fa.ndim:
+                fa = fa.reshape(fa.shape + (1,) * (lo.ndim - fa.ndim))
+            out[k] = lo * (1.0 - fa) + v[i + 1] * fa
+        return out
 
 
 def strided_block_geometry(epoch_s, doy, site, stride, xp=np, kernels=None):

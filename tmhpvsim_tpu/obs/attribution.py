@@ -1,0 +1,537 @@
+"""Semantic phase attribution: per-phase device-time split of a trace.
+
+PR 15's ``obs.pod.comm_split`` separates collective from compute time —
+one bit of taxonomy.  This module generalises that event walk into a
+phase-level one: when the engine traces under ``SimConfig.phase_obs``
+(engine/simulation.py ``_phase`` / obs/profiler.py :func:`phase_scope`),
+every HLO op carries a ``ph__<phase>`` component in its ``op_name``
+metadata, and a device trace of such a build can be bucketed into the
+~9 semantic stages of the per-second chain (rng, markov, csi, geometry,
+physics, fleet, telemetry, analytics, collectives) plus an
+``unattributed`` residual.
+
+The join is indirect, by necessity: Chrome-trace op events do NOT carry
+scope metadata — they carry the *optimized-HLO instruction name*
+(``args.hlo_op``, e.g. ``fusion.1``).  The scope path lives in the
+compiled HLO text (``jit.lower(...).compile().as_text()``), where every
+instruction's ``metadata={op_name="jit(f)/.../ph__geometry/sin"}``
+records the scopes it was traced under.  So attribution is a two-file
+protocol:
+
+1. at capture time, :func:`write_phase_map` parses the compiled HLO of
+   the active block jit into ``{instruction name: phase}`` — fusions
+   inherit their root op's scope, falling back to a majority vote over
+   the fused computation's members — and drops ``phase_map.json`` next
+   to the trace;
+2. :func:`attribute` walks the trace's XLA op events (gzip or plain
+   Chrome JSON) and joins durations against that map
+   (``basis: "scope"``), degrading to op-name heuristics — collectives
+   by prefix, rng by name — when no map or no scoped ops are present
+   (``basis: "opname-heuristic"``), and to ``basis: "unavailable"``
+   with a rate-limited WARN when nothing at all can be attributed
+   (older jax, scope-less builds): never an exception.
+
+The result feeds the RunReport v15 ``attribution`` section, the
+``device.phase.*`` gauges, bench.py's per-lever attribution diffs
+(:func:`diff_attribution`) and obs/cost.py's ``model_error`` phase
+checks (each static-v1 factor axis names the phase it claims to scale).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import logging
+import os
+import re
+import time
+from typing import Iterator, Optional
+
+from tmhpvsim_tpu.obs.pod import (COLLECTIVE_PREFIXES, _is_xla_op)
+from tmhpvsim_tpu.obs.profiler import PHASE_PREFIX
+
+logger = logging.getLogger(__name__)
+
+ATTRIBUTION_SCHEMA_VERSION = 1
+
+#: sidecar written next to a scoped trace by :func:`write_phase_map`
+PHASE_MAP_NAME = "phase_map.json"
+
+#: the semantic stages of the per-second chain, in pipeline order
+#: (engine/simulation.py wraps each in ``phase_scope``)
+PHASES = ("rng", "markov", "csi", "geometry", "physics", "fleet",
+          "telemetry", "analytics", "collectives")
+
+#: recognised ``basis`` values of an attribution doc
+BASES = ("scope", "opname-heuristic", "unavailable")
+
+#: op-name fragments attributed to the rng phase when no scope map is
+#: available (threefry/philox hash chains dominate the draw cost)
+_RNG_NAME_PATTERNS = ("rng", "threefry", "philox")
+
+#: control-flow CONTAINER instructions: their trace events re-span the
+#: body thunks' events on the same thread (a ``while`` duration is the
+#: whole loop including every member op), so counting them alongside
+#: the member events double-counts ~every scan body.  Excluded from
+#: the op walk; the loop's own bookkeeping overhead lands nowhere,
+#: which is the conservative choice.
+_CONTAINER_OPS = ("while", "conditional", "call")
+
+#: min seconds between "no scope metadata" WARNs (a bench sweep calls
+#: attribute() once per variant; one warning carries the message)
+_WARN_INTERVAL_S = 60.0
+_last_warn = [0.0]
+
+
+# -- trace event walk ------------------------------------------------------
+
+
+def _iter_trace_files(log_dir: str) -> Iterator[str]:
+    """Every Chrome-trace export under ``log_dir`` — the profiler's
+    ``plugins/profile/<ts>/*.trace.json.gz`` layout plus plain
+    ``*.trace.json`` (hand-built fixtures, other exporters)."""
+    for pattern in ("*.trace.json.gz", "*.trace.json"):
+        for path in sorted(glob.glob(
+                os.path.join(log_dir, "**", pattern), recursive=True)):
+            yield path
+
+
+def _load_trace(path: str) -> Optional[dict]:
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt", encoding="utf-8",
+                           errors="replace") as f:
+                return json.load(f)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, EOFError) as e:
+        logger.warning("unparsable device trace %s: %s", path, e)
+        return None
+
+
+def iter_xla_op_events(log_dir: str) -> Iterator[tuple]:
+    """``(op_name, hlo_op, dur_us)`` for every XLA op duration event in
+    every parsable trace under ``log_dir``.
+
+    ``hlo_op`` is the optimized-HLO instruction name jax stamps into
+    ``args.hlo_op`` (the :func:`attribute` join key); None when the
+    export carries no HLO metadata.  The op/thread/process filtering is
+    ``obs.pod._is_xla_op`` — this iterator is the generalised event
+    walk ``comm_split`` grew from — plus the :data:`_CONTAINER_OPS`
+    exclusion (a ``while`` event spans its whole body's events, so
+    keeping it would double-count every scan iteration).
+    """
+    for path in _iter_trace_files(log_dir):
+        trace = _load_trace(path)
+        if trace is None:
+            continue
+        events = trace.get("traceEvents") or []
+        proc_names: dict = {}
+        thread_names: dict = {}
+        for ev in events:
+            if ev.get("ph") != "M":
+                continue
+            args = ev.get("args") or {}
+            if ev.get("name") == "process_name":
+                proc_names[ev.get("pid")] = str(args.get("name", ""))
+            elif ev.get("name") == "thread_name":
+                thread_names[(ev.get("pid"), ev.get("tid"))] = \
+                    str(args.get("name", ""))
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur <= 0:
+                continue
+            name = str(ev.get("name", ""))
+            thread = thread_names.get((ev.get("pid"), ev.get("tid")), "")
+            process = proc_names.get(ev.get("pid"), "")
+            if not _is_xla_op(name, thread, process):
+                continue
+            args = ev.get("args") or {}
+            hlo_op = args.get("hlo_op")
+            op = str(hlo_op) if hlo_op else name
+            if op.split(".", 1)[0] in _CONTAINER_OPS:
+                continue
+            yield name, (str(hlo_op) if hlo_op else None), float(dur)
+
+
+# -- phase classification --------------------------------------------------
+
+
+_SCOPE_RE = re.compile(re.escape(PHASE_PREFIX) + r"([A-Za-z0-9_]+)")
+
+
+def phase_of_scope_path(op_name: str) -> Optional[str]:
+    """The phase named by the INNERMOST ``ph__<phase>`` occurrence in an
+    HLO ``op_name`` scope path (``jit(f)/jit(main)/ph__geometry/sin``
+    -> ``"geometry"``), or None when no phase scope encloses the op.
+
+    Matched by substring, not path component: transforms wrap the scope
+    name in brackets — under vmap/while the path reads
+    ``.../vmap(ph__markov)/while/body/...`` — and the thunk-level
+    instructions of a scanned graph live almost entirely inside such
+    wrapped components."""
+    m = _SCOPE_RE.findall(op_name)
+    return m[-1] if m else None
+
+
+def phase_of_op_name(name: str) -> Optional[str]:
+    """Scope-less fallback: the phase an optimized-HLO op name alone
+    reveals — collectives by instruction-name prefix (the
+    ``comm_split`` taxonomy), rng by hash-chain fragments.  Everything
+    else is unattributable without a scope map."""
+    if name.startswith(COLLECTIVE_PREFIXES):
+        return "collectives"
+    base = name.lower()
+    if any(p in base for p in _RNG_NAME_PATTERNS):
+        return "rng"
+    return None
+
+
+# -- compiled-HLO phase map ------------------------------------------------
+
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{")
+_OP_NAME_RE = re.compile(r'metadata=\{[^}]*?op_name="([^"]*)"')
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+
+
+def parse_hlo_phase_map(hlo_text: str) -> dict:
+    """``{optimized-HLO instruction name: phase}`` from one compiled
+    module's text (``lowered.compile().as_text()``).
+
+    An instruction's phase is the innermost ``ph__*`` scope in its
+    ``op_name`` metadata.  A fusion whose own metadata names no phase
+    (or a root op traced outside any scope) falls back to the majority
+    phase among its fused computation's member instructions — XLA fuses
+    across scope boundaries freely, and charging the whole fusion to
+    the dominant member is the honest first-order split.  An unscoped
+    instruction (copies, converts, tuple plumbing — inserted by late
+    passes with no metadata) inside a computation whose scoped members
+    UNANIMOUSLY name one phase inherits that phase: a rejection
+    sampler's while-body carry copies are that sampler's work
+    (measured: they were >60% of a CPU trace's device time before this
+    rule).  Instructions with no phase anywhere are omitted (they land
+    in the residual) — in particular plumbing inside MIXED-phase
+    computations, like the main scan body's carries, stays
+    unattributed rather than being charged to the dominant phase.
+    """
+    instr_phase: dict = {}
+    comp_counts: dict = {}          # computation -> {phase: n_members}
+    comp_unscoped: dict = {}        # computation -> [unscoped names]
+    fusion_calls: dict = {}         # instr -> (containing, called comp)
+    current_comp = None
+    for line in hlo_text.splitlines():
+        mc = _COMP_RE.match(line)
+        if mc:
+            current_comp = mc.group(1)
+            continue
+        if line.startswith("}"):
+            current_comp = None
+            continue
+        mi = _INSTR_RE.match(line)
+        if not mi:
+            continue
+        name = mi.group(1)
+        mo = _OP_NAME_RE.search(line)
+        phase = phase_of_scope_path(mo.group(1)) if mo else None
+        if phase is not None:
+            instr_phase[name] = phase
+            if current_comp is not None:
+                counts = comp_counts.setdefault(current_comp, {})
+                counts[phase] = counts.get(phase, 0) + 1
+        elif current_comp is not None and " parameter(" not in line:
+            comp_unscoped.setdefault(current_comp, []).append(name)
+        mcall = _CALLS_RE.search(line)
+        if mcall:
+            fusion_calls[name] = (current_comp, mcall.group(1))
+    # second pass: fusions without their own phase inherit the majority
+    # phase of the computation they call (ties stay unattributed).  The
+    # inherited phase counts toward the CONTAINING computation's phase
+    # mix, so the unanimity pass below sees a computation holding, say,
+    # one rng op and one geometry fusion as mixed — not unanimous rng.
+    for name, (container, comp) in fusion_calls.items():
+        if name in instr_phase:
+            continue
+        counts = comp_counts.get(comp)
+        if not counts:
+            continue
+        ranked = sorted(counts.items(), key=lambda kv: -kv[1])
+        if len(ranked) == 1 or ranked[0][1] > ranked[1][1]:
+            phase = ranked[0][0]
+            instr_phase[name] = phase
+            if container is not None:
+                ccounts = comp_counts.setdefault(container, {})
+                ccounts[phase] = ccounts.get(phase, 0) + 1
+    # third pass: unscoped members of a single-phase computation inherit
+    # its phase (setdefault — a fusion-majority assignment wins)
+    for comp, members in comp_unscoped.items():
+        counts = comp_counts.get(comp)
+        if not counts or len(counts) != 1:
+            continue
+        phase = next(iter(counts))
+        for name in members:
+            instr_phase.setdefault(name, phase)
+    return instr_phase
+
+
+def write_phase_map(log_dir: str, hlo_texts) -> dict:
+    """Parse each compiled-HLO text and write the merged
+    ``phase_map.json`` sidecar into ``log_dir`` (next to the trace the
+    map explains).  Returns the merged ``{instruction: phase}`` map."""
+    merged: dict = {}
+    for text in hlo_texts:
+        merged.update(parse_hlo_phase_map(text))
+    os.makedirs(log_dir, exist_ok=True)
+    doc = {
+        "schema_version": ATTRIBUTION_SCHEMA_VERSION,
+        "n_mapped": len(merged),
+        "op_phase": merged,
+    }
+    with open(os.path.join(log_dir, PHASE_MAP_NAME), "w") as f:
+        json.dump(doc, f)
+    return merged
+
+
+def read_phase_map(log_dir: str) -> Optional[dict]:
+    """The ``{instruction: phase}`` map of a capture directory, or None
+    when no sidecar exists (scope-less capture — attribute() degrades
+    to op-name heuristics)."""
+    path = os.path.join(log_dir, PHASE_MAP_NAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    op_phase = doc.get("op_phase")
+    return op_phase if isinstance(op_phase, dict) else None
+
+
+# -- attribution -----------------------------------------------------------
+
+
+def _warn_rate_limited(msg: str, *args) -> None:
+    now = time.monotonic()
+    if now - _last_warn[0] >= _WARN_INTERVAL_S:
+        _last_warn[0] = now
+        logger.warning(msg, *args)
+
+
+def attribute(log_dir: str, phase_map: Optional[dict] = None
+              ) -> Optional[dict]:
+    """Per-phase device-time split of a ``device_trace`` capture.
+
+    Returns the RunReport v15 ``attribution`` section::
+
+        {"schema_version": 1, "basis": "scope",
+         "total_device_s": ..., "n_events": ...,
+         "phases": {"geometry": {"seconds": ..., "frac": ...}, ...},
+         "unattributed_s": ..., "unattributed_frac": ...}
+
+    ``phases`` holds only phases with nonzero observed time; fractions
+    are of total XLA op time, so ``sum(frac) + unattributed_frac == 1``
+    (the fractions-sum invariant tests assert).  ``basis`` records the
+    evidence class: ``"scope"`` (joined against a compiled-HLO phase
+    map — see :func:`write_phase_map`), ``"opname-heuristic"`` (no map;
+    collectives/rng recognised by op name only) or ``"unavailable"``
+    (XLA events exist but nothing could be attributed — rate-limited
+    WARN, never an exception).  None only when the directory holds no
+    parsable trace or no XLA op events at all, mirroring
+    ``obs.pod.comm_split``.
+    """
+    pm = phase_map if phase_map is not None else read_phase_map(log_dir)
+    per_phase_us: dict = {}
+    total_us = 0.0
+    n_events = 0
+    scope_hits = 0
+    heuristic_hits = 0
+    for name, hlo_op, dur in iter_xla_op_events(log_dir):
+        n_events += 1
+        total_us += dur
+        phase = None
+        if pm:
+            phase = pm.get(hlo_op) if hlo_op else None
+            if phase is None:
+                phase = pm.get(name)
+            if phase is not None:
+                scope_hits += 1
+        if phase is None:
+            phase = phase_of_op_name(name)
+            if phase is not None:
+                heuristic_hits += 1
+        if phase is not None:
+            per_phase_us[phase] = per_phase_us.get(phase, 0.0) + dur
+    if n_events == 0 or total_us <= 0:
+        return None
+    if scope_hits:
+        basis = "scope"
+    elif heuristic_hits:
+        basis = "opname-heuristic"
+    else:
+        basis = "unavailable"
+        _warn_rate_limited(
+            "phase attribution unavailable for %s: %d XLA op events but "
+            "no phase map matched and no op name was recognisable — "
+            "capture with SimConfig.phase_obs='on' and write_phase_map() "
+            "to get a scoped split", log_dir, n_events)
+    attributed_us = sum(per_phase_us.values())
+    phases = {
+        name: {"seconds": round(us / 1e6, 6),
+               "frac": round(us / total_us, 6)}
+        for name, us in sorted(per_phase_us.items(),
+                               key=lambda kv: -kv[1])
+    }
+    return {
+        "schema_version": ATTRIBUTION_SCHEMA_VERSION,
+        "basis": basis,
+        "total_device_s": round(total_us / 1e6, 6),
+        "n_events": n_events,
+        "n_scope_events": scope_hits,
+        "phases": phases,
+        "unattributed_s": round((total_us - attributed_us) / 1e6, 6),
+        "unattributed_frac": round((total_us - attributed_us) / total_us,
+                                   6),
+    }
+
+
+def phase_fractions(doc: Optional[dict]) -> Optional[dict]:
+    """``{phase: frac}`` of an attribution doc when it carries a usable
+    split (basis != 'unavailable'), else None — the shape
+    ``obs.cost.model_error_doc`` takes for its per-axis phase checks."""
+    if not isinstance(doc, dict) or doc.get("basis") == "unavailable":
+        return None
+    phases = doc.get("phases")
+    if not isinstance(phases, dict):
+        return None
+    return {name: float(v.get("frac", 0.0))
+            for name, v in phases.items() if isinstance(v, dict)}
+
+
+# -- lever diffs -----------------------------------------------------------
+
+
+def diff_attribution(base: Optional[dict], variant: Optional[dict]
+                     ) -> Optional[dict]:
+    """Per-phase share shift of a lever variant vs the all-defaults
+    baseline: ``{"phases": {name: {"base_frac", "variant_frac",
+    "delta_frac"}}, "basis": ...}``.  None when either side is missing
+    or unavailable (a diff against heuristic-only evidence would
+    mislead more than it informs)."""
+    bf = phase_fractions(base)
+    vf = phase_fractions(variant)
+    if bf is None or vf is None:
+        return None
+    out = {}
+    for name in sorted(set(bf) | set(vf)):
+        b, v = bf.get(name, 0.0), vf.get(name, 0.0)
+        out[name] = {
+            "base_frac": round(b, 6),
+            "variant_frac": round(v, 6),
+            "delta_frac": round(v - b, 6),
+        }
+    return {
+        "basis": "scope" if (base.get("basis") == "scope"
+                             and variant.get("basis") == "scope")
+        else "opname-heuristic",
+        "phases": out,
+    }
+
+
+def describe_diff(label: str, diff: Optional[dict],
+                  min_delta: float = 0.01) -> list:
+    """Human lines for a lever diff — one per phase whose share moved
+    by at least ``min_delta`` ("<label> cut geometry share from 31.2%
+    to 12.4%")."""
+    if not diff:
+        return []
+    lines = []
+    for name, d in sorted(diff["phases"].items(),
+                          key=lambda kv: kv[1]["delta_frac"]):
+        delta = d["delta_frac"]
+        if abs(delta) < min_delta:
+            continue
+        verb = "cut" if delta < 0 else "raised"
+        lines.append(
+            "%s %s %s share from %.1f%% to %.1f%%" % (
+                label, verb, name,
+                100.0 * d["base_frac"], 100.0 * d["variant_frac"]))
+    return lines
+
+
+# -- /metrics exposition ---------------------------------------------------
+
+
+def publish_phase_gauges(registry, doc: Optional[dict]) -> None:
+    """Surface an attribution doc as ``device.phase.*`` gauges on a
+    metrics registry (obs/metrics.py), where the live ``/metrics``
+    endpoint and RunReport's metrics dump pick them up.  No-op on
+    None/unavailable docs."""
+    if registry is None or not isinstance(doc, dict):
+        return
+    if doc.get("basis") == "unavailable":
+        return
+    registry.gauge("device.phase.total_s").set(doc.get(
+        "total_device_s", 0.0))
+    for name, d in (doc.get("phases") or {}).items():
+        registry.gauge(f"device.phase.{name}.frac").set(d.get("frac", 0.0))
+        registry.gauge(f"device.phase.{name}.seconds").set(
+            d.get("seconds", 0.0))
+    registry.gauge("device.phase.unattributed.frac").set(
+        doc.get("unattributed_frac", 0.0))
+
+
+# -- validation ------------------------------------------------------------
+
+
+def validate_attribution_section(sec) -> list:
+    """Schema errors of a RunReport ``attribution`` section (empty list
+    == valid).  Checks the fractions-sum invariant: phase fractions
+    plus the unattributed residual must cover total time to within
+    rounding (<= 1 + eps each way)."""
+    errors: list = []
+    if not isinstance(sec, dict):
+        return [f"attribution: expected dict, got {type(sec).__name__}"]
+    basis = sec.get("basis")
+    if basis not in BASES:
+        errors.append(f"attribution.basis: {basis!r} not in {BASES}")
+    for key in ("total_device_s", "unattributed_s", "unattributed_frac"):
+        v = sec.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            errors.append(f"attribution.{key}: non-negative number "
+                          f"required, got {v!r}")
+    n_events = sec.get("n_events")
+    if not isinstance(n_events, int) or isinstance(n_events, bool) \
+            or n_events < 0:
+        errors.append(f"attribution.n_events: non-negative int required, "
+                      f"got {n_events!r}")
+    phases = sec.get("phases")
+    if not isinstance(phases, dict):
+        errors.append(f"attribution.phases: dict required, "
+                      f"got {type(phases).__name__}")
+        return errors
+    frac_sum = 0.0
+    for name, d in phases.items():
+        if not isinstance(d, dict):
+            errors.append(f"attribution.phases[{name!r}]: dict required")
+            continue
+        for key in ("seconds", "frac"):
+            v = d.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                errors.append(f"attribution.phases[{name!r}].{key}: "
+                              f"non-negative number required, got {v!r}")
+        frac = d.get("frac")
+        if isinstance(frac, (int, float)) and not isinstance(frac, bool):
+            if frac > 1 + 1e-6:
+                errors.append(f"attribution.phases[{name!r}].frac: "
+                              f"{frac} > 1")
+            frac_sum += float(frac)
+    uf = sec.get("unattributed_frac")
+    if isinstance(uf, (int, float)) and not isinstance(uf, bool):
+        total = frac_sum + float(uf)
+        if total > 1 + 1e-3:
+            errors.append(f"attribution: phase fractions + unattributed "
+                          f"residual sum to {total:.6f} > 1")
+    return errors

@@ -106,6 +106,21 @@ _GEOM_STRIDE_FACTORS = {
     "60": (0.70, 1.0),
 }
 
+#: which semantic phases (obs/attribution.py PHASES) each static-v1
+#: factor axis claims to scale.  Used by :func:`model_error_doc` to
+#: check a factor against the *measured* device-time share of its
+#: phase: a factor promising a big flop cut on an axis whose phase is
+#: 2% of device time cannot move the total — the phase share bounds
+#: the achievable effect (Amdahl).  block_impl restructures the whole
+#: loop rather than one phase, so it maps to no phase.
+_FACTOR_PHASES = {
+    "block_impl": (),
+    "compute_dtype": ("physics", "csi"),
+    "kernel_impl": ("geometry", "physics"),
+    "rng_batch": ("rng",),
+    "geom_stride": ("geometry",),
+}
+
 
 def _resolve(value: Optional[str], default: str) -> str:
     return default if value in (None, "", "auto") else str(value)
@@ -152,7 +167,8 @@ def cost_doc(*, site_s_per_s: Optional[float],
              geom_stride=None,
              device_kind: Optional[str] = None,
              measured_flops_per_site_s: Optional[float] = None,
-             measured_bytes_per_site_s: Optional[float] = None) -> dict:
+             measured_bytes_per_site_s: Optional[float] = None,
+             phase_fractions: Optional[dict] = None) -> dict:
     """The RunReport ``cost`` section (v10; v11 adds the rng_batch /
     geom_stride axes): static model × measured rate (→ achieved
     GFLOP/s, GB/s, north-star fraction), plus roofline fractions when
@@ -167,7 +183,12 @@ def cost_doc(*, site_s_per_s: Optional[float],
     ``basis: "measured"`` appear with NO manual plumbing on every run
     that warmed the compile cache.  Under a measured basis the doc also
     carries the ``model_error`` sub-doc (:func:`model_error_doc`):
-    each static-v1 factor priced against the measurement."""
+    each static-v1 factor priced against the measurement.
+
+    ``phase_fractions`` — optional measured per-phase device-time
+    shares (obs/attribution.py ``phase_fractions``); when present the
+    ``model_error`` factor rows also carry the measured share of the
+    phase each axis claims to scale (v15)."""
     doc = model_cost(block_impl, compute_dtype, kernel_impl,
                      rng_batch, geom_stride)
     if measured_flops_per_site_s is None and \
@@ -196,7 +217,8 @@ def cost_doc(*, site_s_per_s: Optional[float],
     doc["basis"] = "measured" if measured_flops_per_site_s else "model"
     if doc["basis"] == "measured":
         doc["model_error"] = model_error_doc(
-            doc, measured_flops_per_site_s, measured_bytes_per_site_s)
+            doc, measured_flops_per_site_s, measured_bytes_per_site_s,
+            phase_fractions=phase_fractions)
     if site_s_per_s:
         rate = float(site_s_per_s)
         doc["site_s_per_s"] = round(rate, 1)
@@ -216,7 +238,8 @@ def cost_doc(*, site_s_per_s: Optional[float],
 
 def model_error_doc(doc: dict,
                     measured_flops_per_site_s: Optional[float],
-                    measured_bytes_per_site_s: Optional[float]) -> dict:
+                    measured_bytes_per_site_s: Optional[float],
+                    phase_fractions: Optional[dict] = None) -> dict:
     """Price each static-v1 factor against measurement — ROADMAP item
     2's "say which factor model terms were wrong", computable only
     under a measured basis.
@@ -227,7 +250,15 @@ def model_error_doc(doc: dict,
     the static table actually used and the *implied* factor — the
     value that axis would need for the model to match measurement if
     IT alone absorbed the whole error.  An implied factor far from its
-    table entry on exactly one axis names the term to re-anchor."""
+    table entry on exactly one axis names the term to re-anchor.
+
+    ``phase_fractions`` (v15, optional) — measured per-phase
+    device-time shares from a scoped trace (obs/attribution.py).  When
+    present, each factor row also carries ``phases`` (the semantic
+    phases that axis claims to scale, :data:`_FACTOR_PHASES`) and
+    ``measured_phase_frac`` (the summed measured share of those
+    phases) — the Amdahl bound on how much of the device time that
+    factor can actually move."""
     out = {}
     sf = float(doc["flops_per_site_s"])
     fr = (float(measured_flops_per_site_s) / sf
@@ -256,6 +287,12 @@ def model_error_doc(doc: dict,
             row["implied_flops_factor"] = round(f * fr, 4)
         if br is not None:
             row["implied_bytes_factor"] = round(b * br, 4)
+        if phase_fractions:
+            phases = _FACTOR_PHASES.get(axis, ())
+            row["phases"] = list(phases)
+            row["measured_phase_frac"] = round(
+                sum(float(phase_fractions.get(p, 0.0)) for p in phases),
+                4)
         factors[axis] = row
     out["factors"] = factors
     return out
@@ -344,6 +381,18 @@ def validate_cost(doc) -> list:
                             errors.append(
                                 f"cost.model_error.factors.{axis}."
                                 f"{key}: expected number")
+                    # v15 phase-check keys — optional, so v14
+                    # documents keep validating
+                    if "phases" in row and \
+                            not isinstance(row["phases"], list):
+                        errors.append(
+                            f"cost.model_error.factors.{axis}."
+                            "phases: expected list")
+                    if "measured_phase_frac" in row and not isinstance(
+                            row["measured_phase_frac"], (int, float)):
+                        errors.append(
+                            f"cost.model_error.factors.{axis}."
+                            "measured_phase_frac: expected number")
     frac = doc.get("north_star_frac")
     if isinstance(frac, (int, float)) and frac < 0:
         errors.append(f"cost.north_star_frac: negative ({frac})")

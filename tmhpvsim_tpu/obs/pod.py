@@ -41,11 +41,7 @@ never perturbs the compiled graph.
 
 from __future__ import annotations
 
-import glob
-import gzip
-import json
 import logging
-import os
 import statistics
 import threading
 import time
@@ -307,55 +303,29 @@ def comm_split(log_dir: str) -> Optional[dict]:
 
     None when the directory holds no parsable trace or no XLA op events
     — callers treat that as "no attribution available", never an error.
+
+    The event walk lives in ``obs.attribution.iter_xla_op_events`` —
+    this two-bucket split is the degenerate case of that module's
+    phase-level taxonomy (and inherits its plain-``.trace.json``
+    fixture support alongside the profiler's gzip exports).
     """
-    paths = sorted(glob.glob(os.path.join(
-        log_dir, "**", "*.trace.json.gz"), recursive=True))
-    if not paths:
-        return None
+    # lazy: attribution imports this module's filters at import time
+    from tmhpvsim_tpu.obs.attribution import iter_xla_op_events
+
     coll_us = 0.0
     comp_us = 0.0
     n_events = 0
     n_coll = 0
     by_coll: dict = {}
-    for path in paths:
-        try:
-            with gzip.open(path, "rt", encoding="utf-8",
-                           errors="replace") as f:
-                trace = json.load(f)
-        except (OSError, json.JSONDecodeError, EOFError) as e:
-            logger.warning("unparsable device trace %s: %s", path, e)
-            continue
-        events = trace.get("traceEvents") or []
-        proc_names: dict = {}
-        thread_names: dict = {}
-        for ev in events:
-            if ev.get("ph") != "M":
-                continue
-            args = ev.get("args") or {}
-            if ev.get("name") == "process_name":
-                proc_names[ev.get("pid")] = str(args.get("name", ""))
-            elif ev.get("name") == "thread_name":
-                thread_names[(ev.get("pid"), ev.get("tid"))] = \
-                    str(args.get("name", ""))
-        for ev in events:
-            if ev.get("ph") != "X":
-                continue
-            dur = ev.get("dur")
-            if not isinstance(dur, (int, float)) or dur <= 0:
-                continue
-            name = str(ev.get("name", ""))
-            thread = thread_names.get((ev.get("pid"), ev.get("tid")), "")
-            process = proc_names.get(ev.get("pid"), "")
-            if not _is_xla_op(name, thread, process):
-                continue
-            n_events += 1
-            if is_collective(name):
-                n_coll += 1
-                coll_us += dur
-                base = name.split(".", 1)[0]
-                by_coll[base] = by_coll.get(base, 0.0) + dur
-            else:
-                comp_us += dur
+    for name, _hlo_op, dur in iter_xla_op_events(log_dir):
+        n_events += 1
+        if is_collective(name):
+            n_coll += 1
+            coll_us += dur
+            base = name.split(".", 1)[0]
+            by_coll[base] = by_coll.get(base, 0.0) + dur
+        else:
+            comp_us += dur
     total_us = coll_us + comp_us
     if n_events == 0 or total_us <= 0:
         return None

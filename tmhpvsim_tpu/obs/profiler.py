@@ -185,6 +185,29 @@ class BlockTimer:
         return out
 
 
+#: scope-name prefix marking a semantic phase in HLO ``op_name``
+#: metadata; obs/attribution.py keys on it when bucketing device time
+PHASE_PREFIX = "ph__"
+
+
+def phase_scope(name: str):
+    """In-graph semantic-phase scope: a ``jax.named_scope`` whose name
+    (``ph__<name>``) survives lowering into every enclosed HLO op's
+    ``op_name`` metadata, where obs/attribution.py can bucket device
+    time by phase.  Unlike :func:`annotate` (a host-side span around a
+    dispatch) this is TRACE-time scoping — it must wrap the traced
+    computation itself and it changes lowered-text metadata, which is
+    why the engine only enters it when ``SimConfig.phase_obs`` is on
+    (off stays byte-identical HLO).  Degrades to a no-op without jax.
+    """
+    try:
+        import jax
+
+        return jax.named_scope(PHASE_PREFIX + name)
+    except Exception:  # no jax — host-side callers still compose
+        return contextlib.nullcontext()
+
+
 @contextlib.contextmanager
 def annotate(name: str):
     """Host-side ``jax.profiler.TraceAnnotation`` span (a named region in

@@ -106,9 +106,20 @@ logger = logging.getLogger(__name__)
 #: measured-vs-static flops/bytes ratios and per-factor implied
 #: corrections, present only under ``basis: "measured"``.  All
 #: additive — unsharded/off runs omit the section (None).
+#: v15: adds the optional ``attribution`` section (semantic phase
+#: attribution, obs/attribution.py ``attribute``): per-phase device-time
+#: split from a scoped trace — ``basis`` ("scope" when ph__* phase
+#: scopes mapped the ops, "opname-heuristic" when only op-name
+#: heuristics applied, "unavailable" when the trace carried nothing
+#: attributable), ``total_device_s``, per-phase ``seconds``/``frac``,
+#: and the ``unattributed`` residual.  The ``cost`` section's
+#: ``model_error`` sub-doc gains optional per-axis ``phases`` /
+#: ``measured_phase_frac`` keys checking each static-v1 factor axis
+#: against the measured share of the phase it claims to scale.  All
+#: additive — runs without ``phase_obs`` omit the section (None).
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 14
+REPORT_SCHEMA_VERSION = 15
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -143,6 +154,7 @@ _TOP_SCHEMA = {
     "cost": (False, _OPT_DICT),
     "mesh": (False, _OPT_DICT),
     "pod": (False, _OPT_DICT),
+    "attribution": (False, _OPT_DICT),
 }
 
 _DEVICE_SCHEMA = {
@@ -317,6 +329,12 @@ def validate_report(doc) -> dict:
         errors = validate_pod_section(doc["pod"])
         if errors:
             raise ValueError("run report pod: " + "; ".join(errors))
+    if isinstance(doc.get("attribution"), dict):
+        from tmhpvsim_tpu.obs.attribution import validate_attribution_section
+
+        errors = validate_attribution_section(doc["attribution"])
+        if errors:
+            raise ValueError("run report attribution: " + "; ".join(errors))
     try:
         json.dumps(doc)
     except (TypeError, ValueError) as e:
@@ -642,6 +660,11 @@ class RunReport:
         #: ``obs.pod.PodMonitor.doc()`` — per-host heartbeat rows, skew
         #: stats, straggler counts, collective-vs-compute comm_frac
         self.pod: Optional[dict] = None
+        #: phase-attribution section (schema v15): set from
+        #: ``obs.attribution.attribute`` when a phase-scoped device
+        #: trace was captured — per-phase device seconds/fractions plus
+        #: the unattributed residual
+        self.attribution: Optional[dict] = None
 
     def set_timing(self, timer_summary: dict) -> None:
         """Adopt a ``BlockTimer.summary()`` dict as the timing section."""
@@ -747,6 +770,7 @@ class RunReport:
             "cost": self.cost,
             "mesh": self.mesh,
             "pod": self.pod,
+            "attribution": self.attribution,
         }
         return validate_report(out) if validate else out
 

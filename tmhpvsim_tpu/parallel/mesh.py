@@ -320,7 +320,9 @@ class ShardedSimulation(Simulation):
 
         def step(state, inputs, acc):
             state, acc, ta = inner(state, inputs, acc)
-            return state, acc, distributed.psum_telemetry(ta, self._axis)
+            with self._phase("collectives"):
+                return (state, acc,
+                        distributed.psum_telemetry(ta, self._axis))
 
         spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
@@ -339,7 +341,8 @@ class ShardedSimulation(Simulation):
 
         def fold(meter, pv, t):
             ta = self._wide_telemetry(meter, pv, t)
-            return distributed.psum_telemetry(ta, self._axis)
+            with self._phase("collectives"):
+                return distributed.psum_telemetry(ta, self._axis)
 
         mapped = shard_map(
             fold, mesh=self.mesh,
@@ -362,7 +365,8 @@ class ShardedSimulation(Simulation):
 
         def step(state, inputs, acc):
             state, acc, fa = inner(state, inputs, acc)
-            return state, acc, distributed.psum_fleet(fa, self._axis)
+            with self._phase("collectives"):
+                return state, acc, distributed.psum_fleet(fa, self._axis)
 
         spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
@@ -383,9 +387,10 @@ class ShardedSimulation(Simulation):
 
         def step(state, inputs, acc):
             state, acc, ta, fa = inner(state, inputs, acc)
-            return (state, acc,
-                    distributed.psum_telemetry(ta, self._axis),
-                    distributed.psum_fleet(fa, self._axis))
+            with self._phase("collectives"):
+                return (state, acc,
+                        distributed.psum_telemetry(ta, self._axis),
+                        distributed.psum_fleet(fa, self._axis))
 
         spec_c, spec_r = P(self._axis), P()
         mapped = shard_map(
@@ -407,13 +412,15 @@ class ShardedSimulation(Simulation):
             # the accumulator are shared scatter targets and psum-merge
             def fold(meter, pv, t, cohort):
                 fa = self._wide_fleet(meter, pv, t, cohort)
-                return distributed.psum_fleet(fa, self._axis)
+                with self._phase("collectives"):
+                    return distributed.psum_fleet(fa, self._axis)
 
             in_specs = (P(self._axis), P(self._axis), P(), P(self._axis))
         else:
             def fold(meter, pv, t):
                 fa = self._wide_fleet(meter, pv, t)
-                return distributed.psum_fleet(fa, self._axis)
+                with self._phase("collectives"):
+                    return distributed.psum_fleet(fa, self._axis)
 
             in_specs = (P(self._axis), P(self._axis), P())
 
@@ -436,8 +443,9 @@ class ShardedSimulation(Simulation):
 
         def fn(state, inputs):
             state, m_sum, p_sum = series(state, inputs)
-            return (state, jax.lax.psum(m_sum, self._axis),
-                    jax.lax.psum(p_sum, self._axis))
+            with self._phase("collectives"):
+                return (state, jax.lax.psum(m_sum, self._axis),
+                        jax.lax.psum(p_sum, self._axis))
 
         mapped = shard_map(
             fn, mesh=self.mesh,
@@ -456,8 +464,9 @@ class ShardedSimulation(Simulation):
         and ``run_ensemble`` runs sharded unchanged."""
 
         def ens(meter, pv):
-            m_sum = jax.lax.psum(meter.sum(axis=0), self._axis)
-            p_sum = jax.lax.psum(pv.sum(axis=0), self._axis)
+            with self._phase("collectives"):
+                m_sum = jax.lax.psum(meter.sum(axis=0), self._axis)
+                p_sum = jax.lax.psum(pv.sum(axis=0), self._axis)
             return m_sum, p_sum
 
         mapped = shard_map(
@@ -491,13 +500,14 @@ class ShardedSimulation(Simulation):
                 st, a = out[0], out[1]
                 extras = []
                 idx = 2
-                if tel:
-                    extras.append(
-                        distributed.psum_telemetry(out[idx], self._axis))
-                    idx += 1
-                if fleet:
-                    extras.append(
-                        distributed.psum_fleet(out[idx], self._axis))
+                with self._phase("collectives"):
+                    if tel:
+                        extras.append(distributed.psum_telemetry(
+                            out[idx], self._axis))
+                        idx += 1
+                    if fleet:
+                        extras.append(distributed.psum_fleet(
+                            out[idx], self._axis))
                 if extras:
                     return (st, a), (a,) + tuple(extras)
                 return (st, a), a
@@ -531,8 +541,9 @@ class ShardedSimulation(Simulation):
             def body(st, x):
                 st, a, b = fn(st, self._merge_inputs(x, const))
                 if series:
-                    a = jax.lax.psum(a, self._axis)
-                    b = jax.lax.psum(b, self._axis)
+                    with self._phase("collectives"):
+                        a = jax.lax.psum(a, self._axis)
+                        b = jax.lax.psum(b, self._axis)
                 return st, (a, b)
 
             state, (a_k, b_k) = jax.lax.scan(body, state, xs)

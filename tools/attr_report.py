@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Validate + pretty-print the ``attribution`` section of run reports.
+
+Accepts any mix of the shapes the repo's tooling writes (the same
+contract as tools/pod_report.py):
+
+* a bare RunReport JSON (``kind == "tmhpvsim_tpu.run_report"``);
+* a bench doc — one JSON object with an embedded ``run_report`` key,
+  in particular the ``bench.py --attr DIR`` artifact, whose per-variant
+  ``variants.<name>.attribution`` docs are checked too;
+* a JSONL stream of either (bench.py batteries append one doc per
+  phase: SWEEP_r05.jsonl and friends).
+
+Every attribution section found (schema v15, obs/attribution.py
+``attribute``) is checked with ``validate_attribution_section`` —
+basis membership, non-negative seconds, fraction ranges, the
+fractions-sum-plus-residual-≤-1 invariant — and printed as a
+one-glance phase line:
+
+    ATTR.json[run_report]: attribution scope 0.055s — markov 47.8%,
+      physics 34.0%, geometry 13.0% (+2 more), unattributed 0.9%
+
+Exit code 0 when every *present* attribution section validates —
+reports without one (pre-v15 documents, phase_obs off) are fine and
+just noted, which is how ``run_tpu_round5b.sh`` consumes this
+non-fatally after each bench doc.  Nonzero means a malformed section:
+the attribution plumbing wrote something ``attribute`` never emits.
+
+The only repo import is ``obs.attribution`` (pure stdlib at import
+time): runs anywhere the repo checks out, no jax required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repo-root import without installation (the tools/ scripts' pattern)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmhpvsim_tpu.obs.attribution import (  # noqa: E402
+    validate_attribution_section,
+)
+
+REPORT_KIND = "tmhpvsim_tpu.run_report"
+
+
+def print_attribution(sec: dict, label: str) -> None:
+    basis = sec.get("basis")
+    line = f"{label}: attribution {basis}"
+    if basis == "unavailable":
+        print(line + " (trace carried nothing attributable)")
+        return
+    total = sec.get("total_device_s")
+    if isinstance(total, (int, float)):
+        line += f" {total:.3f}s"
+    phases = sec.get("phases") or {}
+    parts = [f"{name} {100.0 * p.get('frac', 0.0):.1f}%"
+             for name, p in list(phases.items())[:3]]
+    if len(phases) > 3:
+        parts.append(f"(+{len(phases) - 3} more)")
+    uf = sec.get("unattributed_frac")
+    if isinstance(uf, (int, float)):
+        parts.append(f"unattributed {100.0 * uf:.1f}%")
+    if parts:
+        line += " — " + ", ".join(parts)
+    print(line)
+
+
+def _iter_docs(path: str):
+    """Parsed JSON documents in ``path``: one whole-file document, or
+    one per line (bench batteries write JSONL)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        yield json.loads(text)
+        return
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            yield json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+
+
+def _extract_sections(doc):
+    """(label_suffix, attribution_section) pairs in one parsed doc."""
+    if not isinstance(doc, dict):
+        return
+    if doc.get("kind") == REPORT_KIND:
+        if doc.get("attribution") is not None:
+            yield "", doc["attribution"]
+        return
+    if "parsed" in doc and "cmd" in doc:   # driver round wrapper
+        doc = doc.get("parsed") or {}
+    label = doc.get("phase") or doc.get("variant") or doc.get("config")
+    suffix = f"[{label}]" if label else ""
+    # the --attr artifact: one attribution doc per traced variant
+    variants = doc.get("variants")
+    if isinstance(variants, dict):
+        for name, v in variants.items():
+            sec = isinstance(v, dict) and v.get("attribution")
+            if isinstance(sec, dict):
+                yield f"{suffix}[{name}]", sec
+    rep = doc.get("run_report")
+    if isinstance(rep, dict) and rep.get("attribution") is not None:
+        yield f"{suffix}[run_report]" if suffix else "[run_report]", \
+            rep["attribution"]
+
+
+def check_file(path: str, quiet: bool = False) -> bool:
+    """Validate (and print) every attribution section in one file; True
+    when all present sections pass.  A file with none passes
+    trivially."""
+    name = os.path.basename(path)
+    try:
+        docs = list(_iter_docs(path))
+    except OSError as e:
+        print(f"{name}: UNREADABLE ({e})", file=sys.stderr)
+        return False
+    found = 0
+    ok = True
+    for doc in docs:
+        for suffix, sec in _extract_sections(doc):
+            found += 1
+            errors = validate_attribution_section(sec)
+            if errors:
+                ok = False
+                print(f"{name}{suffix}: INVALID attribution section "
+                      f"({len(errors)} error(s))", file=sys.stderr)
+                for e in errors[:10]:
+                    print(f"  {e}", file=sys.stderr)
+                if len(errors) > 10:
+                    print(f"  ... and {len(errors) - 10} more",
+                          file=sys.stderr)
+            elif not quiet:
+                print_attribution(sec, f"{name}{suffix}")
+    if not found and not quiet:
+        print(f"{name}: no attribution section (phase_obs off, no "
+              f"scoped trace, or pre-v15 report)")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + pretty-print RunReport attribution "
+                    "sections (bare reports, bench docs, or JSONL of "
+                    "either)")
+    ap.add_argument("files", nargs="+", help="report/bench files to check")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the phase lines (errors still print)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for path in args.files:
+        ok = check_file(path, quiet=args.quiet) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,10 +40,16 @@ fraction when the chip's peaks are known) — and the regression-gate
 verdict reports the newest round's roofline fraction alongside the
 steady-wall comparison.  The v13 ``mesh`` section (and ``bench.py
 --hosts`` artifacts) adds ``mesh``/``hosts`` columns — the device-mesh
-shape and process count.  A round's north-star fraction always comes
-from its OWN top-level headline; a cpu-fallback doc's embedded
-``last_tpu_headline`` is a prior round's copy, flagged in the note
-column and never promoted into the row (the BENCH_r05 stale-0.183
+shape and process count.  The v15 ``attribution`` section adds a
+``phases`` column — the dominant semantic phase and its device-time
+share from the scoped-trace split (pre-v15 docs render ``-``) — and
+every row whose headline is a cpu-fallback artifact (``"platform":
+"cpu-fallback"`` or ``salvaged_after_tpu_failure``) carries an
+explicit ``fallback`` marker in the note column, so a salvaged round
+can never be misread as a TPU number.  A round's north-star fraction
+always comes from its OWN top-level headline; a cpu-fallback doc's
+embedded ``last_tpu_headline`` is a prior round's copy, flagged in the
+note column and never promoted into the row (the BENCH_r05 stale-0.183
 trap).  ``--json`` emits the rows + gate verdict as one JSON document
 for machine consumers.
 
@@ -231,6 +237,53 @@ def _pod_fields(doc) -> tuple:
     return (float(cf) if isinstance(cf, (int, float)) else None, err)
 
 
+def _attr_fields(doc) -> str | None:
+    """Dominant-phase cell ("markov:48%") from a v15 ``attribution``
+    section — the bare RunReport's, the embedded run_report's, or a
+    ``bench.py --attr`` artifact's baseline variant.  Pre-v15 documents
+    and basis-``unavailable`` sections (trace carried no scope
+    metadata) read as None and render ``-``."""
+    sec = None
+    if doc.get("kind") == REPORT_KIND:
+        sec = doc.get("attribution")
+    else:
+        rep = doc.get("run_report")
+        if isinstance(rep, dict):
+            sec = rep.get("attribution")
+        if not isinstance(sec, dict):
+            variants = doc.get("variants")
+            if isinstance(variants, dict):
+                base = variants.get(doc.get("baseline"))
+                if isinstance(base, dict):
+                    sec = base.get("attribution")
+    if not isinstance(sec, dict) or sec.get("basis") == "unavailable":
+        return None
+    phases = sec.get("phases")
+    if not isinstance(phases, dict) or not phases:
+        return None
+    name, p = max(
+        phases.items(),
+        key=lambda kv: kv[1].get("frac", 0.0) if isinstance(kv[1], dict)
+        else 0.0)
+    frac = p.get("frac") if isinstance(p, dict) else None
+    if not isinstance(frac, (int, float)):
+        return None
+    return f"{name}:{frac * 100:.0f}%"
+
+
+def _mark_fallback(row: dict, doc: dict) -> None:
+    """Attach the explicit ``fallback`` marker to a row whose headline
+    is a CPU-fallback artifact — ``"platform": "cpu-fallback"`` or the
+    watchdog-salvage flag ``salvaged_after_tpu_failure`` (bench.py sets
+    both on a salvaged round).  The marker leads the note column so it
+    survives next to the stale-embedded-headline flag."""
+    if row.get("platform") == "cpu-fallback" \
+            or doc.get("salvaged_after_tpu_failure"):
+        row["fallback"] = True
+        note = row.get("note")
+        row["note"] = f"fallback; {note}" if note else "fallback"
+
+
 def _stale_embedded_note(doc: dict) -> str | None:
     """A cpu-fallback headline carries the newest REAL-TPU headline as
     ``last_tpu_headline`` evidence (bench.py _last_tpu_evidence).  That
@@ -266,6 +319,7 @@ def normalize(path: str) -> dict:
            "roofline_frac_vpu": None, "fleet_sites": None,
            "fleet_ratio": None, "mesh": None, "hosts": None,
            "comm_frac": None, "cost_err_pct": None,
+           "attr": None, "fallback": False,
            "failed": True}
     try:
         with open(path) as f:
@@ -320,7 +374,9 @@ def normalize(path: str) -> dict:
             fleet_sites=fs, fleet_ratio=fr,
             mesh=mesh, hosts=hosts,
             comm_frac=cf, cost_err_pct=cerr,
+            attr=_attr_fields(doc),
         )
+        _mark_fallback(row, doc)
         return row
 
     # headline docs, serve-only artifacts (bench.py --serve writes no
@@ -359,10 +415,12 @@ def normalize(path: str) -> dict:
             fleet_sites=fs, fleet_ratio=fr,
             mesh=mesh, hosts=hosts,
             comm_frac=cf, cost_err_pct=cerr,
+            attr=_attr_fields(doc),
         )
         stale = _stale_embedded_note(doc)
         if stale:
             row["note"] = stale
+        _mark_fallback(row, doc)
         return row
 
     row["note"] = "unrecognised document shape"
@@ -485,7 +543,7 @@ def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
             "cdt", "kimpl", "rb", "gs", "prec", "fleet", "cost",
-            "mesh", "hosts", "comm%", "cost-err", "note")
+            "mesh", "hosts", "comm%", "cost-err", "phases", "note")
     table = [cols]
     for r in rows:
         ovh = r.get("overhead_pct")
@@ -509,6 +567,7 @@ def print_table(rows: list) -> None:
             "-" if r.get("hosts") is None else str(r["hosts"]),
             "-" if cf is None else f"{cf * 100:.1f}",
             "-" if cerr is None else f"{cerr:+.1f}%",
+            r.get("attr") or "-",
             r.get("note", ""),
         ))
     widths = [max(len(str(line[i])) for line in table)
