@@ -2159,6 +2159,236 @@ def serve_bench(clients: int, requests_per_client: int) -> None:
     print(json.dumps(doc), flush=True)
 
 
+def serve_fleet_bench(n_workers: int, requests_per_client: int) -> None:
+    """Horizontally-scaled serving artifact (serve/fleet.py): the SAME
+    sustained load against (A) the single-worker window batcher — the
+    serving tier as of the first serve artifact — and (B) a fleet of
+    ``n_workers`` continuous-batching warm workers behind the
+    shard-affinity router.  Reports the sustained-throughput speedup at
+    the client-observed latency quantiles, the per-worker occupancy
+    split, and the schema-v16 ``serving.fleet`` RunReport section.
+
+    The load is horizon-mixed (75 % one-block, 25 % full-horizon
+    requests): exactly the mix where the window batcher pays the
+    longest row's blocks for every row in the batch and continuous
+    batching retires the short rows after one block and backfills their
+    slots from the queue.  Both phases run the IDENTICAL worker
+    template (same buckets, same physics) oversubscribed 2x per worker
+    slot, so the only variables are the scheduler and the fleet.
+    Replies are keyed by (client, request) and phase B must be
+    bit-identical to phase A — the fleet must scale throughput, never
+    perturb physics."""
+    import asyncio
+
+    platform, fallback = _probe_or_fallback()
+    from tmhpvsim_tpu.obs import metrics as obs_metrics
+    from tmhpvsim_tpu.runtime import faults
+    from tmhpvsim_tpu.serve.fleet import FleetConfig, ServeFleet
+    from tmhpvsim_tpu.serve.server import (ScenarioClient, ScenarioServer,
+                                           ServeConfig)
+
+    faults.install_from_env()
+    if platform == "tpu":
+        n_chains, block_s, n_blocks, unroll = 16384, 1080, 4, 8
+    else:
+        n_chains, block_s, n_blocks, unroll = 64, 60, 4, 1
+    sim = _make_cfg(n_chains, n_blocks, block_s=block_s,
+                    scan_unroll=unroll)
+    # per-worker slot capacity, oversubscribed 6x by the client pool:
+    # sustained saturation — continuous backfill always finds queued
+    # work the moment a short row retires (the occupancy histogram's
+    # right shift), and reply latency is queue-drain dominated, so the
+    # faster tier's p95 is the lower one
+    worker_batch = 16
+    clients = 6 * worker_batch * n_workers
+    total = clients * requests_per_client
+
+    def scenario_for(ci: int, ri: int) -> dict:
+        # 25 % full-horizon, spread across CLIENTS within each round
+        # (ci + ri), so concurrent arrivals are horizon-mixed the way
+        # real traffic is — not phase-locked into homogeneous windows
+        return {"demand_scale": 1.0 + 0.05 * (ci % 64),
+                "weather_bias": 1.0 - 0.02 * (ri % 8),
+                "horizon_s": (n_blocks * block_s
+                              if (ci + ri) % 4 == 3 else block_s)}
+
+    async def load(url: str, exchange: str):
+        """clients x requests_per_client sequential queries; returns
+        (wall_s, client-observed latencies, replies by (ci, ri))."""
+        lats: list = []
+        replies: dict = {}
+
+        async def one_client(ci: int, c: ScenarioClient) -> None:
+            for ri in range(requests_per_client):
+                t0 = time.perf_counter()
+                rep = await c.request(scenario_for(ci, ri),
+                                      mode="reduce", timeout=600.0)
+                lats.append(time.perf_counter() - t0)
+                replies[(ci, ri)] = rep
+
+        clis = [ScenarioClient(url, exchange) for _ in range(clients)]
+        for c in clis:
+            await c.__aenter__()
+        try:
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one_client(i, c)
+                                   for i, c in enumerate(clis)])
+            wall = time.perf_counter() - t0
+        finally:
+            for c in clis:
+                await c.__aexit__(None, None, None)
+        return wall, lats, replies
+
+    def lat_q(lats, q):
+        s = sorted(lats)
+        return s[min(len(s) - 1, int(q * len(s)))] if s else None
+
+    # ---- phase A: single worker, window batching (the reference tier)
+    base_reg = obs_metrics.MetricsRegistry()
+    base_cfg = ServeConfig(sim=sim, url="local://bench-fleet-base",
+                           window_s=0.02, max_batch=worker_batch,
+                           timeout_s=600.0, batching="window")
+
+    async def run_base():
+        server = ScenarioServer(base_cfg, registry=base_reg)
+        await server.start()
+        try:
+            async with ScenarioClient(base_cfg.url,
+                                      base_cfg.exchange) as warm:
+                await warm.request({"horizon_s": n_blocks * block_s},
+                                   timeout=600.0)
+            return await load(base_cfg.url, base_cfg.exchange)
+        finally:
+            server.begin_drain()
+            await server.stop()
+
+    with obs_metrics.use_registry(base_reg):
+        base_wall, base_lats, base_replies = asyncio.run(run_base())
+
+    # ---- phase B: n_workers continuous workers behind the router
+    fleet_reg = obs_metrics.MetricsRegistry()
+    fleet_cfg = FleetConfig(
+        base=ServeConfig(sim=sim, url="local://bench-fleet",
+                         window_s=0.02, max_batch=worker_batch,
+                         timeout_s=600.0, starve_limit=2),
+        n_workers=n_workers, batching="continuous", auto_respawn=False)
+    fleet_holder: dict = {}
+
+    async def run_fleet():
+        fleet = ServeFleet(fleet_cfg, registry=fleet_reg)
+        await fleet.start()
+        try:
+            async with ScenarioClient(fleet_cfg.base.url,
+                                      fleet_cfg.base.exchange) as warm:
+                await warm.request({"horizon_s": n_blocks * block_s},
+                                   timeout=600.0)
+            out = await load(fleet_cfg.base.url, fleet_cfg.base.exchange)
+            fleet_holder["doc"] = fleet.fleet_doc()
+            fleet_holder["snapshots"] = fleet.worker_snapshots()
+            return out
+        finally:
+            await fleet.stop()
+
+    with obs_metrics.use_registry(fleet_reg):
+        fleet_wall, fleet_lats, fleet_replies = asyncio.run(run_fleet())
+    faults.deactivate()
+
+    # ---- bit-identity: same (ci, ri) -> same scenario -> the fleet
+    # reply must equal the single-worker reference bit for bit
+    mismatches = [k for k in base_replies
+                  if base_replies[k].get("result")
+                  != fleet_replies.get(k, {}).get("result")]
+    base_ok = sum(1 for r in base_replies.values() if r.get("ok"))
+    fleet_ok = sum(1 for r in fleet_replies.values() if r.get("ok"))
+
+    def sched_stats(*snaps):
+        """(batches, mean device dispatch ms, mean rows per dispatch)
+        summed across the given registry snapshots."""
+        batches = 0
+        d_sum = d_cnt = 0.0
+        o_sum = o_cnt = 0.0
+        for snap in snaps:
+            batches += snap.get("counters", {}).get(
+                "serve.batches_total", 0)
+            h = snap.get("histograms", {}).get("serve.dispatch_s") or {}
+            d_sum += h.get("sum") or 0.0
+            d_cnt += h.get("count") or 0
+            o = snap.get("histograms", {}).get(
+                "serve.batch_occupancy") or {}
+            o_sum += o.get("sum") or 0.0
+            o_cnt += o.get("count") or 0
+        return (batches,
+                round(1e3 * d_sum / d_cnt, 1) if d_cnt else None,
+                round(o_sum / o_cnt, 2) if o_cnt else None)
+
+    base_batches, base_dms, base_occ = sched_stats(base_reg.snapshot())
+    fleet_batches, fleet_dms, fleet_occ = sched_stats(
+        *[snap for _name, snap in fleet_holder.get("snapshots", [])])
+    base_rps = base_ok / base_wall if base_wall else None
+    fleet_rps = fleet_ok / fleet_wall if fleet_wall else None
+    fdoc = fleet_holder.get("doc") or {}
+    doc = {
+        "artifact": "scenario-serve fleet load",
+        "platform": platform,
+        "workers": n_workers,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "requests": total,
+        "bit_identical": not mismatches,
+        "mismatches": len(mismatches),
+        "baseline": {
+            "mode": "window x1", "ok": base_ok,
+            "wall_s": round(base_wall, 2),
+            "replies_per_s": round(base_rps, 1) if base_rps else None,
+            "reply_p50_ms": round(1e3 * lat_q(base_lats, 0.5), 1),
+            "reply_p95_ms": round(1e3 * lat_q(base_lats, 0.95), 1),
+            "batches": base_batches, "dispatch_ms_mean": base_dms,
+            "occupancy_mean": base_occ,
+        },
+        "fleet": {
+            "mode": f"continuous x{n_workers}", "ok": fleet_ok,
+            "wall_s": round(fleet_wall, 2),
+            "replies_per_s": round(fleet_rps, 1) if fleet_rps else None,
+            "reply_p50_ms": round(1e3 * lat_q(fleet_lats, 0.5), 1),
+            "reply_p95_ms": round(1e3 * lat_q(fleet_lats, 0.95), 1),
+            "batches": fleet_batches, "dispatch_ms_mean": fleet_dms,
+            "occupancy_mean": fleet_occ,
+            "per_worker": [
+                {"name": w["name"], "requests": w["requests"],
+                 "batches": w["batches"],
+                 "backfilled": w["backfilled"],
+                 "occupancy_mean": (round(w["occupancy"]["mean"], 2)
+                                    if w.get("occupancy") else None)}
+                for w in fdoc.get("workers", [])],
+        },
+        # the headline: sustained-throughput ratio fleet vs the
+        # single-worker window tier under the identical load
+        "speedup": (round(fleet_rps / base_rps, 2)
+                    if base_rps and fleet_rps else None),
+        "echo": {"n_chains": n_chains, "block_s": block_s,
+                 "n_blocks": n_blocks, "max_batch": worker_batch,
+                 "window_ms": 20.0, "scan_unroll": unroll,
+                 "starve_limit": 2,
+                 "horizon_mix": f"75% 1-block / 25% {n_blocks}-block"},
+    }
+    try:
+        from tmhpvsim_tpu.obs.report import RunReport
+
+        rep = RunReport("bench.serve-fleet", config=sim)
+        rep.attach_metrics(fleet_reg)
+        rep.attach_fleet_serving(fleet_reg.snapshot(),
+                                 fleet_holder.get("snapshots", []))
+        rep.headline = {"speedup": doc["speedup"],
+                        "fleet_replies_per_s":
+                            doc["fleet"]["replies_per_s"]}
+        doc["run_report"] = rep.doc()
+    except Exception as e:
+        print(f"# run_report build failed (bench.serve-fleet): {e}",
+              file=sys.stderr)
+    _persist_partial({"phase": "serve-fleet", **doc})
+    print(json.dumps(doc), flush=True)
+
+
 #: worker body for --hosts K: one coordinated CPU process per simulated
 #: host (gloo collectives, virtual devices), the same execution model a
 #: TPU pod slice uses — and the same harness pattern as
@@ -2360,6 +2590,15 @@ def main() -> None:
                          "section")
     ap.add_argument("--serve-requests", type=int, metavar="R", default=8,
                     help="requests per client in --serve mode (default 8)")
+    ap.add_argument("--serve-fleet", type=int, metavar="N", default=None,
+                    help="horizontally-scaled serving artifact: the same "
+                         "horizon-mixed load against the single-worker "
+                         "window batcher and against N continuous-"
+                         "batching warm workers behind the shard-"
+                         "affinity router (serve/fleet.py); reports the "
+                         "sustained-throughput speedup, per-worker "
+                         "occupancy and the v16 'serving.fleet' section "
+                         "(4N clients x --serve-requests each)")
     ap.add_argument("--fleet-csv", metavar="PATH", default=None,
                     help="heterogeneous-fleet variant from a site CSV "
                          "(fleet/params.py FleetParams.from_csv): prices "
@@ -2440,6 +2679,8 @@ def main() -> None:
         hosts_bench(args.hosts, args.mesh_scenario)
     elif args.serve is not None:
         serve_bench(args.serve, args.serve_requests)
+    elif args.serve_fleet is not None:
+        serve_fleet_bench(args.serve_fleet, args.serve_requests)
     elif args.fleet_csv is not None or args.fleet_synth is not None:
         fleet_bench(args.fleet_csv, args.fleet_synth, args.fleet_seed)
     else:

@@ -156,6 +156,13 @@ run_json benchmarks/BENCH_config5.json config5   --config 5
 # the doc's run_report carries the v6 'serving' section serve_report.py
 # validates below
 run_json benchmarks/SERVE_r05b.json    serve     --serve 8 --serve-requests 8
+# horizontally-scaled serving point (serve/fleet.py): continuous
+# batching x 4 warm workers behind the shard-affinity router vs the
+# single window worker under the same deep load; the doc's run_report
+# carries the v16 'serving.fleet' section serve_report.py validates
+# below.  Non-fatal like every phase here: run_json logs rc and the
+# battery continues.
+run_json benchmarks/SERVEFLEET_r05b.json servefleet --serve-fleet 4 --serve-requests 8
 echo "--- scaling start $(date -u +%FT%TZ)" >> "$LOG"
 if python bench.py --scaling > benchmarks/SCALING.json.tmp 2>> "$LOG"; then
   mv benchmarks/SCALING.json.tmp benchmarks/SCALING.json
@@ -229,8 +236,10 @@ done
 # scenario-serving sanity (non-fatal), same contract as fleet_report:
 # any doc carrying a RunReport 'serving' section must carry a
 # WELL-FORMED one (obs/report.serving_section shape — counters,
-# occupancy consistency, latency-quantile ordering)
-for bench_doc in benchmarks/SERVE_*.json benchmarks/BENCH_*.json; do
+# occupancy consistency, latency-quantile ordering; v16 adds the
+# 'serving.fleet' router/worker partition the SERVEFLEET doc carries)
+for bench_doc in benchmarks/SERVE_*.json benchmarks/SERVEFLEET_*.json \
+                 benchmarks/BENCH_*.json; do
   [ -f "$bench_doc" ] || continue
   echo "--- serve_report $bench_doc $(date -u +%FT%TZ)" >> "$LOG"
   python tools/serve_report.py "$bench_doc" >> "$LOG" 2>&1 \

@@ -167,6 +167,10 @@ _SLOW_LANE = {
     # tests/test_checkpoint.py)
     "test_two_process_elastic_resume",
     "test_million_site_two_host_elastic",
+    # serving-fleet chaos soak: SIGKILL + respawn + tcp partition over a
+    # 2-worker fleet (~75 s; the fast lane keeps the single-server soak
+    # and the sync-stubbed failover tests in tests/test_router.py)
+    "test_worker_kill_partition_exactly_once_warm_respawn",
 }
 
 

@@ -602,7 +602,7 @@ _SECTION_SINCE = {"telemetry": 2, "streaming": 3, "executor": 4,
 class TestReportSchema:
     def test_v5_round_trips_through_validator(self):
         doc = _risk_doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 16
         assert doc["fleet"]["level"] == "risk"
         validate_report(json.loads(json.dumps(doc)))
 

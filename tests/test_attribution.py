@@ -362,7 +362,7 @@ class TestReportV15:
         sim = Simulation(scfg())
         sim.run_reduced()
         doc = sim.run_report()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 16
         assert doc["attribution"] is None  # no capture ran
         doc["attribution"] = _attr_doc({"markov": 0.6, "physics": 0.3})
         validate_report(json.loads(json.dumps(doc)))
@@ -504,7 +504,7 @@ class TestCaptureEndToEnd:
 class TestAttrReportTool:
     def _report_doc(self, sec):
         return {"kind": "tmhpvsim_tpu.run_report",
-                "schema_version": 15, "attribution": sec}
+                "schema_version": 16, "attribution": sec}
 
     def test_valid_sections_print_and_pass(self, tmp_path, capsys):
         import attr_report

@@ -192,6 +192,164 @@ class TestServeSoak:
 
 
 # ---------------------------------------------------------------------------
+# fleet soak: worker SIGKILL + tcp partition under load, exactly-once
+# replies, warm zero-cold-compile respawn
+# ---------------------------------------------------------------------------
+
+
+class TestFleetChaos:
+    def test_worker_kill_partition_exactly_once_warm_respawn(
+            self, tmp_path):
+        """The serving-fleet soak over tcp://.  A worker SIGKILL under
+        a concurrent burst: the router's health loop sheds the corpse,
+        pending requests re-route to the survivor, every outcome is ok
+        or typed, and no id ever collects two ok replies.  The
+        replacement worker comes up against the warm compile cache
+        with ZERO cold compiles.  A mid-run TCP partition drops a real
+        socket; reconnect-and-resubscribe absorbs it."""
+        from tmhpvsim_tpu.config import SiteGrid
+        from tmhpvsim_tpu.engine import compilecache
+        from tmhpvsim_tpu.serve.fleet import FleetConfig, ServeFleet
+
+        compilecache.configure(str(tmp_path))
+        reg = MetricsRegistry()
+        ok_seen = collections.Counter()
+        sim = scfg(n_chains=2, site_grid=SiteGrid.regular(
+            (45.0, 46.0), (5.0, 6.0), 1, 2))
+
+        async def monitor(url, reply_to):
+            async def run():
+                async with make_transport(url, reply_to) as tx:
+                    async for _t, _v, meta in tx.subscribe(
+                            with_meta=True):
+                        if isinstance(meta, dict) and meta.get("ok"):
+                            ok_seen[meta.get("id")] += 1
+
+            await reconnect_policy(
+                name="fleet.monitor", base_delay_s=0.01,
+                max_delay_s=0.05, registry=reg).call(run)
+
+        async def ask(client, rid, scenario=None, timeout=60.0):
+            for _ in range(5):
+                try:
+                    return await client.request(scenario, rid=rid,
+                                                timeout=timeout)
+                except asyncio.TimeoutError:
+                    continue  # at-least-once: the router dedupes
+            raise AssertionError(f"no reply for {rid}")
+
+        async def settle(fleet, want):
+            for _ in range(150):
+                _ok, detail = fleet.readiness()
+                if detail.get("workers_ready") == want:
+                    return detail
+                await asyncio.sleep(0.1)
+            raise AssertionError(
+                f"ready set never became {want}: {detail}")
+
+        async def main():
+            async with TcpFanoutBroker(port=0) as broker:
+                url = f"tcp://127.0.0.1:{broker.port}"
+                base = ServeConfig(sim=sim, url=url, window_s=0.02,
+                                   batch_sizes=(2,), timeout_s=60.0)
+                fleet = ServeFleet(
+                    FleetConfig(base, n_workers=2,
+                                health_period_s=0.05),
+                    registry=reg)
+                await fleet.start()
+                client = ScenarioClient(url, policy=ResiliencePolicy(
+                    attempts=8, base_delay_s=0.01, max_delay_s=0.05,
+                    name="fleet.request", registry=reg))
+                async with client:
+                    mon = asyncio.create_task(
+                        monitor(url, client.reply_to))
+                    await asyncio.sleep(0.1)
+                    try:
+                        # phase 1: both workers up; shard affinity is
+                        # sticky per site key
+                        p1 = await asyncio.gather(*[
+                            ask(client, f"p1-{i}",
+                                {"site_index": i % 2, "horizon_s": 60})
+                            for i in range(4)])
+                        assert all(r["ok"] for r in p1), p1
+                        by_site = collections.defaultdict(set)
+                        for i, r in enumerate(p1):
+                            by_site[i % 2].add(r["worker"])
+                        assert all(len(ws) == 1
+                                   for ws in by_site.values()), by_site
+                        # phase 2: SIGKILL w0 under a concurrent burst
+                        burst = [
+                            asyncio.create_task(ask(
+                                client, f"p2-{i}",
+                                {"site_index": i % 2,
+                                 "horizon_s": 60}))
+                            for i in range(6)]
+                        await asyncio.sleep(0.02)
+                        await fleet.kill_worker(0)
+                        p2 = await asyncio.gather(*burst)
+                        for meta in p2:
+                            assert meta["ok"] or meta["error"]["code"] \
+                                in ("unavailable", "busy",
+                                    "duplicate"), meta
+                        await settle(fleet, ["w1"])
+                        # the survivor answers every site key now
+                        p3 = await asyncio.gather(*[
+                            ask(client, f"p3-{i}",
+                                {"site_index": i % 2, "horizon_s": 60})
+                            for i in range(2)])
+                        assert all(r["ok"] and r["worker"] == "w1"
+                                   for r in p3), p3
+                        # phase 3: warm respawn — the replacement life
+                        # compiles NOTHING cold (fleet acceptance)
+                        await fleet.respawn_worker(0)
+                        await settle(fleet, ["w0", "w1"])
+                        wc = fleet.workers[0].registry.snapshot()[
+                            "counters"]
+                        assert wc.get("executor.compile_cold_total",
+                                      0) == 0, wc
+                        assert wc["executor.compile_warm_total"] >= 1
+                        # phase 4: a real TCP partition mid-serve
+                        plan = FaultPlan.parse("tcp.partition=raise@n3")
+                        with faults.active(plan):
+                            p4 = await asyncio.gather(*[
+                                ask(client, f"p4-{i}",
+                                    {"site_index": i % 2,
+                                     "horizon_s": 60})
+                                for i in range(4)])
+                        for meta in p4:
+                            assert meta["ok"] or meta["error"]["code"] \
+                                in ("unavailable", "busy",
+                                    "duplicate", "timeout"), meta
+                        await asyncio.sleep(0.3)  # reconnects settle
+                        final = await ask(client, "p5-0",
+                                          {"horizon_s": 60})
+                        assert final["ok"] is True, final
+                        snapshot = dict(ok_seen)
+                    finally:
+                        mon.cancel()
+                        with contextlib.suppress(asyncio.CancelledError,
+                                                 ConnectionError):
+                            await mon
+                doc = fleet.fleet_doc()
+                await fleet.stop(drain_timeout_s=5.0)
+                return snapshot, doc
+
+        with use_registry(reg):
+            snapshot, doc = _run(asyncio.wait_for(main(), timeout=480))
+
+        # exactly-once: zero duplicated ok replies across kill,
+        # re-route, respawn and partition
+        assert snapshot and all(n <= 1 for n in snapshot.values()), \
+            snapshot
+        c = reg.snapshot()["counters"]
+        assert c["faults.injected.tcp.partition"] == 1.0
+        assert c["router.worker_down_total"] >= 1.0
+        # the v16 fleet doc holds its partition invariant end to end
+        assert sum(w["requests"] for w in doc["workers"]) \
+            == doc["router"]["routed"] + doc["router"]["rerouted"]
+
+
+# ---------------------------------------------------------------------------
 # SIGKILL mid-run: --supervise restarts warm, output byte-identical
 # ---------------------------------------------------------------------------
 
@@ -237,7 +395,7 @@ class TestSigkillWarmRecovery:
         assert part.read_bytes() == whole.read_bytes()
 
         doc = validate_report(json.loads(report.read_text()))
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 16
         res = doc["resilience"]
         assert res["resumes"] == 1
         assert res["restarts"] == 1

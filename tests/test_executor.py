@@ -325,7 +325,7 @@ class TestReportSchemaV4:
 
     def test_v4_round_trips_with_executor_section(self):
         doc = self._doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 16
         ex = doc["executor"]
         assert ex["blocks_per_dispatch"] == 2
         assert ex["dispatches"] == 2  # 3 blocks, k=2: mega [0,1] + block 2

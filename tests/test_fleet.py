@@ -627,7 +627,7 @@ def _v12_doc():
 class TestReportV12:
     def test_round_trip(self):
         doc = _v12_doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 16
         assert doc["config"]["fleet"]["n_sites"] == 8
         assert doc["config"]["fleet"]["n_cohorts"] == 3
         assert doc["config"]["fleet"]["digest"] == het_fleet(8).digest()

@@ -293,14 +293,14 @@ class TestCostModel:
 
 class TestReportV10:
     def test_cost_section_round_trips(self):
-        assert REPORT_SCHEMA_VERSION == 15
+        assert REPORT_SCHEMA_VERSION == 16
         rep = RunReport("test")
         rep.cost = obs_cost.cost_doc(
             site_s_per_s=1.2e9, block_impl="scan2",
             compute_dtype="bf16", kernel_impl="table",
             device_kind="TPU v5 lite")
         doc = json.loads(json.dumps(rep.doc()))
-        assert doc["schema_version"] == 15
+        assert doc["schema_version"] == 16
         validate_report(doc)
 
     def test_malformed_cost_section_rejected(self):

@@ -373,7 +373,7 @@ class TestReportV14:
 
     def test_engine_attaches_pod_section(self):
         doc = self._run_doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 16
         pod = doc["pod"]
         assert pod is not None
         assert validate_pod_section(pod) == [], validate_pod_section(pod)

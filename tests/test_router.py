@@ -1,0 +1,441 @@
+"""Shard-affinity fleet router (tmhpvsim_tpu/serve/router.py): the
+consistent-hash ring, per-tenant token buckets, admission control
+(quota / queue-depth shed / draining, all with honest ``retry_after_ms``
+hints), the exactly-once answered-id guard, failover re-routing under
+the re-route budget, and an end-to-end fleet pass over the local broker
+where the per-worker duplicate-id replay LRU backs the router up under
+consistent-hash affinity.
+
+The admission/reply/failover tests drive the router synchronously:
+``_send`` / ``_send_worker`` are replaced with recording stubs so every
+routing decision is observable without a broker or a clock.
+"""
+
+import asyncio
+import collections
+import hashlib
+
+import pytest
+
+from tmhpvsim_tpu.config import SimConfig
+from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
+from tmhpvsim_tpu.serve import schema
+from tmhpvsim_tpu.serve.fleet import FleetConfig, ServeFleet
+from tmhpvsim_tpu.serve.router import (
+    MAX_RETRY_AFTER_MS,
+    HashRing,
+    ScenarioRouter,
+    TokenBucket,
+    WorkerHandle,
+    _stable_hash,
+)
+from tmhpvsim_tpu.serve.server import ScenarioClient, ServeConfig
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def scfg(**kw):
+    base = dict(
+        start="2019-09-05 10:00:00",
+        duration_s=120,
+        n_chains=4,
+        seed=7,
+        block_s=60,
+        dtype="float32",
+        output="reduce",
+        block_impl="scan",
+        scan_unroll=1,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+class TestHashRing:
+    def test_stable_hash_is_md5_prefix(self):
+        for key in ("site:0", "cohort:17", "x"):
+            assert _stable_hash(key) == int.from_bytes(
+                hashlib.md5(key.encode()).digest()[:8], "big")
+
+    def test_preference_is_a_stable_permutation(self):
+        names = [f"w{i}" for i in range(5)]
+        ring = HashRing(names)
+        twin = HashRing(names)  # same names -> same ring, any process
+        for site in range(64):
+            key = f"site:{site}"
+            pref = ring.preference(key)
+            assert sorted(pref) == sorted(names)  # every worker once
+            assert pref == ring.preference(key)   # repeatable
+            assert pref == twin.preference(key)   # instance-independent
+
+    def test_first_choice_spreads_and_survives_unrelated_loss(self):
+        names = [f"w{i}" for i in range(4)]
+        ring = HashRing(names)
+        first = collections.Counter(
+            ring.preference(f"site:{s}")[0] for s in range(256))
+        assert set(first) == set(names)  # no worker starves of keys
+        # a key keeps its worker while that worker stays ready: dropping
+        # ANY other worker never moves it (the failover property the
+        # replay-LRU affinity test below leans on)
+        for s in range(32):
+            pref = ring.preference(f"site:{s}")
+            for dead in names:
+                if dead == pref[0]:
+                    continue
+                alive = [n for n in pref if n != dead]
+                assert alive[0] == pref[0]
+
+
+# ---------------------------------------------------------------------------
+# per-tenant token bucket
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clk = _Clock()
+        b = TokenBucket(rate=2.0, burst=2.0, now=clk)
+        assert b.take() and b.take()
+        assert not b.take()
+        assert b.retry_after_s() == pytest.approx(0.5)
+        clk.t = 0.5  # one token refilled
+        assert b.retry_after_s() == 0.0
+        assert b.take()
+        assert not b.take()
+
+    def test_burst_is_a_cap(self):
+        clk = _Clock()
+        b = TokenBucket(rate=10.0, burst=2.0, now=clk)
+        assert b.take() and b.take()
+        clk.t = 100.0  # a long idle spell never banks > burst tokens
+        assert b.take() and b.take()
+        assert not b.take()
+
+    def test_zero_rate_never_refills(self):
+        b = TokenBucket(rate=0.0, burst=1.0, now=_Clock())
+        assert b.take()
+        assert not b.take()
+        assert b.retry_after_s() == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# routing key extraction
+# ---------------------------------------------------------------------------
+
+
+class TestRoutingKey:
+    def test_site_then_cohort_then_shardless(self):
+        rk = ScenarioRouter.routing_key
+        assert rk({"scenario": {"site_index": 3}}) == "site:3"
+        assert rk({"scenario": {"cohort": 2}}) == "cohort:2"
+        # site wins when both are present (schema rejects that combo
+        # anyway, but the router must not flap between keys)
+        assert rk({"scenario": {"site_index": 1, "cohort": 2}}) == "site:1"
+        assert rk({"scenario": {"site_index": -1, "cohort": -1}}) is None
+        assert rk({"scenario": {}}) is None
+        assert rk({"scenario": None}) is None
+        assert rk({}) is None
+        # bools are not selectors even though bool is an int subtype
+        assert rk({"scenario": {"site_index": True}}) is None
+
+
+# ---------------------------------------------------------------------------
+# admission control (sync: stubbed send paths)
+# ---------------------------------------------------------------------------
+
+
+def make_router(names=("w0", "w1", "w2"), **kw):
+    """A router with every worker ready and the publish paths replaced
+    by recording stubs; returns (router, forwarded, replied, registry)."""
+    reg = MetricsRegistry()
+    handles = [WorkerHandle(n, f"scen.{n}", lambda: (True, {}))
+               for n in names]
+    r = ScenarioRouter("local://router-unit", "scen", handles,
+                       registry=reg, **kw)
+    r._ready = set(names)
+    forwarded, replied = [], []
+    r._send_worker = lambda worker, meta, rid: forwarded.append(
+        (worker, dict(meta)))
+    r._send = lambda exchange, meta: replied.append(
+        (exchange, dict(meta)))
+    return r, forwarded, replied, reg
+
+
+def rmeta(rid, scenario=None, tenant=None):
+    m = schema.request_meta(rid, "rep", "reduce", scenario)
+    if tenant is not None:
+        m["tenant"] = tenant
+    return m
+
+
+class TestRouterAdmission:
+    def test_affinity_same_key_same_worker_and_stamp(self):
+        r, fwd, rep, reg = make_router()
+        for i in range(6):
+            r._handle(rmeta(f"a{i}", {"site_index": 7}))
+        assert not rep
+        workers = {w for w, _ in fwd}
+        assert len(workers) == 1  # shard affinity: one worker owns site 7
+        owner = workers.pop()
+        assert owner == r._ring.preference("site:7")[0]
+        for _, meta in fwd:
+            # the stamp satellite: the forwarded meta names its worker
+            # and redirects the reply to the router's own exchange
+            assert meta["worker"] == owner
+            assert meta["reply_to"] == r.reply_exchange
+        assert reg.snapshot()["counters"]["router.routed_total"] == 6.0
+        # distinct sites spread across the fleet
+        r2, fwd2, _, _ = make_router()
+        for s in range(32):
+            r2._handle(rmeta(f"s{s}", {"site_index": s}))
+        assert len({w for w, _ in fwd2}) == 3
+
+    def test_shardless_falls_back_to_least_loaded(self):
+        r, fwd, rep, _ = make_router()
+        for i in range(6):
+            r._handle(rmeta(f"q{i}"))  # no selector -> no ring key
+        assert not rep
+        loads = collections.Counter(w for w, _ in fwd)
+        assert loads == {"w0": 2, "w1": 2, "w2": 2}
+
+    def test_duplicate_in_flight_id_rejected_not_reforwarded(self):
+        r, fwd, rep, reg = make_router()
+        r._handle(rmeta("dup"))
+        r._handle(rmeta("dup"))
+        assert len(fwd) == 1  # the replay never reaches a second worker
+        assert len(rep) == 1
+        assert rep[0][1]["error"]["code"] == "duplicate"
+        assert reg.snapshot()["counters"]["router.rejected_total"] == 1.0
+
+    def test_quota_busy_carries_refill_hint(self):
+        r, fwd, rep, reg = make_router(quota_rate=1.0, quota_burst=1.0)
+        clk = _Clock()
+        r._buckets["t1"] = TokenBucket(1.0, 1.0, now=clk)
+        r._handle(rmeta("ok", tenant="t1"))
+        r._handle(rmeta("over", tenant="t1"))
+        assert len(fwd) == 1
+        err = rep[0][1]["error"]
+        assert err["code"] == "busy"
+        assert err["retry_after_ms"] == 1001  # (1 token / 1 rps) + 1 ms
+        # quotas are per tenant: another tenant's bucket is untouched
+        r._handle(rmeta("other", tenant="t2"))
+        assert len(fwd) == 2
+        assert reg.snapshot()["counters"][
+            "router.quota_rejected_total"] == 1.0
+
+    def test_inflight_limit_sheds_with_retry_after(self):
+        r, fwd, rep, reg = make_router(inflight_limit=2)
+        for i in range(3):
+            r._handle(rmeta(f"n{i}"))
+        assert len(fwd) == 2
+        err = rep[0][1]["error"]
+        assert err["code"] == "busy"
+        assert 1 <= err["retry_after_ms"] <= MAX_RETRY_AFTER_MS
+        assert reg.snapshot()["counters"]["router.shed_total"] == 1.0
+
+    def test_no_ready_worker_is_unavailable_with_hint(self):
+        r, fwd, rep, _ = make_router()
+        r._ready = set()
+        r._handle(rmeta("x"))
+        assert not fwd
+        err = rep[0][1]["error"]
+        assert err["code"] == "unavailable"
+        assert err["retry_after_ms"] >= 1
+
+    def test_draining_rejects_typed(self):
+        r, fwd, rep, _ = make_router()
+        r.begin_drain()
+        r._handle(rmeta("x"))
+        assert not fwd
+        assert rep[0][1]["error"]["code"] == "draining"
+        ok, detail = r.readiness()
+        assert not ok and detail["draining"]
+
+
+# ---------------------------------------------------------------------------
+# reply path: exactly-once
+# ---------------------------------------------------------------------------
+
+
+def _reply(rid, worker="whoever"):
+    return {"op": schema.OP_REPLY, "id": rid, "ok": True,
+            "result": {"mode": "reduce"}, "worker": worker}
+
+
+class TestRouterReplies:
+    def test_reply_forwarded_once_with_worker_stamp(self):
+        r, fwd, rep, reg = make_router()
+        r._handle(rmeta("r1"))
+        owner = fwd[0][0]
+        r._on_reply(_reply("r1"))
+        assert len(rep) == 1
+        exchange, meta = rep[0]
+        assert exchange == "rep"  # the CLIENT's reply exchange
+        assert meta["ok"] and meta["worker"] == owner
+        assert r._inflight[owner] == 0
+        # the rerouted twin / late duplicate is dropped, not re-sent
+        r._on_reply(_reply("r1"))
+        assert len(rep) == 1
+        c = reg.snapshot()["counters"]
+        assert c["router.replies_total"] == 1.0
+        assert c["router.dup_replies_total"] == 1.0
+
+    def test_answered_lru_rejects_replayed_id(self):
+        r, fwd, rep, _ = make_router()
+        r._handle(rmeta("r1"))
+        r._on_reply(_reply("r1"))
+        r._handle(rmeta("r1"))  # replay after the answer
+        assert len(fwd) == 1    # never re-executed
+        assert rep[-1][1]["error"]["code"] == "duplicate"
+
+    def test_answered_lru_is_bounded(self):
+        r, fwd, rep, _ = make_router(answered_cap=2)
+        for rid in ("a", "b", "c"):
+            r._handle(rmeta(rid))
+            r._on_reply(_reply(rid))
+        assert list(r._answered) == ["b", "c"]  # "a" evicted at cap
+
+
+# ---------------------------------------------------------------------------
+# failover: re-route within the budget, exactly-once across the move
+# ---------------------------------------------------------------------------
+
+
+class TestRouterFailover:
+    def test_reroute_moves_inflight_to_next_preference(self):
+        r, fwd, rep, reg = make_router()
+        r._handle(rmeta("f1", {"site_index": 7}))
+        pref = r._ring.preference("site:7")
+        first = fwd[0][0]
+        assert first == pref[0]
+        r._ready.discard(first)
+        r._reroute_worker(first)
+        assert len(fwd) == 2
+        second, meta = fwd[1]
+        assert second == pref[1]  # the ring's failover order
+        assert meta["worker"] == second  # stamp follows the move
+        assert r._pending["f1"].worker == second
+        assert r._inflight[first] == 0 and r._inflight[second] == 1
+        assert reg.snapshot()["counters"]["router.rerouted_total"] == 1.0
+        # exactly-once across the move: the survivor's reply lands, the
+        # dead worker's late twin is dropped
+        r._on_reply(_reply("f1"))
+        r._on_reply(_reply("f1"))
+        assert len(rep) == 1 and rep[0][1]["worker"] == second
+
+    def test_reroute_cap_spends_then_rejects_typed(self):
+        r, fwd, rep, _ = make_router(reroute_cap=1)
+        r._handle(rmeta("f1", {"site_index": 7}))
+        pref = r._ring.preference("site:7")
+        r._ready.discard(pref[0])
+        r._reroute_worker(pref[0])
+        r._ready.discard(pref[1])
+        r._reroute_worker(pref[1])  # budget spent -> typed rejection
+        assert len(fwd) == 2
+        err = rep[0][1]["error"]
+        assert err["code"] == "unavailable"
+        assert err["retry_after_ms"] >= 1
+        assert "f1" not in r._pending
+
+    def test_lone_worker_death_has_no_fallback(self):
+        r, fwd, rep, _ = make_router(names=("w0",))
+        r._handle(rmeta("f1"))
+        r._ready.discard("w0")
+        r._reroute_worker("w0")
+        assert rep[0][1]["error"]["code"] == "unavailable"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a real 2-worker fleet over the local broker
+# ---------------------------------------------------------------------------
+
+
+class TestFleetEndToEnd:
+    def test_affinity_replay_lru_and_worker_stamp(self):
+        """The replay-LRU affinity satellite: with consistent-hash
+        routing, a replayed site request lands on the SAME worker, whose
+        duplicate-id LRU rejects it typed — even when the router's own
+        answered guard has forgotten the id.  Replies carry the worker
+        stamp, and the v16 partition invariant holds."""
+        from tmhpvsim_tpu.config import SiteGrid
+
+        sim = scfg(site_grid=SiteGrid.regular(
+            (45.0, 46.0), (5.0, 6.0), 2, 2))
+        url = "local://fleet-e2e"
+        base = ServeConfig(sim=sim, url=url, window_s=0.05,
+                           batch_sizes=(1, 4), timeout_s=120.0,
+                           drain_timeout_s=10.0)
+        reg = MetricsRegistry()
+        fleet = ServeFleet(
+            FleetConfig(base=base, n_workers=2, health_period_s=0.05),
+            registry=reg)
+
+        async def main():
+            with use_registry(reg):
+                await fleet.start()
+            try:
+                async with ScenarioClient(url) as client:
+                    replies = await asyncio.gather(*[
+                        client.request({"site_index": s % 4,
+                                        "horizon_s": 60},
+                                       rid=f"s{s}", timeout=120)
+                        for s in range(8)])
+                    assert all(m["ok"] for m in replies), replies
+                    by_site = {}
+                    for s, m in enumerate(replies):
+                        assert m["worker"] in ("w0", "w1")
+                        assert m["result"]["site_index"] == s % 4
+                        by_site.setdefault(s % 4, set()).add(m["worker"])
+                    # affinity: every site answered by exactly one worker
+                    assert all(len(ws) == 1 for ws in by_site.values())
+
+                    # replay while the router remembers: its answered
+                    # LRU rejects without touching a worker
+                    dup = await client.request(
+                        {"site_index": 0, "horizon_s": 60}, rid="s0",
+                        timeout=30)
+                    assert dup["error"]["code"] == "duplicate"
+
+                    # replay after the router forgot: affinity re-routes
+                    # to the SAME worker, whose replay LRU rejects —
+                    # the id is never executed twice anywhere
+                    batches_before = sum(
+                        snap["counters"].get("serve.batches_total", 0)
+                        for _, snap in fleet.worker_snapshots())
+                    fleet.router._answered.clear()
+                    dup2 = await client.request(
+                        {"site_index": 0, "horizon_s": 60}, rid="s0",
+                        timeout=30)
+                    assert dup2["error"]["code"] == "duplicate"
+                    assert dup2["worker"] == by_site[0].copy().pop()
+                    batches_after = sum(
+                        snap["counters"].get("serve.batches_total", 0)
+                        for _, snap in fleet.worker_snapshots())
+                    assert batches_after == batches_before
+
+                    doc = fleet.fleet_doc()
+                    assert doc is not None
+                    assert [w["name"] for w in doc["workers"]] \
+                        == ["w0", "w1"]
+                    # the partition invariant serve_report.py enforces
+                    assert sum(w["requests"] for w in doc["workers"]) \
+                        == doc["router"]["routed"] \
+                        + doc["router"]["rerouted"]
+            finally:
+                await fleet.stop(drain_timeout_s=5.0)
+
+        _run(asyncio.wait_for(main(), timeout=600))

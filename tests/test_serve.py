@@ -19,6 +19,7 @@ import json
 import pathlib
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -30,14 +31,20 @@ from tmhpvsim_tpu.obs.metrics import MetricsRegistry, use_registry
 from tmhpvsim_tpu.obs.report import (
     REPORT_SCHEMA_VERSION,
     RunReport,
+    fleet_serving_section,
     serving_section,
     validate_report,
 )
 from tmhpvsim_tpu.runtime import broker as broker_mod
 from tmhpvsim_tpu.runtime.broker import make_transport
+from tmhpvsim_tpu.runtime.resilience import CircuitBreaker, ResiliencePolicy
 from tmhpvsim_tpu.runtime.tcpbroker import TcpFanoutBroker, _Subscriber
 from tmhpvsim_tpu.serve import schema
-from tmhpvsim_tpu.serve.batcher import OCCUPANCY_BUCKETS, MicroBatcher
+from tmhpvsim_tpu.serve.batcher import (
+    OCCUPANCY_BUCKETS,
+    ContinuousBatcher,
+    MicroBatcher,
+)
 from tmhpvsim_tpu.serve.schema import Request, RequestError, Scenario
 from tmhpvsim_tpu.serve.server import (
     ScenarioClient,
@@ -548,6 +555,397 @@ class TestEndToEnd:
 
 
 # ---------------------------------------------------------------------------
+# continuous batching: the rolling scheduler (deterministic fake session)
+# ---------------------------------------------------------------------------
+
+
+class _FakeSession:
+    """Duck-typed RollingSession for scheduler-policy tests: each
+    ``step_finish`` signals entry then blocks until released, so the
+    test controls exactly what is queued while a dispatch is in
+    flight."""
+
+    def __init__(self, bucket, blocks):
+        self.bucket = bucket
+        self._blocks = dict(blocks)  # rid -> horizon blocks
+        self.rows = {}
+        self.calls = []
+        self.step_entered = threading.Semaphore(0)
+        self.step_go = threading.Semaphore(0)
+        self.fail_next = False
+        self.recovered = 0
+
+    def blocks_for(self, request):
+        return self._blocks[request.id]
+
+    def admit_rows(self, admits):
+        for slot, request in admits:
+            self.rows[slot] = request.id
+
+    def step_finish(self, bi, sched, retiring):
+        self.step_entered.release()
+        assert self.step_go.acquire(timeout=10.0)
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError("chaos dispatch")
+        self.calls.append((bi, tuple(sched), tuple(retiring)))
+        return {sl: {"rid": self.rows.pop(sl)} for sl in retiring}
+
+    def recover(self):
+        self.recovered += 1
+        self.rows.clear()
+
+
+async def _entered(sess, timeout=10.0):
+    """Await the fake session's next step_finish entry."""
+    deadline = time.monotonic() + timeout
+    while not sess.step_entered.acquire(blocking=False):
+        assert time.monotonic() < deadline, "dispatch never started"
+        await asyncio.sleep(0.005)
+
+
+class TestContinuousScheduler:
+    def test_backfill_joins_next_dispatch_and_retires_early(self):
+        """The tentpole mechanic: a request arriving while rows are
+        resident backfills a free slot into the very next fused
+        dispatch (no window wait) and retires as soon as ITS horizon is
+        done — it never rides the residents' remaining blocks."""
+        async def main():
+            reg = MetricsRegistry()
+            sess = _FakeSession(4, {"a": 3, "b": 3, "c": 1})
+            b = ContinuousBatcher(sess, window_s=0.02, registry=reg)
+            b.start()
+            fa = b.submit(req("a", Scenario()))
+            fb = b.submit(req("b", Scenario()))
+            await _entered(sess)                 # block 0 of {a, b} in flight
+            fc = b.submit(req("c", Scenario()))  # arrives mid-dispatch
+            sess.step_go.release()
+            for _ in range(3):
+                await _entered(sess)
+                sess.step_go.release()
+            (ra, ia), (rb, ib), (rc, ic) = await asyncio.gather(fa, fb, fc)
+            await b.stop(drain=True)
+            # c backfilled at its own cursor; the residents' shared
+            # cursor keeps the fattest fuse until they retire, then c's
+            # single block dispatches and frees the batch
+            assert sess.calls == [
+                (0, (0, 1), ()),
+                (1, (0, 1), ()),
+                (2, (0, 1), (0, 1)),
+                (0, (2,), (2,)),
+            ]
+            assert (ra["rid"], rb["rid"], rc["rid"]) == ("a", "b", "c")
+            assert ia["blocks"] == 3 and ic["blocks"] == 1
+            assert ia["batch"] == 2 and ic["batch"] == 1
+            c = reg.snapshot()["counters"]
+            assert c["serve.backfilled_total"] == 1.0
+            assert c["serve.batches_total"] == 4.0
+            assert reg.snapshot()["gauges"]["serve.resident_rows"] == 0.0
+
+        _run(main())
+
+    def test_starve_limit_forces_the_oldest_cursor(self):
+        """A stream of fresh short rows outvotes a long resident row's
+        cursor every iteration; after ``starve_limit`` skipped turns the
+        scheduler dispatches the oldest row's cursor anyway."""
+        async def main():
+            reg = MetricsRegistry()
+            blocks = {"L": 2, **{f"s{i}": 1 for i in range(6)}}
+            sess = _FakeSession(8, blocks)
+            b = ContinuousBatcher(sess, window_s=0.02, registry=reg,
+                                  starve_limit=2)
+            b.start()
+            futs = [b.submit(req("L", Scenario()))]
+            for wave in range(3):
+                await _entered(sess)  # previous dispatch in flight
+                futs += [b.submit(req(f"s{2 * wave + k}", Scenario()))
+                         for k in range(2)]
+                sess.step_go.release()
+            await _entered(sess)
+            sess.step_go.release()
+            await _entered(sess)
+            sess.step_go.release()
+            await asyncio.gather(*futs)
+            await b.stop(drain=True)
+            # waves 1 and 2 skip L's cursor (starve 1, 2); wave 3 hits
+            # the limit and L's block 1 dispatches ALONE despite two
+            # fresh short rows waiting at cursor 0
+            assert sess.calls == [
+                (0, (0,), ()),          # L alone, block 0
+                (0, (1, 2), (1, 2)),    # wave 1 shorts (L skipped)
+                (0, (1, 2), (1, 2)),    # wave 2 shorts (L skipped)
+                (1, (0,), (0,)),        # forced: L's starved cursor
+                (0, (1, 2), (1, 2)),    # wave 3 shorts
+            ]
+
+        _run(main())
+
+    def test_dispatch_failure_fails_residents_and_recovers(self):
+        """A failed fused dispatch poisons the shared accumulator, so
+        every RESIDENT row gets a typed ``internal`` error and the
+        session recovers; later requests are served normally."""
+        async def main():
+            reg = MetricsRegistry()
+            sess = _FakeSession(4, {"a": 2, "b": 1, "d": 1})
+            b = ContinuousBatcher(sess, window_s=0.02, registry=reg)
+            b.start()
+            fa = b.submit(req("a", Scenario()))
+            fb = b.submit(req("b", Scenario()))
+            await _entered(sess)
+            sess.fail_next = True
+            sess.step_go.release()
+            for f in (fa, fb):
+                with pytest.raises(RequestError) as ei:
+                    await f
+                assert ei.value.code == "internal"
+            assert sess.recovered == 1
+            fd = b.submit(req("d", Scenario()))
+            await _entered(sess)
+            sess.step_go.release()
+            rd, _info = await fd
+            assert rd["rid"] == "d"
+            await b.stop(drain=True)
+
+        _run(main())
+
+
+# ---------------------------------------------------------------------------
+# continuous batching e2e: bit identity, coalescing, drain, mesh alignment
+# ---------------------------------------------------------------------------
+
+
+class TestContinuousEndToEnd:
+    def test_replies_bit_identical_to_singletons(self, engine):
+        """The tentpole acceptance: every reply from the continuous
+        server is bit-identical to a fresh batch-of-1 run of the same
+        scenario, while the rolling scheduler fuses far fewer dispatches
+        than row-blocks."""
+        url = "local://e2e-continuous"
+        cfg = ServeConfig(sim=scfg(), url=url, window_s=0.25,
+                          batch_sizes=(1, 4, 8), timeout_s=300.0,
+                          batching="continuous", starve_limit=3)
+        reg = MetricsRegistry()
+        scens = [{"demand_scale": 1.0 + 0.1 * i,
+                  "horizon_s": 120 if i % 2 else 60} for i in range(8)]
+        modes = ["reduce", "fleet", "quantiles", "reduce"] * 2
+
+        async def main():
+            server = ScenarioServer(cfg, registry=reg)
+            await server.start()
+            # the ServeConfig knob reaches the scheduler, and the
+            # rolling bucket is the largest compiled one
+            assert server.batcher._starve_limit == 3
+            assert server.batcher._session.bucket == 8
+            try:
+                async with ScenarioClient(url) as client:
+                    replies = await asyncio.gather(*[
+                        client.request(scens[i], mode=modes[i],
+                                       rid=f"c{i}", timeout=300)
+                        for i in range(8)])
+                    assert all(r["ok"] for r in replies), replies
+                    # graceful drain on the continuous path
+                    server.begin_drain()
+                    r = await client.request(scens[0], timeout=30)
+                    assert r["error"]["code"] == "draining"
+            finally:
+                await server.stop()
+            return replies
+
+        replies = _run(main())
+        # 12 useful row-blocks (4x1 + 4x2) fused into a handful of
+        # dispatches with real co-residency
+        c = reg.snapshot()["counters"]
+        assert 2 <= c["serve.batches_total"] <= 8
+        occ = reg.snapshot()["histograms"]["serve.batch_occupancy"]
+        assert occ["max"] > 1.0
+        refs = [engine.run([req(f"c{i}", schema.parse_scenario(
+                    scens[i], max_horizon_s=engine.max_horizon_s),
+                    mode=modes[i])])[0]
+                for i in range(8)]
+        assert [r["result"] for r in replies] == refs
+
+    def test_mesh_scenario_alignment_and_padding_inert(self):
+        """Continuous batching on the 2-D (chains, scenario) mesh: the
+        rolling bucket respects the scenario batch alignment and padded
+        slots stay bit-inert — replies match the UNsharded engine's
+        batch-of-1 bits, concurrent or alone."""
+        base = scfg(n_chains=8)
+        with use_registry(MetricsRegistry()):
+            plain = ScenarioEngine(base, (1, 4))
+        cfg = ServeConfig(sim=dataclasses.replace(base, mesh_scenario=2),
+                          url="local://e2e-mesh-continuous",
+                          window_s=0.2, batch_sizes=(1, 4),
+                          timeout_s=300.0, batching="continuous")
+        reg = MetricsRegistry()
+        scens = [
+            ({"horizon_s": 120}, "reduce"),
+            ({"demand_scale": 1.5, "demand_shift_w": 250.0,
+              "horizon_s": 120}, "fleet"),
+            ({"weather_bias": 0.5, "dc_capacity_scale": 2.0,
+              "curtail_w": 4000.0, "horizon_s": 60}, "quantiles"),
+        ]
+
+        async def main():
+            server = ScenarioServer(cfg, registry=reg)
+            await server.start()
+            # buckets round UP to multiples of the scenario mesh dim,
+            # and the rolling session inherits the aligned width
+            assert server.engine.batch_align == 2
+            assert server.engine.buckets == (2, 4)
+            assert server.batcher._session.bucket % 2 == 0
+            try:
+                async with ScenarioClient(url=cfg.url) as client:
+                    batch = await asyncio.gather(*[
+                        client.request(s, mode=m, rid=f"m{i}",
+                                       timeout=300)
+                        for i, (s, m) in enumerate(scens)])
+                    lone = await client.request(
+                        scens[0][0], mode=scens[0][1], timeout=300)
+            finally:
+                await server.stop()
+            return batch, lone
+
+        batch, lone = _run(main())
+        assert all(r["ok"] for r in batch) and lone["ok"]
+        refs = [plain.run([req(f"m{i}", schema.parse_scenario(
+                    s, max_horizon_s=plain.max_horizon_s), mode=m)])[0]
+                for i, (s, m) in enumerate(scens)]
+        assert [r["result"] for r in batch] == refs
+        assert lone["result"] == refs[0]
+
+
+# ---------------------------------------------------------------------------
+# retry_after hints: honest backoff from busy/unavailable rejections
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestRetryAfterHints:
+    def test_queue_full_busy_carries_hint(self):
+        async def main():
+            b = MicroBatcher(lambda rs: list(rs), window_s=0.01,
+                             max_batch=2, queue_limit=1,
+                             registry=MetricsRegistry())
+            f1 = b.submit("a")  # worker not started: the queue fills
+            with pytest.raises(RequestError) as ei:
+                b.submit("b")
+            assert ei.value.code == "busy"
+            assert ei.value.retry_after_ms >= 1
+            assert ei.value.retry_after_s \
+                == ei.value.retry_after_ms / 1000.0
+            await b.stop(drain=False)
+            with pytest.raises(RequestError):
+                await f1
+
+        _run(main())
+
+    def test_breaker_open_hint_is_reset_remaining(self):
+        async def main():
+            reg = MetricsRegistry()
+            clk = _Clock()
+            br = CircuitBreaker("serve.dispatch", failure_threshold=1,
+                                reset_s=30.0, registry=reg, now=clk)
+            b = MicroBatcher(lambda reqs: list(reqs), window_s=0.005,
+                             max_batch=2, registry=reg, breaker=br)
+            b.start()
+            br.record_failure()  # open
+            clk.t = 12.0         # 18 s of the reset window remain
+            with pytest.raises(RequestError) as ei:
+                b.submit("x")
+            assert ei.value.code == "unavailable"
+            assert ei.value.retry_after_ms == 18_000
+            await b.stop(drain=True)
+
+        _run(main())
+
+    def test_policy_sleeps_the_hint_not_the_dice(self, monkeypatch):
+        """ResiliencePolicy honours a rejection's ``retry_after_s``
+        attribute verbatim, overriding its own jittered backoff."""
+        from tmhpvsim_tpu.runtime import resilience as resilience_mod
+
+        delays = []
+
+        async def fake_sleep(d):
+            delays.append(d)
+
+        monkeypatch.setattr(resilience_mod.asyncio, "sleep", fake_sleep)
+        calls = {"n": 0}
+
+        async def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RequestError("busy", "queue full",
+                                   retry_after_ms=40)
+            return "done"
+
+        pol = ResiliencePolicy(attempts=5, base_delay_s=7.0,
+                               max_delay_s=9.0, name="hint.test",
+                               registry=MetricsRegistry())
+        assert _run(pol.call(flaky)) == "done"
+        assert delays == [0.04, 0.04]  # the server's hint, not 7-9 s
+
+    def test_client_rejection_policy_retries_same_rid(self):
+        """ScenarioClient under a rejection_policy: a typed busy reply
+        (with its retry_after_ms hint) is retried with the SAME request
+        id, and on exhaustion the final typed reply surfaces as a
+        value, never an exception."""
+        url = "local://retry-hints"
+        seen = []
+
+        async def responder():
+            async with make_transport(url, "scenario") as rx:
+                async for _t, _v, meta in rx.subscribe(with_meta=True):
+                    if not isinstance(meta, dict) or \
+                            meta.get("op") != schema.OP_REQUEST:
+                        continue
+                    rid = meta["id"]
+                    seen.append(rid)
+                    if rid.startswith("always") or seen.count(rid) == 1:
+                        out = schema.error_meta(
+                            rid, "busy", "over quota", retry_after_ms=5)
+                    else:
+                        out = schema.ok_meta(rid, "reduce", {"x": 1})
+                    async with make_transport(url,
+                                              meta["reply_to"]) as tx:
+                        await tx.publish(0.0, dt.datetime(2019, 9, 5),
+                                         meta=out)
+
+        async def main():
+            task = asyncio.create_task(responder())
+            await asyncio.sleep(0.05)
+            pol = ResiliencePolicy(attempts=3, base_delay_s=0.01,
+                                   max_delay_s=0.05, name="client.rej",
+                                   registry=MetricsRegistry())
+            try:
+                async with ScenarioClient(
+                        url, rejection_policy=pol) as client:
+                    r = await client.request({"horizon_s": 60},
+                                             rid="rr", timeout=10)
+                    assert r["ok"] and r["result"] == {"x": 1}
+                    assert seen == ["rr", "rr"]  # same id, one retry
+                    r2 = await client.request({"horizon_s": 60},
+                                              rid="always-1", timeout=10)
+                    assert not r2["ok"]
+                    assert r2["error"]["code"] == "busy"
+                    assert r2["error"]["retry_after_ms"] == 5
+                    assert seen.count("always-1") == 3  # exhausted
+            finally:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError,
+                                         ConnectionError):
+                    await task
+
+        _run(main())
+
+
+# ---------------------------------------------------------------------------
 # warm restart: zero fresh compiles against a populated cache
 # ---------------------------------------------------------------------------
 
@@ -608,12 +1006,48 @@ def _serving_registry():
     return reg
 
 
+def _fleet_inputs():
+    """(router_snapshot, [(worker, snapshot), ...]) exercising every
+    v16 ``serving.fleet`` field, with the partition invariant holding:
+    5 + 4 worker requests == 8 routed + 1 rerouted."""
+    reg = MetricsRegistry()
+    reg.counter("router.requests_total").inc(11)
+    reg.counter("router.routed_total").inc(8)
+    reg.counter("router.rerouted_total").inc(1)
+    reg.counter("router.replies_total").inc(8)
+    reg.counter("router.rejected_total").inc(3)
+    reg.counter("router.quota_rejected_total").inc(1)
+    reg.counter("router.shed_total").inc(1)
+    reg.counter("router.dup_replies_total").inc(1)
+    reg.counter("router.worker_down_total").inc(1)
+    reg.gauge("router.workers_ready").set(2)
+    reg.gauge("router.pending").set(0)
+    reg.gauge("resilience.supervised_restarts.w0").set(1)
+    h = reg.histogram("router.reply_latency_s")
+    for x in (0.002, 0.02, 0.2):
+        h.observe(x)
+    workers = []
+    for name, n in (("w0", 5), ("w1", 4)):
+        w = MetricsRegistry()
+        w.counter("serve.requests_total").inc(n)
+        w.counter("serve.replies_total").inc(n)
+        w.counter("serve.batches_total").inc(2)
+        w.counter("serve.backfilled_total").inc(1)
+        w.counter("executor.compile_warm_total").inc(3)
+        occ = w.histogram("serve.batch_occupancy",
+                          buckets=OCCUPANCY_BUCKETS)
+        for v in (1.0, float(n)):
+            occ.observe(v)
+        workers.append((name, w.snapshot()))
+    return reg.snapshot(), workers
+
+
 class TestServingReport:
     def test_v6_round_trip(self):
         rep = RunReport("pvsim.serve")
         rep.attach_metrics(_serving_registry())
         doc = rep.doc()
-        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 15
+        assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 16
         validate_report(doc)
         doc2 = json.loads(json.dumps(doc))
         validate_report(doc2)
@@ -632,6 +1066,51 @@ class TestServingReport:
         assert rep.doc()["serving"] is None
         validate_report(rep.doc())
 
+    def test_v16_fleet_round_trip(self):
+        rep = RunReport("pvsim.serve")
+        rep.attach_metrics(_serving_registry())
+        rep.attach_fleet_serving(*_fleet_inputs())
+        doc = json.loads(json.dumps(rep.doc()))
+        assert doc["schema_version"] == 16
+        validate_report(doc)
+        fleet = doc["serving"]["fleet"]
+        assert [w["name"] for w in fleet["workers"]] == ["w0", "w1"]
+        # the partition invariant the tools enforce
+        assert sum(w["requests"] for w in fleet["workers"]) \
+            == fleet["router"]["routed"] + fleet["router"]["rerouted"]
+        r = fleet["router"]
+        assert (r["requests"], r["rejected"], r["quota_rejected"],
+                r["shed"], r["rerouted"], r["dup_replies"],
+                r["worker_down"]) == (11, 3, 1, 1, 1, 1, 1)
+        assert r["workers_ready"] == 2
+        assert r["reply_latency"]["count"] == 3
+        w0 = fleet["workers"][0]
+        assert (w0["backfilled"], w0["compile_cold"],
+                w0["compile_warm"], w0["restarts"]) == (1, 0, 3, 1)
+        assert fleet["workers"][1]["restarts"] == 0
+
+    def test_router_only_registry_synthesizes_base_serving(self):
+        """A router process has no ``serve.*`` names; the fleet attach
+        synthesizes the documented base serving shape from the fleet
+        totals so v1-v15 consumers keep reading the section."""
+        rep = RunReport("pvsim.router")
+        rep.attach_fleet_serving(*_fleet_inputs())
+        doc = rep.doc()
+        validate_report(doc)
+        sec = doc["serving"]
+        assert (sec["requests"], sec["replies"],
+                sec["rejected"]) == (11, 8, 3)
+        assert sec["batches"] == 4  # summed across the worker rows
+        assert sec["fleet"]["router"]["quota_rejected"] == 1
+
+    def test_v15_doc_still_validates(self):
+        """Additive v16: a fleet-less v15 document (no ``fleet`` key)
+        remains valid byte-for-byte."""
+        doc = _serving_doc()
+        doc["schema_version"] = 15
+        validate_report(doc)
+        assert "fleet" not in (doc["serving"] or {})
+
 
 # ---------------------------------------------------------------------------
 # tools/serve_report.py + the bench_trend serve column
@@ -647,6 +1126,13 @@ def _run_tool(script, *argv):
 def _serving_doc():
     rep = RunReport("pvsim.serve")
     rep.attach_metrics(_serving_registry())
+    return rep.doc()
+
+
+def _fleet_doc():
+    rep = RunReport("pvsim.serve")
+    rep.attach_metrics(_serving_registry())
+    rep.attach_fleet_serving(*_fleet_inputs())
     return rep.doc()
 
 
@@ -686,6 +1172,37 @@ class TestServeReportTool:
         r = _run_tool(SERVE_REPORT, path)
         assert r.returncode == 0, r.stdout + r.stderr
         assert r.stdout.count("[serve]") == 2
+
+    def test_fleet_section_prints_worker_rows(self, tmp_path):
+        path = tmp_path / "fleet.json"
+        path.write_text(json.dumps(_fleet_doc()))
+        r = _run_tool(SERVE_REPORT, path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "w0" in r.stdout and "w1" in r.stdout
+        assert "cold=0 restarts=1" in r.stdout
+
+    def test_fleet_partition_violation_fails(self, tmp_path):
+        doc = _fleet_doc()
+        doc["serving"]["fleet"]["workers"][0]["requests"] += 1
+        path = tmp_path / "bad_fleet.json"
+        path.write_text(json.dumps(doc))
+        r = _run_tool(SERVE_REPORT, path)
+        assert r.returncode == 1
+        assert "partition" in r.stderr
+
+    def test_bench_trend_fleet_columns(self, tmp_path):
+        f = tmp_path / "fleet_bench.json"
+        f.write_text(json.dumps({
+            "artifact": "scenario-serve fleet load", "platform": "cpu",
+            "workers": 4, "speedup": 2.36,
+            "run_report": _fleet_doc(),
+        }))
+        r = _run_tool(BENCH_TREND, "--json", f)
+        assert r.returncode == 0, r.stdout + r.stderr
+        row = json.loads(r.stdout)["rows"][0]
+        assert row["fleet_workers"] == 4
+        assert row["cb_speedup"] == 2.36
+        assert not row["failed"]
 
     def test_bench_trend_serve_column(self, tmp_path):
         a = tmp_path / "a.json"

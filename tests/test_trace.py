@@ -590,7 +590,7 @@ def test_report_schema_v1_v2_still_validate():
     schemas keep validating against the current validator."""
     from tmhpvsim_tpu.obs.report import REPORT_SCHEMA_VERSION, RunReport
 
-    assert REPORT_SCHEMA_VERSION == 15
+    assert REPORT_SCHEMA_VERSION == 16
     doc = RunReport("test").doc()
     for old in (1, 2):
         legacy = {k: v for k, v in doc.items()

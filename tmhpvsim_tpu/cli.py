@@ -701,6 +701,28 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
                    "crash up to N times; the AOT-warmed compile cache "
                    "makes the restarted server compile nothing fresh "
                    "(runtime/supervise.py)")
+@click.option("--fleet", "fleet_n", type=int, default=0, metavar="N",
+              show_default="0 (single worker)",
+              help="serve with a fleet of N replicated warm workers "
+                   "behind a shard-affinity router (consistent hashing "
+                   "on site_index/cohort, least-loaded fallback, "
+                   "supervised warm respawn; serve/fleet.py).  0 keeps "
+                   "the single-worker server byte-identical to "
+                   "previous releases")
+@click.option("--batching", type=click.Choice(["window", "continuous"]),
+              default=None,
+              help="dispatch scheduler: 'window' retires every row of "
+                   "a fused batch together; 'continuous' backfills "
+                   "freed slots from the queue each block so short "
+                   "requests never wait out long ones (default: window "
+                   "single-worker, continuous with --fleet)")
+@click.option("--quota-rate", type=float, default=None, metavar="R",
+              help="per-tenant admission quota in requests/s (token "
+                   "bucket at the router; requires --fleet).  Over-"
+                   "quota requests get typed 'busy' with retry_after_ms")
+@click.option("--quota-burst", type=float, default=None, metavar="B",
+              help="token-bucket burst size of --quota-rate "
+                   "(default: R)")
 @click.option("--trace", "trace", default=None,
               help="Record the serving event timeline and export "
                    "Chrome-trace JSON here on exit; crashes dump the "
@@ -724,8 +746,9 @@ def pvsim(file, amqp_url, exchange, verbose, realtime, seed, duration_s,
 def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
           block_s, block_impl, tune, mesh_scenario, window_ms, max_batch,
           batch_sizes, queue_limit, timeout_s, drain_timeout_s, supervise,
-          trace, metrics_path, run_report_path, compile_cache, obs_port,
-          obs_bind, chaos, chaos_seed):
+          fleet_n, batching, quota_rate, quota_burst, trace, metrics_path,
+          run_report_path, compile_cache, obs_port, obs_bind, chaos,
+          chaos_seed):
     """Long-lived scenario server: a warm simulation answering "what-if"
     queries over the broker (serve/).  Each request perturbs bounded
     scenario knobs (demand scale/shift, DC-capacity scale, weather
@@ -740,6 +763,11 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
     _activate_chaos(chaos, chaos_seed)
     if mesh_scenario < 0:
         raise click.UsageError("--mesh-scenario must be >= 0")
+    if fleet_n < 0:
+        raise click.UsageError("--fleet must be >= 0")
+    if (quota_rate is not None or quota_burst is not None) and not fleet_n:
+        raise click.UsageError("--quota-rate/--quota-burst need --fleet "
+                               "(quotas live at the router)")
     sim_kw = dict(duration_s=duration_s, n_chains=n_chains, seed=seed,
                   output="reduce", block_impl=block_impl, tune=tune,
                   mesh_scenario=mesh_scenario)
@@ -757,7 +785,23 @@ def serve(amqp_url, exchange, verbose, seed, duration_s, start, n_chains,
         url=amqp_url or "local://default", exchange=exchange,
         window_s=window_ms / 1e3, max_batch=max_batch,
         batch_sizes=buckets, queue_limit=queue_limit,
-        timeout_s=timeout_s, drain_timeout_s=drain_timeout_s)
+        timeout_s=timeout_s, drain_timeout_s=drain_timeout_s,
+        batching=batching or "window")
+    if fleet_n:
+        from tmhpvsim_tpu.serve.fleet import (FleetConfig,
+                                              serve_fleet_main)
+
+        fcfg = FleetConfig(
+            base=cfg, n_workers=fleet_n,
+            batching=batching or "continuous",
+            quota_rate=quota_rate, quota_burst=quota_burst,
+            inflight_limit=queue_limit, auto_respawn=True)
+        asyncrun(serve_fleet_main(
+            fcfg, compile_cache=compile_cache, trace=trace,
+            metrics_path=metrics_path,
+            run_report_path=run_report_path,
+            obs_port=obs_port, obs_bind=obs_bind))
+        return
     asyncrun(serve_main(cfg, compile_cache=compile_cache, trace=trace,
                         metrics_path=metrics_path,
                         run_report_path=run_report_path,

@@ -117,9 +117,18 @@ logger = logging.getLogger(__name__)
 #: ``measured_phase_frac`` keys checking each static-v1 factor axis
 #: against the measured share of the phase it claims to scale.  All
 #: additive — runs without ``phase_obs`` omit the section (None).
+#: v16: the ``serving`` section gains the optional additive ``fleet``
+#: sub-doc (:func:`fleet_serving_section`) when the run served through
+#: the horizontally-scaled tier (serve/router.py + serve/fleet.py):
+#: ``router`` totals (routed/replies/rerouted/dup_replies/quota_
+#: rejected/shed + reply-latency) and per-worker rows (requests/
+#: replies/batches/backfilled/occupancy/compile counters/restarts)
+#: that partition the router's routed totals — tools/serve_report.py
+#: checks the partition.  Single-worker serves omit the key — their
+#: reports stay byte-compatible with v15 emitters.
 #: The validator accepts any version in [1, REPORT_SCHEMA_VERSION] —
 #: prior-version documents stay loadable (tested).
-REPORT_SCHEMA_VERSION = 15
+REPORT_SCHEMA_VERSION = 16
 REPORT_KIND = "tmhpvsim_tpu.run_report"
 
 _NUM = (int, float)
@@ -555,6 +564,80 @@ def serving_section(snap: dict) -> Optional[dict]:
     }
 
 
+def fleet_serving_section(router_snap: dict,
+                          workers: list) -> Optional[dict]:
+    """The v16 ``serving.fleet`` sub-doc from a router registry
+    snapshot plus ``[(worker_name, worker_snapshot), ...]`` (one
+    snapshot per worker, counters summed across its lives by the
+    caller).  None when the router saw no traffic AND no workers were
+    given — a single-worker serve never gains the key.
+
+    Invariant the tools check: the per-worker ``requests`` rows
+    partition the router's forwarded totals
+    (``sum(workers[].requests) == router.routed + router.rerouted``)
+    — every routed request landed on exactly one worker per forward.
+    """
+    from tmhpvsim_tpu.obs.metrics import quantile_from_snapshot
+
+    counters = router_snap.get("counters", {})
+    gauges = router_snap.get("gauges", {})
+    hists = router_snap.get("histograms", {})
+    if not workers and not any(k.startswith("router.")
+                               for k in list(counters) + list(gauges)):
+        return None
+
+    def c(name):
+        return int(counters.get(name, 0))
+
+    rows = []
+    for name, snap in workers:
+        wc = snap.get("counters", {})
+        wg = snap.get("gauges", {})
+        wh = snap.get("histograms", {})
+        occ = wh.get("serve.batch_occupancy")
+        occupancy = None
+        if occ and occ.get("count"):
+            occupancy = {"batches": occ["count"],
+                         "mean": occ.get("mean"),
+                         "max": occ.get("max"),
+                         "p50": quantile_from_snapshot(occ, 0.50)}
+        rows.append({
+            "name": name,
+            "requests": int(wc.get("serve.requests_total", 0)),
+            "replies": int(wc.get("serve.replies_total", 0)),
+            "rejected": int(wc.get("serve.rejected_total", 0)),
+            "timeouts": int(wc.get("serve.timeouts_total", 0)),
+            "batches": int(wc.get("serve.batches_total", 0)),
+            "backfilled": int(wc.get("serve.backfilled_total", 0)),
+            "occupancy": occupancy,
+            "compile_cold":
+                int(wc.get("executor.compile_cold_total", 0)),
+            "compile_warm":
+                int(wc.get("executor.compile_warm_total", 0)),
+            "restarts": int(gauges.get(
+                f"resilience.supervised_restarts.{name}", 0)),
+        })
+    return {
+        "router": {
+            "requests": c("router.requests_total"),
+            "routed": c("router.routed_total"),
+            "replies": c("router.replies_total"),
+            "rejected": c("router.rejected_total"),
+            "quota_rejected": c("router.quota_rejected_total"),
+            "shed": c("router.shed_total"),
+            "rerouted": c("router.rerouted_total"),
+            "dup_replies": c("router.dup_replies_total"),
+            "timeouts": c("router.timeouts_total"),
+            "worker_down": c("router.worker_down_total"),
+            "workers_ready": int(gauges.get("router.workers_ready", 0)),
+            "pending": int(gauges.get("router.pending", 0)),
+            "reply_latency":
+                _latency_doc(hists.get("router.reply_latency_s")),
+        },
+        "workers": rows,
+    }
+
+
 def resilience_section(snap: dict) -> Optional[dict]:
     """The ``resilience`` report section (schema v7) from the
     well-known ``resilience.*`` / ``faults.*`` metric names
@@ -735,10 +818,43 @@ class RunReport:
             self.executor = {**executor, **(self.executor or {})}
         serving = serving_section(snap)
         if serving is not None:
+            # a fleet sub-doc attached earlier survives the re-derive
+            fleet = (self.serving or {}).get("fleet")
             self.serving = serving
+            if fleet is not None:
+                self.serving["fleet"] = fleet
         resilience = resilience_section(snap)
         if resilience is not None:
             self.resilience = resilience
+
+    def attach_fleet_serving(self, router_snap: dict,
+                             workers: list) -> None:
+        """Attach the v16 ``serving.fleet`` sub-doc (see
+        :func:`fleet_serving_section`); merges into whatever
+        ``serving`` section :meth:`attach_metrics` derived."""
+        fleet = fleet_serving_section(router_snap, workers)
+        if fleet is None:
+            return
+        if self.serving is None:
+            self.serving = serving_section(router_snap)
+        if self.serving is None:
+            # router registries carry no serve.* names: synthesize the
+            # base section from the fleet totals so the serving shape
+            # stays the documented v6 one with the additive fleet key
+            r = fleet["router"]
+            self.serving = {
+                "requests": r["requests"],
+                "replies": r["replies"],
+                "rejected": r["rejected"],
+                "timeouts": r["timeouts"],
+                "batches": sum(w["batches"] for w in fleet["workers"]),
+                "in_flight": r["pending"],
+                "occupancy": None,
+                "queue_wait": None,
+                "dispatch": None,
+                "reply_latency": r["reply_latency"],
+            }
+        self.serving["fleet"] = fleet
 
     def doc(self, validate: bool = True) -> dict:
         out = {

@@ -173,6 +173,14 @@ class CircuitBreaker:
                         self.name)
             self._set_state("closed")
 
+    def reset_remaining_s(self) -> float:
+        """Seconds until an open breaker half-opens (0 when not open) —
+        the honest ``retry_after`` hint for load shed on its behalf."""
+        self._maybe_half_open()
+        if self._state != "open":
+            return 0.0
+        return max(0.0, self.reset_s - (self._now() - self._opened_at))
+
     def record_failure(self) -> None:
         self._maybe_half_open()
         probe_failed = self._state == "half_open" and self._probe_in_flight
@@ -309,7 +317,15 @@ class ResiliencePolicy:
                             res = await res
                         return res
                     return fb
-                prev = delay = self.backoff(n, prev)
+                # a server-supplied retry_after hint (typed busy /
+                # unavailable rejections, serve/schema.py) overrides the
+                # blind jittered backoff: the far end knows its queue
+                # depth and breaker reset better than our dice do
+                hint = getattr(exc, "retry_after_s", None)
+                if hint is not None:
+                    prev = delay = max(0.0, float(hint))
+                else:
+                    prev = delay = self.backoff(n, prev)
                 if deadline is not None:
                     delay = min(delay,
                                 max(0.0, deadline - time.monotonic()))

@@ -33,13 +33,14 @@ same outage don't synchronize into a thundering herd.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 import signal
 import subprocess
 import sys
 import time
-from typing import List, Optional, Sequence
+from typing import Awaitable, Callable, List, Optional, Sequence
 
 from tmhpvsim_tpu.runtime.resilience import ResiliencePolicy
 
@@ -169,3 +170,52 @@ def run_supervised(argv: Sequence[str], *, max_restarts: int,
     finally:
         for s, h in old_handlers.items():
             signal.signal(s, h)
+
+
+async def supervise_service(run: Callable[[int], Awaitable[None]], *,
+                            max_restarts: int,
+                            backoff_base_s: float = 0.05,
+                            backoff_max_s: float = 2.0,
+                            name: str = "service",
+                            registry=None) -> None:
+    """In-process analogue of :func:`run_supervised` for asyncio
+    services (the serving fleet's workers): ``await run(attempt)``
+    until it returns cleanly; an exception is a crash and triggers a
+    warm respawn under the same decorrelated-jitter backoff discipline,
+    up to ``max_restarts`` lives.  The attempt number lands on the
+    ``resilience.supervised_restarts.{name}`` gauge so a fleet's run
+    report records how many lives each worker used.  Warmth is the
+    same story as the subprocess supervisor: under a populated
+    persistent compile cache a respawned worker deserialises every
+    executable and compiles nothing cold."""
+    policy = ResiliencePolicy(attempts=max_restarts + 1,
+                              base_delay_s=backoff_base_s,
+                              max_delay_s=backoff_max_s,
+                              name=f"supervise.{name}")
+    attempt = 0
+    prev = backoff_base_s
+    while True:
+        try:
+            await run(attempt)
+            return
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            if attempt >= max_restarts:
+                log.error(
+                    "supervised service %r crashed (%s: %s); %d "
+                    "restart(s) exhausted — giving up", name,
+                    type(err).__name__, err, max_restarts)
+                raise
+            attempt += 1
+            if registry is not None:
+                registry.gauge(
+                    f"resilience.supervised_restarts.{name}"
+                ).set(attempt)
+            delay = policy.backoff(attempt, prev)
+            prev = max(delay, backoff_base_s)
+            log.warning(
+                "supervised service %r crashed (%s: %s); warm respawn "
+                "%d/%d in %.2f s", name, type(err).__name__, err,
+                attempt, max_restarts, delay)
+            await asyncio.sleep(delay)

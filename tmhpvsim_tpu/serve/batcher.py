@@ -1,12 +1,31 @@
-"""Micro-batcher: coalesce concurrent scenario requests into one
-fused dispatch.
+"""Request batchers: coalesce concurrent scenario requests into fused
+dispatches.
 
-The window protocol: the first pending request OPENS a window; the
-batch dispatches when either ``window_s`` elapses or ``max_batch``
-requests are pending, whichever comes first.  A lone request therefore
-pays at most one window of added latency, and a burst of concurrent
-clients rides one dispatch (batch occupancy > 1 — the serving win the
-e2e acceptance test asserts).
+Two schedulers share one submit/stop front (:class:`_BatcherCore`):
+
+* :class:`MicroBatcher` — the window protocol.  The first pending
+  request OPENS a window; the batch dispatches when either ``window_s``
+  elapses or ``max_batch`` requests are pending, whichever comes first.
+  Every row of a dispatch retires together: the batch pays the blocks
+  of its LONGEST horizon, so a short request stuck behind a long one
+  waits for blocks it does not need.
+* :class:`ContinuousBatcher` — rolling (continuous) batching.  Requests
+  occupy slots of ONE fixed-width device batch; each fused dispatch
+  advances one block index for every resident row at that cursor, rows
+  retire individually the moment their own horizon's blocks are folded,
+  and freed slots are backfilled from the queue into the very next
+  dispatch instead of waiting for the batch to drain.  Rows not
+  scheduled in a dispatch ride along as ``horizon_s=0`` padding — the
+  established bit-inert row (``Simulation._block_step_scan_scenario``)
+  — so replies stay bit-identical to batch-of-1 runs.  The device-side
+  slot protocol lives in :class:`~tmhpvsim_tpu.serve.server
+  .RollingSession`; this class only schedules.
+
+Both keep the ``batch_align``/bucket-rounding contract: the window
+batcher tops a closing batch up to the next multiple from requests
+already queued; the continuous batcher's slot count IS the engine's
+aligned bucket, so every dispatch divides the 2-D scenario mesh evenly
+by construction.
 
 The dispatch callable runs in a single worker thread: device access is
 serialized by construction (one dispatch in flight at a time — exactly
@@ -15,9 +34,16 @@ accept and reject traffic.  Results resolve per-request futures; a
 future the server already abandoned (request timeout) is skipped, not
 an error.
 
+Typed ``busy``/``unavailable`` rejections carry a ``retry_after_ms``
+hint derived from the batcher window + queue depth (or the breaker's
+remaining reset time), so clients back off by the server's own queue
+arithmetic instead of blind jitter.
+
 SLO metrics (``serve.*``, obs/metrics.py): ``queue_wait_s`` /
 ``dispatch_s`` histograms, a ``batch_occupancy`` histogram on dedicated
-count buckets plus a last-batch gauge, and ``batches_total``.
+count buckets plus a last-batch gauge, and ``batches_total``.  The
+continuous scheduler adds ``serve.backfilled_total`` (slots admitted
+into an already-rolling batch) and a ``serve.resident_rows`` gauge.
 """
 
 from __future__ import annotations
@@ -27,7 +53,7 @@ import concurrent.futures
 import contextlib
 import dataclasses
 import logging
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from tmhpvsim_tpu.obs import metrics as obs_metrics
 from tmhpvsim_tpu.obs import trace as obs_trace
@@ -41,6 +67,14 @@ log = logging.getLogger(__name__)
 OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
                      32.0, 48.0, 64.0)
 
+#: dispatches the continuous scheduler may skip the oldest resident
+#: row's cursor before it is forced (anti-starvation)
+STARVE_LIMIT = 4
+
+#: ceiling on retry_after hints — past this the client should treat the
+#: server as down, not slow
+MAX_RETRY_AFTER_MS = 60_000
+
 
 @dataclasses.dataclass
 class _Pending:
@@ -49,31 +83,20 @@ class _Pending:
     t_enq: float  # loop.time() at submit
 
 
-class MicroBatcher:
-    """See module docstring.  ``dispatch(requests) -> results`` is a
-    SYNCHRONOUS callable (it owns the device) returning one result per
-    request, positionally."""
+class _BatcherCore:
+    """Shared submit/stop front of both schedulers (see module
+    docstring).  ``capacity`` is the per-dispatch row budget the
+    retry_after arithmetic divides the queue by."""
 
     _STOP = object()
 
-    def __init__(self, dispatch: Callable[[List[Request]], Sequence],
-                 *, window_s: float = 0.010, max_batch: int = 16,
+    def __init__(self, *, window_s: float, capacity: int,
                  queue_limit: int = 1024, registry=None,
-                 breaker: Optional[CircuitBreaker] = None,
-                 batch_align: int = 1):
-        if max_batch < 1:
-            raise ValueError(f"max_batch {max_batch} must be >= 1")
-        if batch_align < 1:
-            raise ValueError(
-                f"batch_align {batch_align} must be >= 1")
-        self._dispatch = dispatch
+                 breaker: Optional[CircuitBreaker] = None):
+        if capacity < 1:
+            raise ValueError(f"batch capacity {capacity} must be >= 1")
         self._window_s = float(window_s)
-        self._max_batch = int(max_batch)
-        #: soft alignment: at window close, top the batch up to the next
-        #: multiple of this from requests ALREADY queued (non-blocking).
-        #: On a 2-D (chains, scenario) mesh an aligned batch fills the
-        #: scenario shards evenly instead of padding one of them.
-        self._batch_align = int(batch_align)
+        self._capacity = int(capacity)
         #: dispatch circuit breaker: consecutive dispatch failures open
         #: it and submit sheds with typed ``unavailable`` until a probe
         #: batch succeeds (None = never shed)
@@ -83,6 +106,8 @@ class MicroBatcher:
             max_workers=1, thread_name_prefix="serve-dispatch")
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        #: EWMA of fused-dispatch device seconds (retry_after input)
+        self._ewma_dispatch_s: Optional[float] = None
         reg = registry or obs_metrics.get_registry()
         self._c_batches = reg.counter("serve.batches_total")
         self._h_wait = reg.histogram("serve.queue_wait_s")
@@ -93,6 +118,26 @@ class MicroBatcher:
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def retry_after_ms(self) -> int:
+        """The honest backoff hint for a shedding rejection: how long
+        until the queue ahead of a new request has likely dispatched
+        (batches ahead x (window + EWMA dispatch)), or the breaker's
+        remaining reset when it is open."""
+        if self.breaker is not None and self.breaker.state == "open":
+            ms = int(self.breaker.reset_remaining_s() * 1000.0)
+            return max(1, min(MAX_RETRY_AFTER_MS, ms))
+        per_batch = self._window_s + (self._ewma_dispatch_s
+                                      if self._ewma_dispatch_s is not None
+                                      else self._window_s)
+        batches_ahead = -(-(self._queue.qsize() + 1) // self._capacity)
+        ms = int(batches_ahead * per_batch * 1000.0)
+        return max(1, min(MAX_RETRY_AFTER_MS, ms))
+
+    def _note_dispatch(self, dispatch_s: float) -> None:
+        e = self._ewma_dispatch_s
+        self._ewma_dispatch_s = (dispatch_s if e is None
+                                 else 0.2 * dispatch_s + 0.8 * e)
 
     def submit(self, request: Request) -> asyncio.Future:
         """Enqueue one request; the returned future resolves with its
@@ -106,7 +151,8 @@ class MicroBatcher:
             self.breaker.count_rejected()
             raise RequestError(
                 "unavailable",
-                "dispatch circuit breaker is open; retry with backoff")
+                "dispatch circuit breaker is open; retry with backoff",
+                retry_after_ms=self.retry_after_ms())
         loop = asyncio.get_running_loop()
         pending = _Pending(request, loop.create_future(), loop.time())
         try:
@@ -114,7 +160,8 @@ class MicroBatcher:
         except asyncio.QueueFull:
             raise RequestError(
                 "busy", f"pending queue full "
-                f"({self._queue.maxsize} requests)") from None
+                f"({self._queue.maxsize} requests)",
+                retry_after_ms=self.retry_after_ms()) from None
         tracer = obs_trace.get_tracer()
         if tracer:  # queue-wait starts here; trace_id rides the context
             tracer.instant("batcher.admit", "serve", rid=request.id)
@@ -157,6 +204,16 @@ class MicroBatcher:
         # device call returns)
         self._pool.shutdown(wait=not timed_out)
 
+    def kill(self) -> None:
+        """Simulated SIGKILL (chaos tests): drop everything on the
+        floor — no drain, no rejections, queued and in-flight futures
+        never resolve.  A killed process says nothing."""
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._pool.shutdown(wait=False)
+
     def _fail_queued(self, why: str) -> None:
         while True:
             try:
@@ -165,6 +222,36 @@ class MicroBatcher:
                 break
             if p is not self._STOP and not p.future.done():
                 p.future.set_exception(RequestError("draining", why))
+
+    async def _run(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class MicroBatcher(_BatcherCore):
+    """The window scheduler (see module docstring).
+    ``dispatch(requests) -> results`` is a SYNCHRONOUS callable (it
+    owns the device) returning one result per request, positionally."""
+
+    def __init__(self, dispatch: Callable[[List[Request]], Sequence],
+                 *, window_s: float = 0.010, max_batch: int = 16,
+                 queue_limit: int = 1024, registry=None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 batch_align: int = 1):
+        if max_batch < 1:
+            raise ValueError(f"max_batch {max_batch} must be >= 1")
+        if batch_align < 1:
+            raise ValueError(
+                f"batch_align {batch_align} must be >= 1")
+        super().__init__(window_s=window_s, capacity=max_batch,
+                         queue_limit=queue_limit, registry=registry,
+                         breaker=breaker)
+        self._dispatch = dispatch
+        self._max_batch = int(max_batch)
+        #: soft alignment: at window close, top the batch up to the next
+        #: multiple of this from requests ALREADY queued (non-blocking).
+        #: On a 2-D (chains, scenario) mesh an aligned batch fills the
+        #: scenario shards evenly instead of padding one of them.
+        self._batch_align = int(batch_align)
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
@@ -247,6 +334,7 @@ class MicroBatcher:
             self.breaker.record_success()
         dispatch_s = loop.time() - t0
         self._h_dispatch.observe(dispatch_s)
+        self._note_dispatch(dispatch_s)
         if len(results) != len(batch):  # dispatch contract violation
             for p in batch:
                 if not p.future.done():
@@ -264,3 +352,200 @@ class MicroBatcher:
                     "queue_s": w,
                     "dispatch_s": dispatch_s,
                 }))
+
+
+class ContinuousBatcher(_BatcherCore):
+    """The rolling scheduler (see module docstring).  ``session`` is a
+    :class:`~tmhpvsim_tpu.serve.server.RollingSession`: ``bucket`` slots
+    wide, with synchronous ``admit_rows`` / ``step_finish`` /
+    ``recover`` methods that run on the single dispatch thread.
+
+    Scheduling policy: each iteration backfills free slots from the
+    queue (non-blocking), then dispatches the block cursor shared by
+    the MOST resident rows (ties prefer the cursor closest to
+    retirement, so slots free sooner).  A cursor skipped
+    :data:`STARVE_LIMIT` times in a row while the oldest resident row
+    waits at it is forced — no horizon mix can park a row forever.
+    The window only applies while the batch is EMPTY (first fill):
+    waiting for company while resident rows are runnable would stall
+    them for nothing.
+    """
+
+    def __init__(self, session, *, window_s: float = 0.010,
+                 queue_limit: int = 1024, registry=None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 starve_limit: int = STARVE_LIMIT):
+        super().__init__(window_s=window_s, capacity=session.bucket,
+                         queue_limit=queue_limit, registry=registry,
+                         breaker=breaker)
+        self._session = session
+        self._starve_limit = int(starve_limit)
+        reg = registry or obs_metrics.get_registry()
+        self._c_backfilled = reg.counter("serve.backfilled_total")
+        self._g_resident = reg.gauge("serve.resident_rows")
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        s = self._session
+        bucket = s.bucket
+        free = list(range(bucket - 1, -1, -1))
+        occupied: Dict[int, _Pending] = {}
+        cursors: Dict[int, int] = {}
+        need: Dict[int, int] = {}
+        waits: Dict[int, float] = {}
+        admit_at: Dict[int, float] = {}
+        closing = False
+        starve = 0
+        while True:
+            # ---- gather admissions -------------------------------------
+            pend: List[_Pending] = []
+            if not occupied:
+                if closing:
+                    return
+                first = await self._queue.get()
+                if first is self._STOP:
+                    return
+                pend.append(first)
+                # the window protocol, empty-batch case only: a lone
+                # request waits at most one window for company
+                deadline = loop.time() + self._window_s
+                while len(pend) < bucket and not closing:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = await asyncio.wait_for(self._queue.get(),
+                                                     remaining)
+                    except asyncio.TimeoutError:
+                        break
+                    if nxt is self._STOP:
+                        closing = True
+                        break
+                    pend.append(nxt)
+            else:
+                # rolling: backfill free slots from the queue into the
+                # very next dispatch, never waiting (resident rows are
+                # runnable NOW)
+                while len(pend) < len(free) and not closing:
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if nxt is self._STOP:
+                        closing = True
+                        break
+                    pend.append(nxt)
+                if pend:
+                    self._c_backfilled.inc(len(pend))
+            # ---- admit into slots --------------------------------------
+            admits = []
+            now = loop.time()
+            for p in pend:
+                if p.future.done():  # abandoned while queued
+                    continue
+                slot = free.pop()
+                occupied[slot] = p
+                cursors[slot] = 0
+                need[slot] = s.blocks_for(p.request)
+                waits[slot] = now - p.t_enq
+                admit_at[slot] = now
+                self._h_wait.observe(waits[slot])
+                admits.append((slot, p.request))
+            if admits:
+                try:
+                    await loop.run_in_executor(
+                        self._pool, s.admit_rows, admits)
+                except Exception as err:
+                    await self._fail_resident(
+                        occupied, cursors, need, waits, admit_at, free,
+                        err)
+                    continue
+            self._g_resident.set(len(occupied))
+            if not occupied:
+                if closing:
+                    return
+                continue
+            # ---- pick the cursor to advance ----------------------------
+            counts: Dict[int, int] = {}
+            for c in cursors.values():
+                counts[c] = counts.get(c, 0) + 1
+            bi = max(counts, key=lambda c: (counts[c], c))
+            oldest = min(occupied, key=lambda sl: admit_at[sl])
+            if starve >= self._starve_limit:
+                bi = cursors[oldest]
+            starve = 0 if cursors[oldest] == bi else starve + 1
+            sched = sorted(sl for sl, c in cursors.items() if c == bi)
+            retiring = [sl for sl in sched if cursors[sl] + 1 >= need[sl]]
+            # ---- fused dispatch of block ``bi`` ------------------------
+            self._h_occupancy.observe(float(len(sched)))
+            self._g_occupancy.set(len(sched))
+            self._c_batches.inc()
+            tracer = obs_trace.get_tracer()
+            span = contextlib.nullcontext()
+            if tracer:
+                tids = [occupied[sl].request.trace_id for sl in sched
+                        if occupied[sl].request.trace_id]
+                span = tracer.span(
+                    "batcher.block", "serve", block=bi,
+                    batch=len(sched), retiring=len(retiring),
+                    **({"trace_ids": tids} if tids else {}))
+            t0 = loop.time()
+            try:
+                with span:
+                    if faults.ACTIVE is not None:
+                        await faults.afire("serve.dispatch")
+                    results = await loop.run_in_executor(
+                        self._pool, s.step_finish, bi, sched, retiring)
+            except Exception as err:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                log.exception(
+                    "continuous dispatch failed (block %d, %d rows)",
+                    bi, len(sched))
+                await self._fail_resident(
+                    occupied, cursors, need, waits, admit_at, free, err)
+                continue
+            if self.breaker is not None:
+                self.breaker.record_success()
+            dispatch_s = loop.time() - t0
+            self._h_dispatch.observe(dispatch_s)
+            self._note_dispatch(dispatch_s)
+            # ---- advance & retire --------------------------------------
+            for sl in sched:
+                cursors[sl] += 1
+            for sl, result in results.items():
+                p = occupied.pop(sl)
+                blocks = need.pop(sl)
+                cursors.pop(sl)
+                w = waits.pop(sl)
+                admit_at.pop(sl)
+                free.append(sl)
+                if not p.future.done():
+                    p.future.set_result((result, {
+                        "batch": len(sched),
+                        "queue_s": w,
+                        "dispatch_s": dispatch_s,
+                        "blocks": blocks,
+                    }))
+            self._g_resident.set(len(occupied))
+
+    async def _fail_resident(self, occupied, cursors, need, waits,
+                             admit_at, free, err) -> None:
+        """A failed fused dispatch poisons the shared accumulator
+        (donated buffers), so every resident row fails typed
+        ``internal`` and the session recovers a fresh accumulator.
+        Queued (not yet admitted) requests are untouched."""
+        loop = asyncio.get_running_loop()
+        for sl, p in list(occupied.items()):
+            if not p.future.done():
+                p.future.set_exception(
+                    RequestError("internal", f"dispatch failed: {err}"))
+        free.extend(sorted(occupied))
+        occupied.clear()
+        cursors.clear()
+        need.clear()
+        waits.clear()
+        admit_at.clear()
+        self._g_resident.set(0)
+        with contextlib.suppress(Exception):
+            await loop.run_in_executor(self._pool, self._session.recover)

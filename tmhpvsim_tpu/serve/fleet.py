@@ -1,0 +1,316 @@
+"""In-process serving fleet: N replicated warm workers behind the
+shard-affinity router.
+
+:class:`ServeFleet` stands up ``n_workers`` full
+:class:`~tmhpvsim_tpu.serve.server.ScenarioServer` replicas — each a
+warm ``Simulation`` with its own metrics registry and its own request
+exchange ``{exchange}.w{i}`` — plus one
+:class:`~tmhpvsim_tpu.serve.router.ScenarioRouter` facing the clients'
+exchange, all over the same broker url.  Workers default to
+**continuous batching** (the fleet exists for throughput; the window
+scheduler remains available via ``FleetConfig.batching``).
+
+Warmth is the tfp.mcmc "compile once, sample forever" discipline at
+fleet scale: under a populated persistent compile cache
+(engine/compilecache.py) every replica AFTER the first deserialises its
+executables — ``executor.compile_cold_total == 0`` — so standing up or
+respawning a worker costs cache loads, not compiles.  The chaos
+acceptance test pins this for a replacement worker.
+
+Supervision rides :func:`~tmhpvsim_tpu.runtime.supervise
+.supervise_service` (the in-process analogue of ``--supervise``'s
+subprocess loop, same decorrelated backoff): with ``auto_respawn`` on,
+a worker whose :meth:`~tmhpvsim_tpu.serve.server.ScenarioServer.kill`
+fires (the chaos SIGKILL stand-in) is respawned warm, and the restart
+count lands on ``resilience.supervised_restarts.{name}`` in the fleet
+registry — the v16 ``serving.fleet`` per-worker ``restarts`` column.
+
+Metrics: the router's ``router.*`` family lives on the fleet registry;
+each worker life keeps its own registry, and :meth:`worker_snapshot`
+sums counters across a worker's lives (a killed life's counts must not
+vanish from the partition invariant the report tools check).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+from typing import List, Optional, Tuple
+
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs.trace import Tracer
+from tmhpvsim_tpu.runtime.supervise import supervise_service
+from tmhpvsim_tpu.serve.router import ScenarioRouter, WorkerHandle
+from tmhpvsim_tpu.serve.server import ScenarioServer, ServeConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """One fleet: the per-worker template + the tier knobs."""
+
+    #: per-worker template; ``base.exchange`` is the CLIENT-facing
+    #: exchange the router subscribes (workers get ``.w{i}`` suffixes)
+    base: ServeConfig
+    n_workers: int = 2
+    #: worker scheduler — the fleet defaults to continuous batching
+    batching: str = "continuous"
+    #: per-tenant token-bucket quota (requests/s; None = no quotas)
+    quota_rate: Optional[float] = None
+    quota_burst: Optional[float] = None
+    #: whole-router queue-depth shed threshold
+    inflight_limit: int = 1024
+    #: failover re-routes allowed per request
+    reroute_cap: int = 1
+    health_period_s: float = 0.1
+    #: supervised warm respawns per worker (``auto_respawn``)
+    max_restarts: int = 3
+    auto_respawn: bool = False
+
+
+class FleetWorker:
+    """One worker slot: the current server life + its past lives'
+    counter snapshots (summed into :meth:`snapshot`)."""
+
+    def __init__(self, index: int, name: str, exchange: str):
+        self.index = index
+        self.name = name
+        self.exchange = exchange
+        self.server: Optional[ScenarioServer] = None
+        self.registry: Optional[obs_metrics.MetricsRegistry] = None
+        self.lives = 0
+        self._dead_counters: List[dict] = []
+
+    def ready(self) -> tuple:
+        if self.server is None:
+            return False, {"spawned": False}
+        return self.server.readiness()
+
+    def retire_life(self) -> None:
+        if self.registry is not None:
+            self._dead_counters.append(
+                self.registry.snapshot().get("counters", {}))
+
+    def snapshot(self) -> dict:
+        """Current life's snapshot with counters summed across ALL
+        lives — a killed life's requests stay in the partition."""
+        snap = (self.registry.snapshot() if self.registry is not None
+                else {"counters": {}, "gauges": {}, "histograms": {}})
+        if self._dead_counters:
+            counters = dict(snap.get("counters", {}))
+            for dead in self._dead_counters:
+                for k, v in dead.items():
+                    counters[k] = counters.get(k, 0) + v
+            snap = {**snap, "counters": counters}
+        return snap
+
+
+class ServeFleet:
+    """See module docstring."""
+
+    def __init__(self, cfg: FleetConfig, *, registry=None,
+                 tracer: Optional[Tracer] = None):
+        if cfg.n_workers < 1:
+            raise ValueError(f"n_workers {cfg.n_workers} must be >= 1")
+        self.cfg = cfg
+        self.registry = registry or obs_metrics.get_registry()
+        self.tracer = tracer
+        self.workers = [
+            FleetWorker(i, f"w{i}", f"{cfg.base.exchange}.w{i}")
+            for i in range(cfg.n_workers)]
+        self.router: Optional[ScenarioRouter] = None
+        self._supervisors: List[asyncio.Task] = []
+        self._stopping = False
+
+    def worker_config(self, i: int) -> ServeConfig:
+        return dataclasses.replace(
+            self.cfg.base, exchange=self.workers[i].exchange,
+            batching=self.cfg.batching)
+
+    async def _spawn(self, i: int) -> None:
+        w = self.workers[i]
+        w.retire_life()
+        reg = obs_metrics.MetricsRegistry()
+        server = ScenarioServer(self.worker_config(i), registry=reg,
+                                tracer=self.tracer)
+        await server.start()
+        w.server, w.registry = server, reg
+        w.lives += 1
+        logger.info("fleet worker %s up (life %d) on exchange %r",
+                    w.name, w.lives, w.exchange)
+
+    async def start(self) -> None:
+        for i in range(self.cfg.n_workers):
+            await self._spawn(i)
+        handles = [WorkerHandle(w.name, w.exchange, w.ready)
+                   for w in self.workers]
+        self.router = ScenarioRouter(
+            self.cfg.base.url, self.cfg.base.exchange, handles,
+            registry=self.registry, tracer=self.tracer,
+            quota_rate=self.cfg.quota_rate,
+            quota_burst=self.cfg.quota_burst,
+            inflight_limit=self.cfg.inflight_limit,
+            request_timeout_s=self.cfg.base.timeout_s,
+            health_period_s=self.cfg.health_period_s,
+            reroute_cap=self.cfg.reroute_cap)
+        await self.router.start()
+        if self.cfg.auto_respawn:
+            self._supervisors = [
+                asyncio.create_task(supervise_service(
+                    self._worker_run(i),
+                    max_restarts=self.cfg.max_restarts,
+                    name=self.workers[i].name,
+                    registry=self.registry))
+                for i in range(self.cfg.n_workers)]
+
+    def _worker_run(self, i: int):
+        async def run(attempt: int) -> None:
+            w = self.workers[i]
+            if w.server is None or w.server._stopped:
+                await self._spawn(i)  # warm respawn: zero cold compiles
+            await w.server.died.wait()
+            if self._stopping:
+                return
+            raise RuntimeError(f"fleet worker {w.name} died")
+
+        return run
+
+    def readiness(self) -> tuple:
+        """Fleet ``/readyz``: the router's (ready iff >= 1 worker is)."""
+        if self.router is None:
+            return False, {"router": "not started"}
+        return self.router.readiness()
+
+    async def kill_worker(self, i: int) -> None:
+        """Chaos: simulated SIGKILL of worker ``i`` (no drain, no
+        farewell replies; the router health loop sheds and re-routes)."""
+        w = self.workers[i]
+        if w.server is not None:
+            logger.warning("fleet: killing worker %s", w.name)
+            await w.server.kill()
+
+    async def respawn_worker(self, i: int) -> None:
+        """Manual warm respawn (``auto_respawn`` does this itself)."""
+        await self._spawn(i)
+
+    async def stop(self, drain_timeout_s: Optional[float] = None)\
+            -> None:
+        self._stopping = True
+        # wake supervisors so they exit their died.wait() cleanly
+        for w in self.workers:
+            if w.server is not None:
+                w.server.died.set()
+        for t in self._supervisors:
+            t.cancel()
+        if self._supervisors:
+            await asyncio.wait(self._supervisors, timeout=2.0)
+        self._supervisors = []
+        if self.router is not None:
+            await self.router.stop(
+                drain_timeout_s if drain_timeout_s is not None
+                else self.cfg.base.drain_timeout_s)
+        for w in self.workers:
+            if w.server is not None:
+                with contextlib.suppress(Exception):
+                    await w.server.stop()
+
+    # ------------------------------------------------------------------
+    # reporting
+    # ------------------------------------------------------------------
+
+    def worker_snapshots(self) -> List[Tuple[str, dict]]:
+        return [(w.name, w.snapshot()) for w in self.workers]
+
+    def fleet_doc(self) -> Optional[dict]:
+        """The v16 ``serving.fleet`` sub-doc for this fleet's run."""
+        from tmhpvsim_tpu.obs.report import fleet_serving_section
+
+        return fleet_serving_section(self.registry.snapshot(),
+                                     self.worker_snapshots())
+
+    def attach_report(self, rep) -> None:
+        rep.attach_fleet_serving(self.registry.snapshot(),
+                                 self.worker_snapshots())
+
+
+async def serve_fleet_main(cfg: FleetConfig, *,
+                           compile_cache: Optional[str] = None,
+                           trace: Optional[str] = None,
+                           metrics_path: Optional[str] = None,
+                           run_report_path: Optional[str] = None,
+                           obs_port: Optional[int] = None,
+                           obs_bind: str = "127.0.0.1",
+                           install_signals: bool = True) -> None:
+    """App orchestrator behind ``pvsim serve --fleet N``: the fleet
+    analogue of :func:`~tmhpvsim_tpu.serve.server.serve_main`.  One
+    metrics registry carries the router + supervisor families (each
+    worker life keeps its own, merged into the v16 run report);
+    ``/readyz`` is the ROUTER's readiness — the fleet serves while at
+    least one worker is up."""
+    import signal
+
+    from tmhpvsim_tpu.engine import compilecache as cc
+    from tmhpvsim_tpu.obs import trace as obs_trace
+    from tmhpvsim_tpu.obs.live import maybe_obs_server
+
+    registry = obs_metrics.MetricsRegistry()
+    sink = None
+    if metrics_path:
+        sink = obs_metrics.make_sink(metrics_path)
+        registry.add_sink(sink)
+    tracer = Tracer() if trace else None
+    fleet = ServeFleet(cfg, registry=registry, tracer=tracer)
+    if obs_port is not None:
+        obs_trace.enable_propagation(True)
+    stop = asyncio.Event()
+    async with maybe_obs_server(obs_port, host=obs_bind,
+                                registry=registry, tracer=tracer,
+                                ready=fleet.readiness):
+        with obs_metrics.use_registry(registry):
+            if compile_cache is not None:
+                cc.configure(compile_cache)
+            if install_signals:
+                loop = asyncio.get_running_loop()
+                for sig in (signal.SIGINT, signal.SIGTERM):
+                    with contextlib.suppress(NotImplementedError):
+                        loop.add_signal_handler(sig, stop.set)
+            try:
+                await fleet.start()
+                await stop.wait()
+            except asyncio.CancelledError:
+                raise
+            except BaseException:
+                if tracer:
+                    with contextlib.suppress(Exception):
+                        tracer.dump_flight(trace + ".crash.json")
+                raise
+            finally:
+                with contextlib.suppress(Exception):
+                    await fleet.stop()
+                if tracer:
+                    with contextlib.suppress(Exception):
+                        tracer.export(trace, process_name="pvsim-fleet")
+                if run_report_path:
+                    try:
+                        from tmhpvsim_tpu.obs.report import RunReport
+
+                        w0 = fleet.workers[0].server
+                        rep = RunReport(
+                            "pvsim.serve-fleet",
+                            config=(w0.engine.sim.config
+                                    if w0 and w0.engine else cfg.base.sim),
+                            plan=(w0.engine.sim.plan
+                                  if w0 and w0.engine else None))
+                        rep.attach_metrics(registry)
+                        fleet.attach_report(rep)
+                        rep.write(run_report_path)
+                    except Exception as err:
+                        logger.warning("run report write failed: %s", err)
+                if sink is not None:
+                    registry.flush(event="end")
+                    registry.remove_sink(sink)
+                    with contextlib.suppress(Exception):
+                        sink.close()

@@ -1,0 +1,701 @@
+"""Shard-affinity request router: the front of the serving fleet.
+
+One :class:`ScenarioRouter` faces the clients' request exchange and
+spreads traffic across N replicated warm workers, each a full
+:class:`~tmhpvsim_tpu.serve.server.ScenarioServer` on its own request
+exchange over the SAME broker url (local://, tcp:// or amqp://ws).
+
+Routing.  Requests carrying a site selector (``site_index`` /
+``cohort``, PR 13) route by **consistent hashing** on the selector key:
+the ring (``vnodes`` virtual nodes per worker, stable md5 hashes) keeps
+a selector pinned to the same worker across requests — so each worker's
+per-selector device work and its duplicate-id replay LRU stay hot — and
+moves only ~1/N of the keyspace when the fleet changes.  Shardless
+requests fall back to **least-loaded** among ready workers.
+
+Health.  Each worker's ``ready`` callable (wired to its ``/readyz``
+readiness — warm AND not draining AND breaker closed, obs/live.py) is
+polled every ``health_period_s``; a worker that stops answering ready
+is taken out of rotation, and its in-flight requests are re-routed to
+the next ring preference (once per request: ``reroute_cap``).  The
+router stamps the chosen worker into the forwarded ``Message.meta``
+(``"worker"``) and echoes it on the reply, so a stitched trace reads
+client -> route -> admit -> dispatch -> reply with the worker named.
+
+Exactly-once replies.  The router rewrites ``reply_to`` to its own
+reply exchange and forwards each worker reply to the client's original
+exchange at most once (an answered-id LRU): a failover re-route that
+makes two workers answer the same id yields ONE client reply, and a
+replayed id that was already answered or is still in flight is rejected
+``duplicate`` at the router — it never reaches a second worker, so a
+replay can never double-execute (the satellite pin).
+
+Admission control.  Layered ahead of routing: per-tenant token-bucket
+quotas (``quota_rate``/``quota_burst``; requests carry an optional
+``tenant`` meta field) and whole-router queue-depth shedding
+(``inflight_limit``).  Both reject with typed ``busy`` carrying a
+``retry_after_ms`` hint derived from the quota refill time or the
+router's observed reply latency x queue depth — the client's
+``ResiliencePolicy`` backs off by the router's arithmetic, not jitter.
+
+Metrics (``router.*``): requests/routed/replies/rejected/rerouted/
+dup_replies counters, pending + ready-worker gauges, per-worker
+``router.inflight.{name}`` gauges and a reply-latency histogram — the
+RunReport v16 ``serving.fleet`` section reads them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import contextlib
+import dataclasses
+import datetime as _dt
+import hashlib
+import inspect
+import logging
+import time
+import uuid
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tmhpvsim_tpu.obs import metrics as obs_metrics
+from tmhpvsim_tpu.obs import trace as obs_trace
+from tmhpvsim_tpu.obs.trace import Tracer
+from tmhpvsim_tpu.runtime.broker import make_transport
+from tmhpvsim_tpu.runtime.resilience import (ResiliencePolicy, forever)
+from tmhpvsim_tpu.serve import schema
+
+logger = logging.getLogger(__name__)
+
+#: virtual nodes per worker on the hash ring — enough that removing one
+#: worker of four moves ~25% of keys, not a contiguous arc
+VNODES = 64
+
+#: tenants remembered by the quota LRU (an abusive tenant cardinality
+#: must not grow router memory)
+TENANTS_CAP = 1024
+
+#: answered request ids remembered for exactly-once forwarding (LRU)
+ANSWERED_CAP = 4096
+
+MAX_RETRY_AFTER_MS = 60_000
+
+
+def _stable_hash(key: str) -> int:
+    return int.from_bytes(
+        hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over worker names (stable md5, ``vnodes``
+    virtual nodes each).  ``preference(key)`` walks the ring from the
+    key's position and returns every worker once, in ring order — the
+    failover order for that key."""
+
+    def __init__(self, names: Sequence[str], vnodes: int = VNODES):
+        self._names = list(names)
+        self._ring: List[Tuple[int, str]] = sorted(
+            (_stable_hash(f"{name}#{v}"), name)
+            for name in self._names for v in range(vnodes))
+        self._hashes = [h for h, _ in self._ring]
+
+    def preference(self, key: str) -> List[str]:
+        if not self._ring:
+            return []
+        out: List[str] = []
+        seen = set()
+        i = bisect.bisect(self._hashes, _stable_hash(key))
+        for k in range(len(self._ring)):
+            name = self._ring[(i + k) % len(self._ring)][1]
+            if name not in seen:
+                seen.add(name)
+                out.append(name)
+                if len(out) == len(self._names):
+                    break
+        return out
+
+
+class TokenBucket:
+    """Per-tenant admission quota: ``burst`` tokens refilled at
+    ``rate``/s.  ``now`` injectable for tests."""
+
+    def __init__(self, rate: float, burst: float,
+                 now=time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._now = now
+        self._last = now()
+
+    def _refill(self) -> None:
+        t = self._now()
+        self._tokens = min(self.burst,
+                           self._tokens + (t - self._last) * self.rate)
+        self._last = t
+
+    def take(self) -> bool:
+        self._refill()
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+    def retry_after_s(self) -> float:
+        """Seconds until one token is available (0 when one already is)."""
+        self._refill()
+        if self._tokens >= 1.0:
+            return 0.0
+        return (1.0 - self._tokens) / self.rate if self.rate > 0 \
+            else float("inf")
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    """One routed worker: its request exchange and its readiness
+    callable (sync or async ``() -> (ok, detail)`` — a wired
+    ``ScenarioServer.readiness`` in-process, or an HTTP ``/readyz``
+    probe for a subprocess worker)."""
+
+    name: str
+    exchange: str
+    ready: Callable
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One in-flight routed request."""
+
+    meta: dict          # the forwarded request meta (for re-route)
+    reply_to: str       # the client's original reply exchange
+    worker: str         # currently assigned worker name
+    key: Optional[str]  # routing key (None = least-loaded fallback)
+    t0: float           # monotonic at admit
+    reroutes: int = 0
+
+
+class ScenarioRouter:
+    """See module docstring."""
+
+    def __init__(self, url: str, exchange: str,
+                 workers: Sequence[WorkerHandle], *,
+                 registry=None, tracer: Optional[Tracer] = None,
+                 quota_rate: Optional[float] = None,
+                 quota_burst: Optional[float] = None,
+                 inflight_limit: int = 1024,
+                 request_timeout_s: float = 60.0,
+                 health_period_s: float = 0.25,
+                 reroute_cap: int = 1,
+                 answered_cap: int = ANSWERED_CAP,
+                 reply_exchange: Optional[str] = None):
+        if not workers:
+            raise ValueError("router needs at least one worker")
+        names = [w.name for w in workers]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate worker names: {names}")
+        self._url = url
+        self._exchange = exchange
+        self.workers: Dict[str, WorkerHandle] = {
+            w.name: w for w in workers}
+        self.reply_exchange = reply_exchange or \
+            f"{exchange}.router.{uuid.uuid4().hex[:12]}"
+        self._ring = HashRing(names)
+        self._quota_rate = quota_rate
+        self._quota_burst = (quota_burst if quota_burst is not None
+                             else (quota_rate or 0.0))
+        self._buckets: OrderedDict = OrderedDict()
+        self._inflight_limit = int(inflight_limit)
+        self._request_timeout_s = float(request_timeout_s)
+        self._health_period_s = float(health_period_s)
+        self._reroute_cap = int(reroute_cap)
+        self._answered_cap = int(answered_cap)
+        self._pending: Dict[str, _Pending] = {}
+        self._answered: OrderedDict = OrderedDict()
+        self._ready: set = set()
+        self._inflight: Dict[str, int] = {n: 0 for n in names}
+        self._worker_tx: Dict[str, object] = {}
+        self._client_tx: Dict[str, object] = {}
+        self._req_tx = None
+        self._rep_tx = None
+        self._tasks: List[asyncio.Task] = []
+        self._send_tasks: set = set()
+        self._draining = False
+        self._stopped = False
+        self._ewma_reply_s: Optional[float] = None
+        self.tracer = tracer
+        reg = registry or obs_metrics.get_registry()
+        self.registry = reg
+        self._c_requests = reg.counter("router.requests_total")
+        self._c_routed = reg.counter("router.routed_total")
+        self._c_replies = reg.counter("router.replies_total")
+        self._c_rejected = reg.counter("router.rejected_total")
+        self._c_quota = reg.counter("router.quota_rejected_total")
+        self._c_shed = reg.counter("router.shed_total")
+        self._c_rerouted = reg.counter("router.rerouted_total")
+        self._c_dup_replies = reg.counter("router.dup_replies_total")
+        self._c_timeouts = reg.counter("router.timeouts_total")
+        self._c_down = reg.counter("router.worker_down_total")
+        self._g_pending = reg.gauge("router.pending")
+        self._g_ready = reg.gauge("router.workers_ready")
+        self._h_reply = reg.histogram("router.reply_latency_s")
+        self._g_worker = {n: reg.gauge(f"router.inflight.{n}")
+                          for n in names}
+        self._consume_policy = ResiliencePolicy(
+            attempts=forever, base_delay_s=0.1, max_delay_s=2.0,
+            name="router.consume", registry=reg)
+        self._reply_consume_policy = ResiliencePolicy(
+            attempts=forever, base_delay_s=0.1, max_delay_s=2.0,
+            name="router.reply_consume", registry=reg)
+        self._publish_policy = ResiliencePolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            name="router.publish", registry=reg)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def readiness(self) -> tuple:
+        """``(ok, detail)`` for the fleet's ``/readyz``: ready iff at
+        least one worker is."""
+        ok = bool(self._ready) and not self._draining
+        return ok, {"workers_ready": sorted(self._ready),
+                    "workers": sorted(self.workers),
+                    "draining": self._draining,
+                    "pending": len(self._pending)}
+
+    async def start(self) -> None:
+        # seed the ready set synchronously so the first routed request
+        # does not race the first health tick
+        await self._health_tick()
+        self._req_tx = make_transport(self._url, self._exchange)
+        await self._req_tx.__aenter__()
+        self._rep_tx = make_transport(self._url, self.reply_exchange)
+        await self._rep_tx.__aenter__()
+        for name, w in self.workers.items():
+            tx = make_transport(self._url, w.exchange)
+            await tx.__aenter__()
+            self._worker_tx[name] = tx
+        self._tasks = [
+            asyncio.create_task(self._consume_requests()),
+            asyncio.create_task(self._consume_replies()),
+            asyncio.create_task(self._health_loop()),
+        ]
+        if self.tracer:
+            self.tracer.instant("router.start", "serve",
+                                workers=sorted(self.workers))
+        logger.info(
+            "scenario router on %s exchange %r -> %d worker(s) %s",
+            self._url, self._exchange, len(self.workers),
+            sorted(self.workers))
+
+    def begin_drain(self) -> None:
+        self._draining = True
+
+    async def stop(self, drain_timeout_s: float = 30.0) -> None:
+        """Drain: stop admitting, give in-flight requests up to the
+        deadline to come back, then close."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._draining = True
+        deadline = time.monotonic() + drain_timeout_s
+        while self._pending and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.wait(self._tasks, timeout=1.0)
+        self._tasks = []
+        if self._send_tasks:
+            await asyncio.wait(list(self._send_tasks), timeout=1.0)
+        for tx in [self._req_tx, self._rep_tx,
+                   *self._worker_tx.values(),
+                   *self._client_tx.values()]:
+            if tx is not None:
+                with contextlib.suppress(Exception):
+                    await tx.__aexit__(None, None, None)
+        self._worker_tx.clear()
+        self._client_tx.clear()
+        self._req_tx = self._rep_tx = None
+        if self.tracer:
+            self.tracer.instant("router.stop", "serve")
+
+    # ------------------------------------------------------------------
+    # health
+    # ------------------------------------------------------------------
+
+    async def _check_ready(self, w: WorkerHandle) -> bool:
+        try:
+            r = w.ready()
+            if inspect.isawaitable(r):
+                r = await r
+            ok = bool(r[0]) if isinstance(r, tuple) else bool(r)
+        except Exception:
+            ok = False
+        return ok
+
+    async def _health_tick(self) -> None:
+        ready = set()
+        for name, w in self.workers.items():
+            if await self._check_ready(w):
+                ready.add(name)
+        went_down = self._ready - ready
+        self._ready = ready
+        self._g_ready.set(len(ready))
+        for name in went_down:
+            self._c_down.inc()
+            logger.warning("router: worker %r went not-ready; "
+                           "re-routing its in-flight requests", name)
+            if self.tracer:
+                self.tracer.instant("router.worker_down", "serve",
+                                    worker=name)
+            self._reroute_worker(name)
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._health_period_s)
+            await self._health_tick()
+            self._sweep_timeouts()
+
+    def _sweep_timeouts(self) -> None:
+        now = time.monotonic()
+        stale = [rid for rid, p in self._pending.items()
+                 if now - p.t0 > self._request_timeout_s]
+        for rid in stale:
+            p = self._pending.pop(rid)
+            self._dec_inflight(p.worker)
+            self._c_timeouts.inc()
+            self._finish(rid, p, schema.error_meta(
+                rid, "timeout",
+                f"no worker reply within "
+                f"{self._request_timeout_s:g} s",
+                trace_id=p.meta.get("trace_id")), count_reply=False)
+        self._g_pending.set(len(self._pending))
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+
+    async def _consume_requests(self) -> None:
+        async def run():
+            if self._req_tx is None:
+                tx = make_transport(self._url, self._exchange)
+                await tx.__aenter__()
+                self._req_tx = tx
+            try:
+                async for item in self._req_tx.subscribe(
+                        with_meta=True):
+                    _t, _v, meta = item
+                    self._handle(meta)
+            except BaseException:
+                tx, self._req_tx = self._req_tx, None
+                if tx is not None:
+                    with contextlib.suppress(Exception):
+                        await tx.__aexit__(None, None, None)
+                raise
+
+        await self._consume_policy.call(run)
+
+    @staticmethod
+    def routing_key(meta: dict) -> Optional[str]:
+        """The shard-affinity key of a request (None = shardless)."""
+        sc = meta.get("scenario")
+        if isinstance(sc, dict):
+            site = sc.get("site_index", -1)
+            if isinstance(site, int) and not isinstance(site, bool) \
+                    and site >= 0:
+                return f"site:{site}"
+            cohort = sc.get("cohort", -1)
+            if isinstance(cohort, int) and not isinstance(cohort, bool) \
+                    and cohort >= 0:
+                return f"cohort:{cohort}"
+        return None
+
+    def _retry_after_ms(self) -> int:
+        per = self._ewma_reply_s if self._ewma_reply_s is not None \
+            else 0.1
+        load = max(1.0, len(self._pending) / max(1, len(self._ready)
+                                                 or 1) / 8.0)
+        ms = int(per * load * 1000.0)
+        return max(1, min(MAX_RETRY_AFTER_MS, ms))
+
+    def _bucket_for(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = TokenBucket(self._quota_rate, self._quota_burst)
+            self._buckets[tenant] = b
+            while len(self._buckets) > TENANTS_CAP:
+                self._buckets.popitem(last=False)
+        else:
+            self._buckets.move_to_end(tenant)
+        return b
+
+    def _handle(self, meta) -> None:
+        if not isinstance(meta, dict) or \
+                meta.get("op") != schema.OP_REQUEST:
+            return
+        with obs_trace.extracted(meta):
+            self._handle_traced(meta)
+
+    def _handle_traced(self, meta: dict) -> None:
+        self._c_requests.inc()
+        rid = meta.get("id") if isinstance(meta.get("id"), str) else None
+        reply_to = meta.get("reply_to") \
+            if isinstance(meta.get("reply_to"), str) else None
+        tid = meta.get("trace_id")
+        tid = tid if isinstance(tid, str) else None
+        try:
+            if self._draining:
+                raise schema.RequestError(
+                    "draining", "router is draining; retry elsewhere")
+            if rid is None or reply_to is None:
+                raise schema.RequestError(
+                    "invalid", "request needs string id and reply_to")
+            # exactly-once guard: a replayed id that is in flight or
+            # already answered never reaches a (second) worker
+            if rid in self._pending or rid in self._answered:
+                if rid in self._answered:
+                    self._answered.move_to_end(rid)
+                raise schema.RequestError(
+                    "duplicate",
+                    f"request id {rid!r} already routed")
+            tenant = meta.get("tenant")
+            tenant = tenant if isinstance(tenant, str) and tenant \
+                else "default"
+            if self._quota_rate is not None:
+                bucket = self._bucket_for(tenant)
+                if not bucket.take():
+                    self._c_quota.inc()
+                    raise schema.RequestError(
+                        "busy",
+                        f"tenant {tenant!r} over quota "
+                        f"({self._quota_rate:g}/s)",
+                        retry_after_ms=int(
+                            bucket.retry_after_s() * 1000) + 1)
+            if len(self._pending) >= self._inflight_limit:
+                self._c_shed.inc()
+                raise schema.RequestError(
+                    "busy",
+                    f"router at in-flight limit "
+                    f"({self._inflight_limit})",
+                    retry_after_ms=self._retry_after_ms())
+            key = self.routing_key(meta)
+            worker = self._pick_worker(key)
+            if worker is None:
+                raise schema.RequestError(
+                    "unavailable", "no worker is ready",
+                    retry_after_ms=self._retry_after_ms())
+        except schema.RequestError as err:
+            self._c_rejected.inc()
+            if reply_to:
+                self._send(reply_to, schema.error_meta(
+                    rid, err.code, str(err), trace_id=tid,
+                    retry_after_ms=err.retry_after_ms))
+            return
+        fwd = dict(meta)
+        fwd["reply_to"] = self.reply_exchange
+        fwd["worker"] = worker  # the stitched-trace worker stamp
+        self._pending[rid] = _Pending(
+            meta=fwd, reply_to=reply_to, worker=worker, key=key,
+            t0=time.monotonic())
+        self._inc_inflight(worker)
+        self._g_pending.set(len(self._pending))
+        self._c_routed.inc()
+        if self.tracer:
+            self.tracer.instant("router.route", "serve", id=rid,
+                                worker=worker,
+                                **({"key": key} if key else {}))
+        self._send_worker(worker, fwd, rid)
+
+    def _pick_worker(self, key: Optional[str]) -> Optional[str]:
+        if not self._ready:
+            return None
+        if key is not None:
+            for name in self._ring.preference(key):
+                if name in self._ready:
+                    return name
+            return None
+        # shardless: least-loaded among ready (ties by name for
+        # determinism)
+        return min(sorted(self._ready),
+                   key=lambda n: self._inflight[n])
+
+    def _inc_inflight(self, worker: str) -> None:
+        self._inflight[worker] = self._inflight.get(worker, 0) + 1
+        self._g_worker[worker].set(self._inflight[worker])
+
+    def _dec_inflight(self, worker: str) -> None:
+        self._inflight[worker] = max(
+            0, self._inflight.get(worker, 0) - 1)
+        g = self._g_worker.get(worker)
+        if g is not None:
+            g.set(self._inflight[worker])
+
+    # ------------------------------------------------------------------
+    # failover
+    # ------------------------------------------------------------------
+
+    def _reroute_worker(self, dead: str) -> None:
+        """Re-route every in-flight request assigned to ``dead``.  The
+        answered-id LRU keeps this exactly-once for the client even if
+        the dead worker's reply later limps in through a partition."""
+        for rid, p in list(self._pending.items()):
+            if p.worker != dead:
+                continue
+            self._dec_inflight(dead)
+            if p.reroutes >= self._reroute_cap:
+                self._pending.pop(rid)
+                self._c_rejected.inc()
+                self._finish(rid, p, schema.error_meta(
+                    rid, "unavailable",
+                    f"worker {dead!r} died and the re-route budget "
+                    f"({self._reroute_cap}) is spent",
+                    trace_id=p.meta.get("trace_id"),
+                    retry_after_ms=self._retry_after_ms()),
+                    count_reply=False)
+                continue
+            nxt = self._pick_worker(p.key)
+            if nxt is None or nxt == dead:
+                self._pending.pop(rid)
+                self._c_rejected.inc()
+                self._finish(rid, p, schema.error_meta(
+                    rid, "unavailable",
+                    f"worker {dead!r} died with no ready fallback",
+                    trace_id=p.meta.get("trace_id"),
+                    retry_after_ms=self._retry_after_ms()),
+                    count_reply=False)
+                continue
+            p.worker = nxt
+            p.reroutes += 1
+            p.meta = dict(p.meta)
+            p.meta["worker"] = nxt
+            self._inc_inflight(nxt)
+            self._c_rerouted.inc()
+            if self.tracer:
+                self.tracer.instant("router.reroute", "serve", id=rid,
+                                    worker=nxt, dead=dead)
+            self._send_worker(nxt, p.meta, rid)
+        self._g_pending.set(len(self._pending))
+
+    # ------------------------------------------------------------------
+    # reply path
+    # ------------------------------------------------------------------
+
+    async def _consume_replies(self) -> None:
+        async def run():
+            if self._rep_tx is None:
+                tx = make_transport(self._url, self.reply_exchange)
+                await tx.__aenter__()
+                self._rep_tx = tx
+            try:
+                async for _t, _v, meta in self._rep_tx.subscribe(
+                        with_meta=True):
+                    if not isinstance(meta, dict) or \
+                            meta.get("op") != schema.OP_REPLY:
+                        continue
+                    self._on_reply(meta)
+            except BaseException:
+                tx, self._rep_tx = self._rep_tx, None
+                if tx is not None:
+                    with contextlib.suppress(Exception):
+                        await tx.__aexit__(None, None, None)
+                raise
+
+        await self._reply_consume_policy.call(run)
+
+    def _on_reply(self, meta: dict) -> None:
+        rid = meta.get("id")
+        p = self._pending.pop(rid, None) if isinstance(rid, str) \
+            else None
+        if p is None:
+            # late/duplicate reply (a rerouted twin, or one that limped
+            # in after the timeout sweep): drop — exactly-once
+            self._c_dup_replies.inc()
+            return
+        self._dec_inflight(p.worker)
+        self._g_pending.set(len(self._pending))
+        latency = time.monotonic() - p.t0
+        self._h_reply.observe(latency)
+        e = self._ewma_reply_s
+        self._ewma_reply_s = (latency if e is None
+                              else 0.2 * latency + 0.8 * e)
+        out = dict(meta)
+        out["worker"] = p.worker  # stitched trace: who answered
+        self._finish(rid, p, out)
+
+    def _finish(self, rid: str, p: _Pending, reply_meta: dict,
+                count_reply: bool = True) -> None:
+        """Forward one reply to the client's original exchange and
+        remember the id as answered (exactly-once)."""
+        self._answered[rid] = None
+        while len(self._answered) > self._answered_cap:
+            self._answered.popitem(last=False)
+        if count_reply:
+            self._c_replies.inc()
+        if self.tracer:
+            self.tracer.instant("router.reply", "serve", id=rid,
+                                worker=p.worker,
+                                ok=bool(reply_meta.get("ok")))
+        self._send(p.reply_to, reply_meta)
+
+    # ------------------------------------------------------------------
+    # publish plumbing
+    # ------------------------------------------------------------------
+
+    def _send_worker(self, worker: str, meta: dict, rid: str) -> None:
+        task = asyncio.create_task(
+            self._publish_worker(worker, meta, rid))
+        self._send_tasks.add(task)
+        task.add_done_callback(self._send_tasks.discard)
+
+    async def _publish_worker(self, worker: str, meta: dict,
+                              rid: str) -> None:
+        tx = self._worker_tx.get(worker)
+        if tx is None:
+            return
+        try:
+            await self._publish_policy.call(
+                tx.publish, 0.0, _now(), meta=meta,
+                name="router.forward")
+        except Exception:
+            # the worker's transport is gone: treat as a death — the
+            # health loop's reroute path owns recovery, but kick it now
+            # for this request rather than waiting a tick
+            logger.warning("router: forward to %r failed", worker,
+                           exc_info=True)
+            p = self._pending.get(rid)
+            if p is not None and p.worker == worker:
+                self._ready.discard(worker)
+                self._reroute_worker(worker)
+
+    def _send(self, exchange: str, meta: dict) -> None:
+        task = asyncio.create_task(self._publish_client(exchange, meta))
+        self._send_tasks.add(task)
+        task.add_done_callback(self._send_tasks.discard)
+
+    async def _publish_client(self, exchange: str, meta: dict) -> None:
+        async def attempt():
+            tx = self._client_tx.get(exchange)
+            if tx is None:
+                tx = make_transport(self._url, exchange)
+                await tx.__aenter__()
+                self._client_tx[exchange] = tx
+            try:
+                await tx.publish(0.0, _now(), meta=meta)
+            except BaseException:
+                self._client_tx.pop(exchange, None)
+                with contextlib.suppress(Exception):
+                    await tx.__aexit__(None, None, None)
+                raise
+
+        with contextlib.suppress(Exception):
+            await self._publish_policy.call(
+                attempt, name="router.reply_forward")
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc).replace(tzinfo=None)

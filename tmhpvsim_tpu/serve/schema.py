@@ -34,7 +34,8 @@ Reply meta (on ``reply_to``)::
     {"op": "scenario-reply", "id": ..., "ok": true,
      "mode": ..., "result": {...}, "t": {queue/dispatch/batch timings}}
     {"op": "scenario-reply", "id": ..., "ok": false,
-     "error": {"code": "<ERROR_CODES>", "message": ...}}
+     "error": {"code": "<ERROR_CODES>", "message": ...,
+               "retry_after_ms": <optional int: busy/unavailable hint>}}
 
 Validation is strict — unknown scenario knobs, non-finite values and
 out-of-bounds values are typed ``invalid`` rejections, never silently
@@ -86,12 +87,28 @@ _MAX_EXCHANGE_LEN = 128
 
 class RequestError(ValueError):
     """A typed request rejection: ``code`` is one of :data:`ERROR_CODES`
-    and lands verbatim in the error reply."""
+    and lands verbatim in the error reply.
 
-    def __init__(self, code: str, message: str):
+    ``retry_after_ms`` (busy/unavailable rejections) is the server's
+    load-derived hint for when a retry is worth sending — batcher
+    window + queue depth, or the breaker's remaining reset time.  It
+    rides the error reply and feeds ``ResiliencePolicy``'s backoff via
+    the ``retry_after_s`` attribute hint instead of blind jitter.
+    """
+
+    def __init__(self, code: str, message: str,
+                 retry_after_ms: Optional[int] = None):
         assert code in ERROR_CODES, code
         super().__init__(message)
         self.code = code
+        self.retry_after_ms = (None if retry_after_ms is None
+                               else max(0, int(retry_after_ms)))
+
+    @property
+    def retry_after_s(self) -> Optional[float]:
+        if self.retry_after_ms is None:
+            return None
+        return self.retry_after_ms / 1000.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +148,9 @@ class Request:
     scenario: Scenario
     trace_id: Optional[str] = None
     span_id: Optional[str] = None
+    #: admission-control tenant tag (router token-bucket quotas);
+    #: absent parses as None and the request draws the default quota
+    tenant: Optional[str] = None
 
 
 def _check_float(name: str, v, lo: float, hi: float) -> float:
@@ -237,11 +257,20 @@ def parse_request(meta, *, max_horizon_s: int,
     if mode not in MODES:
         raise RequestError(
             "invalid", f"mode {mode!r} not one of {', '.join(MODES)}")
+    # "tenant" is the admission-control tag; "worker" is the router's
+    # chosen-worker stamp (trace stitching) — both ride through workers
     unknown = sorted(set(meta) - {"op", "id", "reply_to", "mode",
-                                  "scenario", "trace_id", "span_id"})
+                                  "scenario", "trace_id", "span_id",
+                                  "tenant", "worker"})
     if unknown:
         raise RequestError(
             "invalid", f"unknown request field(s) {', '.join(unknown)}")
+    tenant = meta.get("tenant")
+    if tenant is not None and (not isinstance(tenant, str)
+                               or not 1 <= len(tenant) <= _MAX_ID_LEN):
+        raise RequestError(
+            "invalid",
+            f"tenant: expected a 1..{_MAX_ID_LEN} char string")
     scenario = parse_scenario(meta.get("scenario"),
                               max_horizon_s=max_horizon_s,
                               n_sites=n_sites, n_cohorts=n_cohorts)
@@ -249,7 +278,8 @@ def parse_request(meta, *, max_horizon_s: int,
     return Request(
         id=rid, reply_to=reply_to, mode=mode, scenario=scenario,
         trace_id=tid if isinstance(tid, str) and tid else None,
-        span_id=sid if isinstance(sid, str) and sid else None)
+        span_id=sid if isinstance(sid, str) and sid else None,
+        tenant=tenant)
 
 
 def request_meta(rid: str, reply_to: str, mode: str = "reduce",
@@ -275,10 +305,13 @@ def ok_meta(rid: str, mode: str, result: dict,
 
 
 def error_meta(rid: Optional[str], code: str, message: str,
-               trace_id: Optional[str] = None) -> dict:
+               trace_id: Optional[str] = None,
+               retry_after_ms: Optional[int] = None) -> dict:
     assert code in ERROR_CODES, code
-    meta = {"op": OP_REPLY, "id": rid, "ok": False,
-            "error": {"code": code, "message": message}}
+    err = {"code": code, "message": message}
+    if retry_after_ms is not None:
+        err["retry_after_ms"] = max(0, int(retry_after_ms))
+    meta = {"op": OP_REPLY, "id": rid, "ok": False, "error": err}
     if trace_id:
         meta["trace_id"] = trace_id
     return meta

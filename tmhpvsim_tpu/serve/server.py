@@ -51,7 +51,7 @@ from tmhpvsim_tpu.runtime.broker import make_transport
 from tmhpvsim_tpu.runtime.resilience import (CircuitBreaker,
                                              ResiliencePolicy, forever)
 from tmhpvsim_tpu.serve import schema
-from tmhpvsim_tpu.serve.batcher import MicroBatcher
+from tmhpvsim_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
 from tmhpvsim_tpu.serve.schema import Request, RequestError, Scenario
 
 logger = logging.getLogger(__name__)
@@ -111,6 +111,17 @@ class ServeConfig:
     breaker_threshold: int = 5
     #: seconds an open breaker waits before letting a probe batch through
     breaker_reset_s: float = 30.0
+    #: batch scheduler: ``"window"`` (the PR-7 coalescer — every row of
+    #: a dispatch retires together) or ``"continuous"`` (rolling
+    #: block-granular dispatch with backfill; see serve/batcher.py).
+    #: The default stays "window" so a fleet-off server lowers to the
+    #: byte-identical HLO of previous releases — continuous mode's
+    #: extra executables (masked row reset) only build when asked for.
+    batching: str = "window"
+    #: continuous mode only: dispatches a resident row's cursor may be
+    #: skipped before it is forced (lower = tighter tail latency for
+    #: long-horizon rows, higher = fatter fused batches)
+    starve_limit: int = 4
 
     def buckets(self) -> Tuple[int, ...]:
         bs = tuple(sorted({int(b) for b in self.batch_sizes})) \
@@ -170,6 +181,41 @@ class ScenarioEngine:
         #: per-block host inputs, computed once (host float64 work)
         self._inputs = [self.sim.host_inputs(bi)[0]
                         for bi in range(self.sim.n_blocks)]
+        #: chain state at each block boundary, cached as continuous
+        #: batching discovers it (see :meth:`block_state`); costs at
+        #: most ``n_blocks`` extra state-sized device buffers
+        self._block_states = {0: self._state0}
+
+    def block_state(self, bi: int):
+        """Chain state at the start of block ``bi``.
+
+        The chain state is deterministic and scenario-INDEPENDENT —
+        scenario knobs only perturb the per-row fold, never the RNG or
+        model state (``Simulation._scenario_block_core``) — so states
+        computed once are valid for every request.  Continuous batching
+        leans on this: a row admitted mid-stream at block cursor 0 and
+        a row already at cursor k both dispatch against the cached
+        state of THEIR OWN block, which is bit-identical to the state a
+        serial batch-of-1 run would have reached.  The cache fills in
+        dispatch order, so any resident cursor's state is present by
+        construction (a row only reaches cursor k after block k-1
+        dispatched and stored state k)."""
+        return self._block_states[bi]
+
+    def store_block_state(self, bi: int, state) -> None:
+        """Cache the post-block state a dispatch just produced (no-op
+        when already known; the returned buffer is fresh, never a
+        donated alias)."""
+        if bi < self.sim.n_blocks and bi not in self._block_states:
+            self._block_states[bi] = state
+
+    def open_rolling(self, bucket: Optional[int] = None
+                     ) -> "RollingSession":
+        """One continuous-batching slot protocol over this engine
+        (bucket defaults to the largest — already ``batch_align``
+        rounded — compiled bucket)."""
+        return RollingSession(
+            self, max(self.buckets) if bucket is None else bucket)
 
     def run(self, requests: Sequence[Request]) -> List[dict]:
         """Answer a batch: one fused dispatch chain over the blocks the
@@ -248,6 +294,155 @@ class ScenarioEngine:
         }})
 
 
+class RollingSession:
+    """Device-side slot protocol of continuous batching (the scheduler
+    is :class:`~tmhpvsim_tpu.serve.batcher.ContinuousBatcher`).
+
+    One fixed ``bucket``-wide accumulator rolls forever.  Each resident
+    request owns a slot; each fused dispatch folds ONE block index for
+    the slots scheduled at that cursor.  Bit-identity with batch-of-1
+    falls out of three established properties:
+
+    * rows the dispatch does NOT schedule ride along with
+      ``horizon_s=0`` — the bit-inert padding row
+      (``Simulation._block_step_scan_scenario`` folds nothing for it),
+      so their accumulator bits and everyone else's are untouched;
+    * scheduled rows carry their TRUE horizon, and block ``bi`` covers
+      global seconds ``[bi*block_s, (bi+1)*block_s)``, so the validity
+      mask ``t < horizon_s`` folds exactly the seconds a serial run of
+      that row would fold in that block — in the same block order,
+      against the same cached chain state (:meth:`ScenarioEngine
+      .block_state`);
+    * a newly admitted slot's accumulator row is re-initialised on
+      device by a masked select against the pristine init template —
+      bit-equal to ``init_scenario_acc``'s values.
+
+    Thread contract: all methods run on the batcher's single dispatch
+    worker thread (same as ``ScenarioEngine.run``).
+    """
+
+    def __init__(self, engine: ScenarioEngine, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        self.engine = engine
+        self.bucket = int(bucket)
+        if self.bucket % engine.batch_align != 0:
+            raise ValueError(
+                f"rolling bucket {bucket} must be a multiple of "
+                f"batch_align {engine.batch_align}")
+        dt = np.dtype(engine.dtype)
+        no_cap = float(np.finfo(dt).max)
+        #: neutral (padding) fill per knob column — a free slot is a
+        #: bit-inert padding row
+        self._neutral = {
+            "demand_scale": (dt, 1.0),
+            "demand_shift_w": (dt, 0.0),
+            "pv_scale": (dt, 1.0),
+            "weather_bias": (dt, 1.0),
+            "curtail_w": (dt, no_cap),
+            "site_index": (np.int32, -1),
+            "cohort": (np.int32, -1),
+        }
+        self._no_cap = no_cap
+        self._cols = {k: np.full((self.bucket,), fill, d)
+                      for k, (d, fill) in self._neutral.items()}
+        self._horizons = np.zeros(self.bucket, np.int32)
+        self._reqs: List[Optional[Request]] = [None] * self.bucket
+        self._totals: List[Optional[dict]] = [None] * self.bucket
+        #: pristine init accumulator — the masked row reset selects
+        #: from it, so re-admitted rows start bit-equal to a fresh
+        #: ``init_scenario_acc`` (never donated)
+        self._acc0 = engine.sim.init_scenario_acc(self.bucket)
+        self.acc = engine.sim.init_scenario_acc(self.bucket)
+
+        def _reset(acc, acc0, mask):
+            return jax.tree.map(
+                lambda a, z: jnp.where(mask[:, None], z, a), acc, acc0)
+
+        #: masked row re-init (donates ``acc``); compiled here so the
+        #: serving START absorbs it and a warm worker's first admit
+        #: compiles nothing
+        self._reset = jax.jit(_reset, donate_argnums=(0,))
+        self.acc = self._reset(self.acc, self._acc0,
+                               np.zeros(self.bucket, bool))
+
+    def blocks_for(self, request: Request) -> int:
+        """Blocks this request's horizon needs (its retirement cursor)."""
+        cfg = self.engine.sim.config
+        return min(self.engine.sim.n_blocks,
+                   -(-int(request.scenario.horizon_s) // cfg.block_s))
+
+    def admit_rows(self, items: Sequence[Tuple[int, Request]]) -> None:
+        """Bind requests to free slots: write their knob columns and
+        re-initialise exactly their accumulator rows on device."""
+        mask = np.zeros(self.bucket, bool)
+        for slot, req in items:
+            s = req.scenario
+            self._cols["demand_scale"][slot] = s.demand_scale
+            self._cols["demand_shift_w"][slot] = s.demand_shift_w
+            self._cols["pv_scale"][slot] = s.dc_capacity_scale
+            self._cols["weather_bias"][slot] = s.weather_bias
+            self._cols["curtail_w"][slot] = (
+                self._no_cap if s.curtail_w is None else s.curtail_w)
+            self._cols["site_index"][slot] = s.site_index
+            self._cols["cohort"][slot] = s.cohort
+            self._horizons[slot] = s.horizon_s
+            self._reqs[slot] = req
+            self._totals[slot] = None
+            mask[slot] = True
+        self.acc = self._reset(self.acc, self._acc0, mask)
+
+    def step_finish(self, bi: int, sched: Sequence[int],
+                    retiring: Sequence[int]) -> dict:
+        """One fused dispatch of block ``bi`` for the slots in
+        ``sched``; returns ``{slot: formatted result}`` for the slots
+        in ``retiring`` (their horizon completes with this block)."""
+        import jax
+        from tmhpvsim_tpu.engine.simulation import _copy_jit
+
+        e = self.engine
+        scen = dict(self._cols)
+        # the per-dispatch horizon mask IS the scheduler: scheduled
+        # rows fold their true horizon's share of this block, everyone
+        # else is a horizon-0 padding row this round
+        h = np.zeros(self.bucket, np.int32)
+        for sl in sched:
+            h[sl] = self._horizons[sl]
+        scen["horizon_s"] = h
+        state = _copy_jit(e.block_state(bi))
+        state, self.acc, fdelta = e.sim.scenario_step(
+            state, e._inputs[bi], self.acc, scen)
+        e.store_block_state(bi + 1, state)
+        fd = jax.device_get(fdelta)
+        for sl in sched:
+            self._totals[sl] = flt.merge_host(
+                self._totals[sl], {k: v[sl] for k, v in fd.items()})
+        out = {}
+        if retiring:
+            acc_h = jax.device_get(self.acc)
+            for sl in retiring:
+                row = {k: np.asarray(v[sl]) for k, v in acc_h.items()}
+                out[sl] = e._format(self._reqs[sl], row,
+                                    self._totals[sl])
+                self._release(sl)
+        return out
+
+    def _release(self, slot: int) -> None:
+        for k, (_d, fill) in self._neutral.items():
+            self._cols[k][slot] = fill
+        self._horizons[slot] = 0
+        self._reqs[slot] = None
+        self._totals[slot] = None
+
+    def recover(self) -> None:
+        """After a failed dispatch (the donated accumulator is gone):
+        fresh accumulator, every slot back to padding."""
+        self.acc = self.engine.sim.init_scenario_acc(self.bucket)
+        for slot in range(self.bucket):
+            self._release(slot)
+
+
 class ScenarioServer:
     """The asyncio serving front (see module docstring)."""
 
@@ -267,6 +462,9 @@ class ScenarioServer:
         self._draining = False
         self._stopped = False
         self._drain_event: Optional[asyncio.Event] = None
+        #: set by :meth:`kill` (chaos): the fleet supervisor's respawn
+        #: signal, the in-process analogue of SIGCHLD
+        self.died = asyncio.Event()
         reg = self.registry
         self._c_requests = reg.counter("serve.requests_total")
         self._c_replies = reg.counter("serve.replies_total")
@@ -308,22 +506,36 @@ class ScenarioServer:
     async def start(self) -> None:
         """Build the warm engine (compiles — possibly from the warm
         cache), open the request subscription, start the batcher."""
+        if self.cfg.batching not in ("window", "continuous"):
+            raise ValueError(
+                f"batching {self.cfg.batching!r} not one of "
+                "'window', 'continuous'")
         self._drain_event = asyncio.Event()
         with obs_metrics.use_registry(self.registry):
             self.engine = ScenarioEngine(self.cfg.sim,
                                          self.cfg.buckets())
-            self.batcher = MicroBatcher(
-                self.engine.run,
-                window_s=self.cfg.window_s,
-                max_batch=max(self.engine.buckets),
-                queue_limit=self.cfg.queue_limit,
-                batch_align=self.engine.batch_align,
-                registry=self.registry,
-                breaker=CircuitBreaker(
-                    "serve.dispatch",
-                    failure_threshold=self.cfg.breaker_threshold,
-                    reset_s=self.cfg.breaker_reset_s,
-                    registry=self.registry))
+            breaker = CircuitBreaker(
+                "serve.dispatch",
+                failure_threshold=self.cfg.breaker_threshold,
+                reset_s=self.cfg.breaker_reset_s,
+                registry=self.registry)
+            if self.cfg.batching == "continuous":
+                self.batcher = ContinuousBatcher(
+                    self.engine.open_rolling(),
+                    window_s=self.cfg.window_s,
+                    queue_limit=self.cfg.queue_limit,
+                    registry=self.registry,
+                    breaker=breaker,
+                    starve_limit=self.cfg.starve_limit)
+            else:
+                self.batcher = MicroBatcher(
+                    self.engine.run,
+                    window_s=self.cfg.window_s,
+                    max_batch=max(self.engine.buckets),
+                    queue_limit=self.cfg.queue_limit,
+                    batch_align=self.engine.batch_align,
+                    registry=self.registry,
+                    breaker=breaker)
             self.batcher.start()
             self._req_tx = make_transport(self.cfg.url, self.cfg.exchange)
             await self._req_tx.__aenter__()
@@ -398,6 +610,35 @@ class ScenarioServer:
         self._reply_tx.clear()
         if self.tracer:
             self.tracer.instant("serve.stop", "serve")
+
+    async def kill(self) -> None:
+        """Simulated SIGKILL (chaos tests): stop consuming, cancel
+        every in-flight reply task, drop queued work unreplied and
+        close transports — no drain, no ``draining`` rejections, no
+        farewell replies.  A killed process says nothing; the fleet
+        router's health loop and reroute path are what keep the
+        requests alive.  Sets :attr:`died` for the fleet supervisor."""
+        self._stopped = True
+        self._draining = True
+        self.died.set()
+        if self._consume_task is not None:
+            self._consume_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError,
+                                     ConnectionError):
+                await self._consume_task
+            self._consume_task = None
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks:
+            await asyncio.wait(list(self._tasks), timeout=1.0)
+        if self.batcher is not None:
+            self.batcher.kill()
+        for tx in [self._req_tx, *self._reply_tx.values()]:
+            if tx is not None:
+                with contextlib.suppress(Exception):
+                    await tx.__aexit__(None, None, None)
+        self._req_tx = None
+        self._reply_tx.clear()
 
     # ------------------------------------------------------------------
     # request path
@@ -478,8 +719,9 @@ class ScenarioServer:
                        err.code, err)
         if reply_to:  # no reply address -> counted, nothing to say
             task = asyncio.create_task(self._publish_reply(
-                reply_to, schema.error_meta(rid, err.code, str(err),
-                                            trace_id=trace_id)))
+                reply_to, schema.error_meta(
+                    rid, err.code, str(err), trace_id=trace_id,
+                    retry_after_ms=err.retry_after_ms)))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
 
@@ -500,7 +742,8 @@ class ScenarioServer:
             except RequestError as err:
                 self._c_rejected.inc()
                 await self._publish_reply(req.reply_to, schema.error_meta(
-                    req.id, err.code, str(err), trace_id=req.trace_id))
+                    req.id, err.code, str(err), trace_id=req.trace_id,
+                    retry_after_ms=err.retry_after_ms))
                 return
             except Exception as err:  # engine bug: reply, do not wedge
                 logger.exception("scenario request %s failed", req.id)
@@ -561,7 +804,8 @@ class ScenarioClient:
 
     def __init__(self, url: str, exchange: str = "scenario",
                  reply_to: Optional[str] = None,
-                 policy: Optional[ResiliencePolicy] = None):
+                 policy: Optional[ResiliencePolicy] = None,
+                 rejection_policy: Optional[ResiliencePolicy] = None):
         self._url = url
         self._exchange = exchange
         self.reply_to = reply_to or \
@@ -573,6 +817,15 @@ class ScenarioClient:
         #: bounded retry policy for request publishes (None = one shot);
         #: reply timeouts stay the caller's ``timeout`` budget
         self._policy = policy
+        #: typed busy/unavailable replies re-issue the SAME request id
+        #: under this policy (None = surface them as values).  The
+        #: server's ``retry_after_ms`` hint, when present, REPLACES the
+        #: policy's decorrelated jitter (resilience.py honours the
+        #: ``retry_after_s`` exception attribute): the server knows its
+        #: queue depth and breaker reset, the dice do not.  Safe by
+        #: construction — busy/unavailable shed BEFORE execution, so a
+        #: retried id can never double-execute or trip the replay LRU.
+        self._rejection_policy = rejection_policy
         #: the reply subscription reconnects-and-resubscribes forever —
         #: a broker blip must not strand every pending future
         self._consume_policy = ResiliencePolicy(
@@ -628,14 +881,46 @@ class ScenarioClient:
 
     async def request(self, scenario: Optional[dict] = None,
                       mode: str = "reduce", rid: Optional[str] = None,
-                      timeout: float = 60.0) -> dict:
+                      timeout: float = 60.0,
+                      tenant: Optional[str] = None) -> dict:
         """One scenario query -> the reply meta dict (``ok`` true or
-        false — typed errors come back as values, not exceptions)."""
+        false — typed errors come back as values, not exceptions).
+        With a ``rejection_policy``, typed busy/unavailable replies are
+        retried under it (same id, server ``retry_after_ms`` hint
+        honoured) and only the final reply surfaces."""
         rid = rid or uuid.uuid4().hex[:16]
+        if self._rejection_policy is None:
+            return await self._request_once(scenario, mode, rid,
+                                            timeout, tenant)
+
+        async def attempt():
+            reply = await self._request_once(scenario, mode, rid,
+                                             timeout, tenant)
+            err = reply.get("error") if not reply.get("ok") else None
+            if err and err.get("code") in ("busy", "unavailable"):
+                exc = RequestError(err["code"],
+                                   err.get("message", ""),
+                                   retry_after_ms=err.get(
+                                       "retry_after_ms"))
+                exc.reply = reply  # surfaced on retry exhaustion
+                raise exc
+            return reply
+
+        return await self._rejection_policy.call(
+            attempt, name="ScenarioClient.rejected",
+            fallback=lambda exc: getattr(
+                exc, "reply",
+                schema.error_meta(rid, "unavailable", str(exc))))
+
+    async def _request_once(self, scenario: Optional[dict],
+                            mode: str, rid: str, timeout: float,
+                            tenant: Optional[str] = None) -> dict:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._pending[rid] = fut
         meta = schema.request_meta(rid, self.reply_to, mode, scenario)
+        if tenant is not None:
+            meta["tenant"] = tenant
         # one trace per logical request: mint here (when propagation is
         # on) so the publish instant, the transport stamp and the reply
         # all share the id

@@ -320,6 +320,7 @@ def normalize(path: str) -> dict:
            "fleet_ratio": None, "mesh": None, "hosts": None,
            "comm_frac": None, "cost_err_pct": None,
            "attr": None, "fallback": False,
+           "fleet_workers": None, "cb_speedup": None,
            "failed": True}
     try:
         with open(path) as f:
@@ -357,10 +358,12 @@ def normalize(path: str) -> dict:
         cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
         fs, fr = _fleet_fields(doc)
+        fw, cb = _serve_fleet_fields(doc)
         mesh, hosts = _mesh_fields(doc)
         cf, cerr = _pod_fields(doc)
         row.update(
             failed=False,
+            fleet_workers=fw, cb_speedup=cb,
             platform=(doc.get("device") or {}).get("platform"),
             value=headline.get("site_seconds_per_s"),
             compile_s=timing.get("compile_s"),
@@ -383,13 +386,15 @@ def normalize(path: str) -> dict:
     # throughput value — the coalescing ratio IS the headline), and
     # --hosts multi-host mechanics artifacts
     if "value" in doc or "variants" in doc or "coalescing" in doc \
-            or "hosts" in doc:
+            or "hosts" in doc \
+            or doc.get("artifact") == "scenario-serve fleet load":
         rep = doc.get("run_report")
         tel, ana = _levels(rep.get("config")
                            if isinstance(rep, dict) else None)
         cdt, kimpl, rb, gs, prec_speed = _precision_axes(doc)
         nsf, vpu = _cost_fields(doc)
         fs, fr = _fleet_fields(doc)
+        fw, cb = _serve_fleet_fields(doc)
         mesh, hosts = _mesh_fields(doc)
         cf, cerr = _pod_fields(doc)
         # the round's OWN top-level headline is authoritative for the
@@ -402,6 +407,7 @@ def normalize(path: str) -> dict:
             nsf = float(top_nsf)
         row.update(
             failed=False,
+            fleet_workers=fw, cb_speedup=cb,
             platform=doc.get("platform"),
             value=doc.get("value"),
             compile_s=_compile_from_headline(doc),
@@ -516,6 +522,30 @@ def _fleet_fields(doc: dict) -> tuple:
     return None, None
 
 
+def _serve_fleet_fields(doc) -> tuple:
+    """(fleet_workers, cb_speedup) of the horizontally-scaled serving
+    tier — worker count from a ``bench.py --serve-fleet`` doc or a v16
+    ``serving.fleet`` report section, and the continuous-batching
+    sustained-throughput speedup over the single-worker window batcher
+    when the artifact timed both.  Fleet-less serves read (None, None)."""
+    workers = cb = None
+    if doc.get("artifact") == "scenario-serve fleet load":
+        workers = doc.get("workers")
+        cb = doc.get("speedup")
+    for rep in (doc, doc.get("run_report")):
+        if not isinstance(rep, dict) or rep.get("kind") != REPORT_KIND:
+            continue
+        sec = rep.get("serving")
+        fleet = sec.get("fleet") if isinstance(sec, dict) else None
+        if isinstance(fleet, dict) and workers is None:
+            workers = len(fleet.get("workers") or [])
+        hl = rep.get("headline")
+        if isinstance(hl, dict) and cb is None \
+                and isinstance(hl.get("speedup"), (int, float)):
+            cb = hl["speedup"]
+    return workers, cb
+
+
 def _fmt_fleet(r) -> str:
     """The ``fleet`` cell: site count, with the heterogeneous-over-
     homogeneous throughput ratio appended when bench.py timed both."""
@@ -542,6 +572,7 @@ def _fmt_cost(r) -> str:
 def print_table(rows: list) -> None:
     cols = ("round", "platform", "site-s/s/chip", "compile_s",
             "steady_block_s", "tel", "analytics", "ovh%", "serve",
+            "wrk", "cb",
             "cdt", "kimpl", "rb", "gs", "prec", "fleet", "cost",
             "mesh", "hosts", "comm%", "cost-err", "phases", "note")
     table = [cols]
@@ -551,12 +582,16 @@ def print_table(rows: list) -> None:
         prec = r.get("precision_speedup")
         cf = r.get("comm_frac")
         cerr = r.get("cost_err_pct")
+        fw = r.get("fleet_workers")
+        cb = r.get("cb_speedup")
         table.append((
             r["name"], r["platform"] or "-", _fmt(r["value"]),
             _fmt(r["compile_s"]), _fmt(r["steady_block_s"]),
             r.get("telemetry") or "-", r.get("analytics") or "-",
             "-" if ovh is None else f"{ovh:+.1f}",
             "-" if srv is None else f"{srv:.2f}x",
+            "-" if fw is None else str(fw),
+            "-" if cb is None else f"{cb:.2f}x",
             r.get("compute_dtype") or "-", r.get("kernel_impl") or "-",
             r.get("rng_batch") or "-",
             "-" if r.get("geom_stride") is None else str(r["geom_stride"]),

@@ -16,6 +16,16 @@ latency-quantile ordering, conservation between requests and outcomes —
 and printed as a readable SLO table with the request-coalescing ratio
 (requests per fused dispatch) the micro-batcher exists to maximise.
 
+A v16 ``serving.fleet`` sub-section (obs/report.py
+``fleet_serving_section``: the router's counters plus one row per
+worker) is validated too when present: router counters must be
+non-negative ints, outcomes must not exceed intake, and the per-worker
+request totals must PARTITION the router's forwarded total —
+``sum(workers[].requests) == router.routed + router.rerouted`` — i.e.
+every request the router forwarded landed on exactly one worker life
+and none materialised out of thin air.  Reports without the
+sub-section (single-worker serves, pre-v16) validate as before.
+
 Exit code 0 when every *present* serving section validates — reports
 without one (non-serving runs, pre-v6 documents) are fine and just
 noted, which is how ``run_tpu_round5b.sh`` consumes this non-fatally
@@ -108,6 +118,87 @@ def validate_serving(sec) -> list:
                        f"[1, max={occ['max']}]")
     for name in _LATENCY_KEYS:
         _validate_latency(sec.get(name), name, errors)
+    if "fleet" in sec and sec["fleet"] is not None:
+        errors.extend(validate_fleet(sec["fleet"]))
+    return errors
+
+
+#: router counters fleet_serving_section always emits (ints, >= 0)
+_ROUTER_KEYS = ("requests", "routed", "replies", "rejected",
+                "quota_rejected", "shed", "rerouted", "dup_replies",
+                "timeouts", "worker_down", "workers_ready", "pending")
+
+#: per-worker counters (ints, >= 0)
+_WORKER_KEYS = ("requests", "replies", "rejected", "timeouts",
+                "batches", "backfilled", "compile_cold", "compile_warm",
+                "restarts")
+
+
+def validate_fleet(fleet) -> list:
+    """Schema errors for one v16 ``serving.fleet`` sub-section."""
+    errors: list = []
+    if not isinstance(fleet, dict):
+        return [f"fleet is {type(fleet).__name__}, not an object"]
+    router = fleet.get("router")
+    workers = fleet.get("workers")
+    if not isinstance(router, dict):
+        errors.append("fleet.router missing/not an object")
+    if not isinstance(workers, list):
+        errors.append("fleet.workers missing/not a list")
+    if errors:
+        return errors
+    for key in _ROUTER_KEYS:
+        v = router.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errors.append(f"fleet.router.{key} missing/not an int")
+        elif v < 0:
+            errors.append(f"fleet.router.{key} negative: {v}")
+    _validate_latency(router.get("reply_latency"),
+                      "fleet.router.reply_latency", errors)
+    for i, w in enumerate(workers):
+        if not isinstance(w, dict):
+            errors.append(f"fleet.workers[{i}] not an object")
+            continue
+        if not isinstance(w.get("name"), str) or not w.get("name"):
+            errors.append(f"fleet.workers[{i}].name missing/empty")
+        for key in _WORKER_KEYS:
+            v = w.get(key)
+            if not isinstance(v, int) or isinstance(v, bool):
+                errors.append(
+                    f"fleet.workers[{i}].{key} missing/not an int")
+            elif v < 0:
+                errors.append(f"fleet.workers[{i}].{key} negative: {v}")
+        occ = w.get("occupancy")
+        if occ is not None:
+            if not isinstance(occ, dict):
+                errors.append(
+                    f"fleet.workers[{i}].occupancy neither object "
+                    f"nor null")
+            else:
+                for key in ("batches", "mean", "max", "p50"):
+                    _check(isinstance(occ.get(key), _NUM), errors,
+                           f"fleet.workers[{i}].occupancy.{key} "
+                           f"missing/non-numeric")
+    if errors:
+        return errors
+    names = [w["name"] for w in workers]
+    _check(len(set(names)) == len(names), errors,
+           f"duplicate worker names: {names}")
+    if all(isinstance(router.get(k), int)
+           for k in ("requests", "routed", "rejected")):
+        _check(router["routed"] + router["rejected"]
+               <= router["requests"], errors,
+               f"router routed+rejected "
+               f"({router['routed']}+{router['rejected']}) exceed "
+               f"requests ({router['requests']})")
+    # THE partition invariant: every forwarded request (original route
+    # or failover re-route) landed on exactly one worker life
+    forwarded = router["routed"] + router["rerouted"]
+    landed = sum(w["requests"] for w in workers)
+    _check(landed == forwarded, errors,
+           f"worker requests ({landed}) do not partition the router's "
+           f"forwarded total (routed {router['routed']} + rerouted "
+           f"{router['rerouted']} = {forwarded})")
     return errors
 
 
@@ -140,6 +231,27 @@ def print_serving(sec: dict, label: str) -> None:
     print(f"  queue wait  {_lat_line(sec.get('queue_wait'))}")
     print(f"  dispatch    {_lat_line(sec.get('dispatch'))}")
     print(f"  reply       {_lat_line(sec.get('reply_latency'))}")
+    if sec.get("fleet"):
+        print_fleet(sec["fleet"])
+
+
+def print_fleet(fleet: dict) -> None:
+    r = fleet["router"]
+    print(f"  fleet       {len(fleet['workers'])} worker(s), "
+          f"{r['workers_ready']} ready  (routed={r['routed']:,} "
+          f"rerouted={r['rerouted']:,} shed={r['shed']:,} "
+          f"quota={r['quota_rejected']:,} dup_replies="
+          f"{r['dup_replies']:,} worker_down={r['worker_down']:,})")
+    print(f"    route lat {_lat_line(r.get('reply_latency'))}")
+    for w in fleet["workers"]:
+        occ = w.get("occupancy")
+        occ_s = (f"occ mean={occ['mean']:.2f} max={occ['max']:g}"
+                 if occ else "no occupancy")
+        cold = w.get("compile_cold")
+        print(f"    {w['name']:<8} requests={w['requests']:,} "
+              f"batches={w['batches']:,} "
+              f"backfilled={w['backfilled']:,}  {occ_s}  "
+              f"cold={cold} restarts={w['restarts']}")
 
 
 def _iter_docs(path: str):
